@@ -1,0 +1,76 @@
+"""registrar_tpu — a from-scratch, idiomatic Python rebuild of
+TritonDataCenter/registrar (reference at /root/reference).
+
+Registrar is a service-discovery sidecar: it writes this host's IP/ports
+into ZooKeeper ephemeral nodes (consumed by Binder to answer DNS A/SRV
+queries), keeps them alive with a heartbeat loop, and optionally runs a
+periodic command-based health check that deregisters the host while the
+check reports it down.
+
+The reference (~800 LoC of callback-style Node.js; see SURVEY.md) has no
+compute path of any kind, so this rebuild targets *capability* parity: the
+ZooKeeper data contract is preserved byte-for-byte (reference
+lib/register.js:141-159 and README.md "ZooKeeper data format"), the
+operational timing constants are identical (BASELINE.md), and the known
+reference bugs that do not affect the wire contract are fixed.
+
+Layer map (mirrors SURVEY.md §1):
+
+    main.py      CLI/daemon mainline                  (ref main.js)
+    agent.py     register_plus orchestrator           (ref lib/index.js)
+    register.py  znode registration pipeline          (ref lib/register.js)
+    health.py    periodic command health checker      (ref lib/health.js)
+    zk/          ZooKeeper client, written from scratch against the
+                 public ZooKeeper 3.4 wire protocol   (ref lib/zk.js + zkplus)
+    testing/     in-process ZooKeeper server for hermetic tests
+                 (the reference's tests need a live ZK at 127.0.0.1:2181;
+                 see SURVEY.md §4 — this is the rebuild's main test upgrade)
+"""
+
+import importlib
+
+__version__ = "1.0.0"
+
+# Flat re-export surface mirroring the reference's lib/index.js:184-186,
+# which re-exports every symbol from health/register/zk alongside the
+# default register_plus export.  Lazy so that subsets of the package can be
+# imported without pulling in the whole stack.
+_EXPORTS = {
+    "register_plus": "registrar_tpu.agent",
+    "RegistrarEvents": "registrar_tpu.agent",
+    "create_health_check": "registrar_tpu.health",
+    "HealthCheck": "registrar_tpu.health",
+    "domain_to_path": "registrar_tpu.records",
+    "host_record": "registrar_tpu.records",
+    "service_record": "registrar_tpu.records",
+    "default_address": "registrar_tpu.records",
+    "HOST_RECORD_TYPES": "registrar_tpu.records",
+    "register": "registrar_tpu.register",
+    "unregister": "registrar_tpu.register",
+    "ZKClient": "registrar_tpu.zk.client",
+    "create_zk_client": "registrar_tpu.zk.client",
+}
+
+
+def __getattr__(name):
+    mod = _EXPORTS.get(name)
+    if mod is None:
+        raise AttributeError(f"module 'registrar_tpu' has no attribute {name!r}")
+    return getattr(importlib.import_module(mod), name)
+
+__all__ = [
+    "register_plus",
+    "RegistrarEvents",
+    "create_health_check",
+    "HealthCheck",
+    "domain_to_path",
+    "host_record",
+    "service_record",
+    "default_address",
+    "HOST_RECORD_TYPES",
+    "register",
+    "unregister",
+    "ZKClient",
+    "create_zk_client",
+    "__version__",
+]

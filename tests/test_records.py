@@ -1,0 +1,188 @@
+"""Golden tests for the ZooKeeper data contract.
+
+Every expected JSON byte-string below is transcribed from the reference
+README's worked examples (reference README.md:443-757) or derived from the
+reference's record-construction code (reference lib/register.js:132-171,
+45-75).  These pin the Binder wire contract: if one of these breaks, the
+rebuild no longer interoperates with the reference deployment.
+"""
+
+import json
+
+import pytest
+
+from registrar_tpu.records import (
+    DEFAULT_SERVICE_TTL,
+    HOST_RECORD_TYPES,
+    default_address,
+    domain_to_path,
+    host_record,
+    parse_payload,
+    path_to_domain,
+    payload_bytes,
+    service_record,
+)
+
+
+class TestDomainToPath:
+    def test_reference_docstring_example(self):
+        # reference lib/register.js:36
+        assert (
+            domain_to_path("1.moray.us-east.joyent.com")
+            == "/com/joyent/us-east/moray/1"
+        )
+
+    def test_readme_authcache_example(self):
+        # reference README.md:466-469
+        assert (
+            domain_to_path("authcache.emy-10.joyent.us")
+            == "/us/joyent/emy-10/authcache"
+        )
+
+    def test_lowercases(self):
+        assert domain_to_path("FOO.Example.COM") == "/com/example/foo"
+
+    def test_single_label(self):
+        assert domain_to_path("localhost") == "/localhost"
+
+    def test_rejects_empty(self):
+        with pytest.raises(ValueError):
+            domain_to_path("")
+
+    def test_roundtrip(self):
+        assert path_to_domain(domain_to_path("a.b.c")) == "a.b.c"
+
+
+class TestHostRecord:
+    def test_readme_redis_host_example(self):
+        # reference README.md:536-545 (authcache instance host record)
+        rec = host_record("redis_host", "172.27.10.62", ttl=30, ports=[6379])
+        assert rec == json.loads(
+            """
+            {
+              "type": "redis_host",
+              "address": "172.27.10.62",
+              "ttl": 30,
+              "redis_host": {
+                "address": "172.27.10.62",
+                "ports": [ 6379 ]
+              }
+            }
+            """
+        )
+        # byte-exact: JSON.stringify key order = insertion order
+        assert payload_bytes(rec) == (
+            b'{"type":"redis_host","address":"172.27.10.62","ttl":30,'
+            b'"redis_host":{"address":"172.27.10.62","ports":[6379]}}'
+        )
+
+    def test_readme_load_balancer_example_no_ttl(self):
+        # reference README.md:624-632: ttl absent entirely when unset
+        rec = host_record("load_balancer", "172.27.10.72", ports=[80])
+        assert payload_bytes(rec) == (
+            b'{"type":"load_balancer","address":"172.27.10.72",'
+            b'"load_balancer":{"address":"172.27.10.72","ports":[80]}}'
+        )
+
+    def test_no_ports_omits_ports_key(self):
+        # JSON.stringify drops undefined members (reference
+        # lib/register.js:139-155 leaves ports undefined when neither
+        # registration.ports nor a service is configured).
+        rec = host_record("host", "10.0.0.1")
+        assert payload_bytes(rec) == (
+            b'{"type":"host","address":"10.0.0.1",'
+            b'"host":{"address":"10.0.0.1"}}'
+        )
+        assert "ttl" not in rec
+        assert "ports" not in rec["host"]
+
+    def test_service_type_rejected(self):
+        with pytest.raises(ValueError):
+            host_record("service", "10.0.0.1")
+
+    def test_all_documented_types_roundtrip(self):
+        for rtype in HOST_RECORD_TYPES:
+            rec = host_record(rtype, "192.168.0.5", ports=[1, 2])
+            parsed = parse_payload(payload_bytes(rec))
+            assert parsed["type"] == rtype
+            assert parsed[rtype]["ports"] == [1, 2]
+
+
+class TestServiceRecord:
+    def test_readme_http_example_with_default_ttl(self):
+        # reference README.md:663-674 shows the stored record; the inner
+        # ttl:60 default is injected at registration time
+        # (reference lib/register.js:197).
+        cfg = {
+            "type": "service",
+            "service": {"srvce": "_http", "proto": "_tcp", "port": 80},
+        }
+        rec = service_record(cfg)
+        assert payload_bytes(rec) == (
+            b'{"type":"service","service":{"type":"service",'
+            b'"service":{"srvce":"_http","proto":"_tcp","port":80,"ttl":60}}}'
+        )
+        # input config must not be mutated (the reference mutates it;
+        # fixed here, SURVEY.md §7 "faithful-vs-fixed")
+        assert "ttl" not in cfg["service"]
+
+    def test_readme_redis_example_explicit_ttls(self):
+        # reference README.md:509-521 (authcache service record with both
+        # inner and outer ttl present)
+        cfg = {
+            "type": "service",
+            "service": {"srvce": "_redis", "proto": "_tcp", "port": 6379, "ttl": 60},
+            "ttl": 60,
+        }
+        rec = service_record(cfg)
+        assert payload_bytes(rec) == (
+            b'{"type":"service","service":{"type":"service",'
+            b'"service":{"srvce":"_redis","proto":"_tcp","port":6379,"ttl":60},'
+            b'"ttl":60}}'
+        )
+
+    def test_explicit_ttl_preserves_position(self):
+        cfg = {
+            "type": "service",
+            "service": {"srvce": "_s", "ttl": 5, "proto": "_tcp", "port": 1},
+        }
+        rec = service_record(cfg)
+        assert payload_bytes(rec) == (
+            b'{"type":"service","service":{"type":"service",'
+            b'"service":{"srvce":"_s","ttl":5,"proto":"_tcp","port":1}}}'
+        )
+
+    def test_default_ttl_constant(self):
+        assert DEFAULT_SERVICE_TTL == 60
+
+    @pytest.mark.parametrize(
+        "bad",
+        [
+            {},
+            {"type": "not-service", "service": {"srvce": "_s", "proto": "_t", "port": 1}},
+            {"type": "service"},
+            {"type": "service", "service": {"proto": "_t", "port": 1}},
+            {"type": "service", "service": {"srvce": "_s", "port": 1}},
+            {"type": "service", "service": {"srvce": "_s", "proto": "_t"}},
+            {"type": "service", "service": {"srvce": "_s", "proto": "_t", "port": True}},
+            {"type": "service", "service": {"srvce": "_s", "proto": "_t", "port": 1, "ttl": "x"}},
+            {"type": "service", "service": {"srvce": "_s", "proto": "_t", "port": 1, "ttl": None}},
+        ],
+    )
+    def test_validation_rejects(self, bad):
+        with pytest.raises(ValueError):
+            service_record(bad)
+
+
+class TestDefaultAddress:
+    def test_returns_non_loopback_ipv4_or_raises(self):
+        # In an environment with no non-loopback interface this must raise
+        # rather than poison DNS with 127.0.0.1.
+        try:
+            addr = default_address()
+        except RuntimeError:
+            return
+        parts = addr.split(".")
+        assert len(parts) == 4
+        assert all(0 <= int(p) <= 255 for p in parts)
+        assert not addr.startswith("127.")
