@@ -1,0 +1,69 @@
+"""A minimal event emitter for asyncio code.
+
+The reference's public surfaces are Node EventEmitters (lib/index.js:38,
+main.js:160-198, zkplus client events); this is the idiomatic-Python
+equivalent used by :mod:`registrar_tpu.zk.client` and
+:mod:`registrar_tpu.agent`.  Listeners may be plain callables or coroutine
+functions; coroutine listeners are scheduled as tasks on the running loop.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import inspect
+import logging
+from collections import defaultdict
+from typing import Any, Callable, Dict, List
+
+log = logging.getLogger("registrar_tpu.events")
+
+
+class EventEmitter:
+    def __init__(self) -> None:
+        self._listeners: Dict[str, List[Callable]] = defaultdict(list)
+        self._once: Dict[str, List[Callable]] = defaultdict(list)
+
+    def on(self, event: str, listener: Callable) -> Callable:
+        """Register ``listener`` for ``event``; returns it (decorator-friendly)."""
+        self._listeners[event].append(listener)
+        return listener
+
+    def once(self, event: str, listener: Callable) -> Callable:
+        self._once[event].append(listener)
+        return listener
+
+    def off(self, event: str, listener: Callable) -> None:
+        for registry in (self._listeners, self._once):
+            if listener in registry.get(event, []):
+                registry[event].remove(listener)
+
+    def listener_count(self, event: str) -> int:
+        return len(self._listeners.get(event, [])) + len(self._once.get(event, []))
+
+    def emit(self, event: str, *args: Any) -> int:
+        """Dispatch ``event``; returns the number of listeners invoked."""
+        targets = list(self._listeners.get(event, []))
+        once = self._once.pop(event, [])
+        targets.extend(once)
+        for listener in targets:
+            try:
+                result = listener(*args)
+                if inspect.isawaitable(result):
+                    asyncio.get_running_loop().create_task(_guard(event, result))
+            except Exception:
+                log.exception("listener for %r raised", event)
+        return len(targets)
+
+    async def wait_for(self, event: str, timeout: float = 30.0) -> tuple:
+        """Await the next emission of ``event``; returns its args (test aid)."""
+        loop = asyncio.get_running_loop()
+        fut: asyncio.Future = loop.create_future()
+        self.once(event, lambda *a: fut.done() or fut.set_result(a))
+        return await asyncio.wait_for(fut, timeout)
+
+
+async def _guard(event: str, awaitable) -> None:
+    try:
+        await awaitable
+    except Exception:
+        log.exception("async listener for %r raised", event)

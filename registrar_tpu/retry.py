@@ -1,0 +1,75 @@
+"""Exponential-backoff retry, mirroring the reference's `backoff` library use.
+
+Two policies exist in the reference and both are preserved exactly
+(BASELINE.md):
+
+  * initial ZK connect: infinite attempts, exponential 1 s -> 90 s
+    (reference lib/zk.js:97-101)
+  * application heartbeat: 5 attempts, exponential 1 s -> 30 s
+    (reference lib/zk.js:38-42)
+
+Delay schedule matches node-backoff's ExponentialStrategy: the first retry
+waits ``initial_delay``, each subsequent retry doubles it, capped at
+``max_delay``.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import math
+from dataclasses import dataclass
+from typing import Awaitable, Callable, Optional, TypeVar
+
+T = TypeVar("T")
+
+
+@dataclass(frozen=True)
+class RetryPolicy:
+    max_attempts: float = 5  # math.inf for unbounded
+    initial_delay: float = 1.0  # seconds
+    max_delay: float = 30.0  # seconds
+
+    def delay(self, attempt: int) -> float:
+        """Delay before retry number ``attempt`` (0-based)."""
+        return min(self.initial_delay * (2**attempt), self.max_delay)
+
+
+#: reference lib/zk.js:38-42
+HEARTBEAT_RETRY = RetryPolicy(max_attempts=5, initial_delay=1.0, max_delay=30.0)
+#: reference lib/zk.js:97-101
+CONNECT_RETRY = RetryPolicy(max_attempts=math.inf, initial_delay=1.0, max_delay=90.0)
+
+
+async def call_with_backoff(
+    fn: Callable[[], Awaitable[T]],
+    policy: RetryPolicy,
+    on_backoff: Optional[Callable[[int, float, Exception], object]] = None,
+    retryable: Optional[Callable[[Exception], bool]] = None,
+) -> T:
+    """Run ``fn`` until it succeeds or the policy's attempts are exhausted.
+
+    ``on_backoff(attempt_number, delay_seconds, error)`` is invoked before
+    each sleep, mirroring node-backoff's 'backoff' event (used by the
+    reference for connect-attempt logging, lib/zk.js:104-119).  Cancelling
+    the awaiting task aborts the loop (the analog of `retry.abort()`).
+
+    ``retryable(err)`` returning False makes the error fatal: it propagates
+    immediately without further attempts (e.g. session expiry during a
+    reconnect loop — retrying cannot resurrect an expired session).
+    """
+    attempt = 0
+    while True:
+        try:
+            return await fn()
+        except asyncio.CancelledError:
+            raise
+        except Exception as err:
+            if retryable is not None and not retryable(err):
+                raise
+            if attempt + 1 >= policy.max_attempts:
+                raise
+            delay = policy.delay(attempt)
+            if on_backoff is not None:
+                on_backoff(attempt, delay, err)
+            await asyncio.sleep(delay)
+            attempt += 1

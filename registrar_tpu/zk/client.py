@@ -1,0 +1,576 @@
+"""Asyncio ZooKeeper client — the rebuild's L1 transport.
+
+Replaces the reference's zkplus dependency (reference lib/zk.js,
+package.json:21) with a from-scratch client speaking the public ZooKeeper
+3.4 wire protocol.  The surface mirrors what the upper layers of the
+reference actually use (SURVEY.md §1 L1): ``put``, ``create`` (with
+ephemeral-plus semantics), ``unlink``, ``mkdirp``, ``stat``, ``get``,
+``get_children``, ``close``, events ``connect`` / ``close`` /
+``session_expired``, plus the application-level ``heartbeat`` that the
+reference monkey-patches onto the client (lib/zk.js:47-59).
+
+Connection/session model:
+
+  * :func:`create_zk_client` retries the initial connect forever with
+    exponential backoff 1 s -> 90 s, logging each attempt and emitting
+    ``attempt`` events (reference lib/zk.js:88-119).  Cancel the task to
+    abort (the analog of the reference's ``retry.stop()``).
+  * After a drop, the client reconnects with the same (session_id, passwd),
+    re-arming watches via SetWatches.  If the server no longer knows the
+    session it emits ``session_expired`` — the daemon's policy is to exit
+    and let the supervisor restart it (reference main.js:141-144).
+  * ``ephemeral_plus`` creates (zkplus's flag, used at
+    reference lib/register.js:157) are ephemeral creates that transparently
+    mkdirp a missing parent.  Intentional divergence, documented: this
+    client does NOT silently re-create ephemerals on session re-establishment
+    — re-registration is the orchestrator's job (lib/index.js re-registers,
+    and main.js exits on expiry), so hiding it in the transport would mask
+    real failures.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import random
+import time
+from collections import deque
+from typing import Deque, Iterable, List, Optional, Sequence, Tuple
+
+from registrar_tpu.events import EventEmitter
+from registrar_tpu.retry import (
+    CONNECT_RETRY,
+    HEARTBEAT_RETRY,
+    RetryPolicy,
+    call_with_backoff,
+)
+from registrar_tpu.zk import protocol as proto
+from registrar_tpu.zk.jute import Reader, Writer
+from registrar_tpu.zk.protocol import (
+    CreateFlag,
+    Err,
+    OpCode,
+    OPEN_ACL_UNSAFE,
+    Stat,
+    ZKError,
+    check_path,
+)
+
+log = logging.getLogger("registrar_tpu.zk.client")
+
+
+class ZKClient(EventEmitter):
+    """One logical ZooKeeper session over a sequence of TCP connections.
+
+    Events: ``connect`` (session (re)established), ``close`` (transport
+    lost or client closed), ``session_expired`` (server disowned our
+    session), ``state`` (every transition, with the state string).
+    """
+
+    def __init__(
+        self,
+        servers: Sequence[Tuple[str, int]],
+        timeout_ms: int = 30000,
+        connect_timeout_ms: int = 4000,
+        reconnect: bool = True,
+        reconnect_policy: Optional[RetryPolicy] = None,
+    ):
+        super().__init__()
+        servers = list(servers)
+        if not servers:
+            raise ValueError("servers must be non-empty")
+        for host, port in servers:
+            if not isinstance(host, str) or not isinstance(port, int):
+                raise ValueError("servers must be (host, port) pairs")
+        self.servers = servers
+        self.requested_timeout_ms = timeout_ms
+        self.connect_timeout_ms = connect_timeout_ms
+        self.reconnect = reconnect
+        self.reconnect_policy = reconnect_policy or CONNECT_RETRY
+
+        self.session_id = 0
+        self.session_passwd = b"\x00" * 16
+        self.negotiated_timeout_ms = timeout_ms
+        self.last_zxid = 0
+
+        self._reader: Optional[asyncio.StreamReader] = None
+        self._writer: Optional[asyncio.StreamWriter] = None
+        self._xid = 0
+        self._pending: Deque[Tuple[int, asyncio.Future]] = deque()
+        self._read_task: Optional[asyncio.Task] = None
+        self._ping_task: Optional[asyncio.Task] = None
+        self._reconnect_task: Optional[asyncio.Task] = None
+        self._closed = False
+        self._connected = False
+        # one-shot watches to re-arm after reconnect: kind -> set of paths
+        self._watch_paths = {"data": set(), "exist": set(), "child": set()}
+        self._watch_emitter = EventEmitter()
+
+    # -- state --------------------------------------------------------------
+
+    @property
+    def connected(self) -> bool:
+        return self._connected
+
+    @property
+    def closed(self) -> bool:
+        return self._closed
+
+    def __str__(self) -> str:
+        hosts = ",".join(f"{h}:{p}" for h, p in self.servers)
+        return f"ZKClient({hosts}, session=0x{self.session_id:x})"
+
+    # -- connection management ----------------------------------------------
+
+    async def connect(self) -> "ZKClient":
+        """Connect (or reconnect) to the first reachable server.
+
+        Single pass over the server list in random order; raises on total
+        failure.  Use :func:`create_zk_client` for the reference's
+        infinite-backoff behavior.
+        """
+        if self._closed:
+            raise ZKError(Err.SESSION_EXPIRED, None)
+        last_err: Optional[Exception] = None
+        order = list(self.servers)
+        random.shuffle(order)
+        for host, port in order:
+            try:
+                await self._connect_one(host, port)
+                return self
+            except SessionExpiredError:
+                raise
+            except asyncio.CancelledError:
+                raise
+            except Exception as err:  # noqa: BLE001 - try next server
+                last_err = err
+                log.debug("connect to %s:%d failed: %r", host, port, err)
+        raise last_err if last_err else ConnectionError("no servers")
+
+    async def _connect_one(self, host: str, port: int) -> None:
+        timeout = self.connect_timeout_ms / 1000.0
+        reader, writer = await asyncio.wait_for(
+            asyncio.open_connection(host, port), timeout
+        )
+        try:
+            req = proto.ConnectRequest(
+                protocol_version=0,
+                last_zxid_seen=self.last_zxid,
+                timeout_ms=self.requested_timeout_ms,
+                session_id=self.session_id,
+                passwd=self.session_passwd,
+            )
+            w = Writer()
+            req.write(w)
+            writer.write(proto.frame(w.to_bytes()))
+            await writer.drain()
+            hdr = await asyncio.wait_for(reader.readexactly(4), timeout)
+            length = int.from_bytes(hdr, "big", signed=True)
+            payload = await asyncio.wait_for(reader.readexactly(length), timeout)
+            resp = proto.ConnectResponse.read(Reader(payload))
+        except Exception:
+            writer.close()
+            raise
+
+        if resp.session_id == 0 or resp.timeout_ms <= 0:
+            # Server refused to (re)attach the session: it has expired.
+            writer.close()
+            self._emit_expired()
+            raise SessionExpiredError()
+
+        reattached = self.session_id == resp.session_id and self.session_id != 0
+        self.session_id = resp.session_id
+        self.session_passwd = resp.passwd
+        self.negotiated_timeout_ms = resp.timeout_ms
+        self._reader = reader
+        self._writer = writer
+        self._connected = True
+        self._read_task = asyncio.create_task(self._read_loop())
+        self._ping_task = asyncio.create_task(self._ping_loop())
+        if reattached:
+            await self._rearm_watches()
+        log.debug(
+            "connected to %s:%d session=0x%x timeout=%dms",
+            host, port, self.session_id, self.negotiated_timeout_ms,
+        )
+        self.emit("state", "connected")
+        self.emit("connect")
+
+    async def _rearm_watches(self) -> None:
+        if not any(self._watch_paths.values()):
+            return
+        body = proto.SetWatches(
+            relative_zxid=self.last_zxid,
+            data_watches=sorted(self._watch_paths["data"]),
+            exist_watches=sorted(self._watch_paths["exist"]),
+            child_watches=sorted(self._watch_paths["child"]),
+        )
+        try:
+            await self._submit(
+                proto.XID_SET_WATCHES, OpCode.SET_WATCHES, body
+            )
+        except ZKError as err:
+            log.warning("re-arming watches failed: %s", err)
+
+    async def close(self) -> None:
+        """Gracefully end the session (ephemerals are dropped server-side)."""
+        if self._closed:
+            return
+        self._closed = True
+        if self._reconnect_task:
+            self._reconnect_task.cancel()
+        if self._connected:
+            try:
+                await asyncio.wait_for(
+                    self._submit(self._next_xid(), OpCode.CLOSE_SESSION, None),
+                    timeout=2.0,
+                )
+            except Exception:  # noqa: BLE001 - best-effort close
+                pass
+        await self._teardown(expected=True)
+
+    async def _teardown(self, expected: bool) -> None:
+        was_connected = self._connected
+        self._connected = False
+        for task in (self._read_task, self._ping_task):
+            if task is not None and task is not asyncio.current_task():
+                task.cancel()
+        if self._writer is not None:
+            try:
+                self._writer.close()
+            except Exception:  # noqa: BLE001
+                pass
+            self._writer = None
+            self._reader = None
+        err = ZKError(Err.CONNECTION_LOSS)
+        while self._pending:
+            _, fut = self._pending.popleft()
+            if not fut.done():
+                fut.set_exception(err)
+        if was_connected:
+            self.emit("state", "disconnected")
+            self.emit("close")
+        if not expected and not self._closed and self.reconnect:
+            if self._reconnect_task is None or self._reconnect_task.done():
+                self._reconnect_task = asyncio.create_task(self._reconnect_loop())
+
+    async def _reconnect_loop(self) -> None:
+        try:
+            await call_with_backoff(
+                self.connect,
+                self.reconnect_policy,
+                on_backoff=lambda n, delay, err: log.warning(
+                    "reconnect attempt %d failed (%r); retrying in %.1fs",
+                    n, err, delay,
+                ),
+                # An expired/closed session cannot be resurrected by retrying.
+                retryable=lambda err: not (
+                    isinstance(err, SessionExpiredError) or self._closed
+                ),
+            )
+        except SessionExpiredError:
+            pass  # _emit_expired already fired
+        except asyncio.CancelledError:
+            pass
+        except Exception:  # noqa: BLE001
+            log.exception("reconnect loop gave up")
+
+    def _emit_expired(self) -> None:
+        self._closed = True
+        self.emit("state", "session_expired")
+        self.emit("session_expired")
+
+    # -- wire I/O -----------------------------------------------------------
+
+    def _next_xid(self) -> int:
+        self._xid += 1
+        return self._xid
+
+    async def _read_loop(self) -> None:
+        reader = self._reader
+        try:
+            while True:
+                hdr = await reader.readexactly(4)
+                length = int.from_bytes(hdr, "big", signed=True)
+                if length < 0 or length > 4 * 1024 * 1024:
+                    raise ConnectionError(f"bad frame length {length}")
+                payload = await reader.readexactly(length)
+                self._dispatch_frame(payload)
+        except asyncio.CancelledError:
+            raise
+        except (asyncio.IncompleteReadError, ConnectionError, OSError) as err:
+            log.debug("connection lost: %r", err)
+            await self._teardown(expected=False)
+        except Exception:  # noqa: BLE001 - malformed frame: treat as conn loss
+            log.exception("protocol error on connection; tearing down")
+            await self._teardown(expected=False)
+
+    def _dispatch_frame(self, payload: bytes) -> None:
+        r = Reader(payload)
+        reply = proto.ReplyHeader.read(r)
+        if reply.zxid > 0:
+            self.last_zxid = reply.zxid
+        if reply.xid == proto.XID_NOTIFICATION:
+            event = proto.WatcherEvent.read(r)
+            self._on_watch_event(event)
+            return
+        if not self._pending:
+            log.warning("unmatched reply xid=%d", reply.xid)
+            return
+        expected_xid, fut = self._pending.popleft()
+        if expected_xid != reply.xid:
+            log.error("xid mismatch: expected %d got %d", expected_xid, reply.xid)
+            if not fut.done():
+                fut.set_exception(ZKError(Err.CONNECTION_LOSS))
+            return
+        if fut.done():
+            return
+        if reply.err != Err.OK:
+            fut.set_exception(ZKError(reply.err))
+        else:
+            fut.set_result(r)
+
+    def _on_watch_event(self, event: proto.WatcherEvent) -> None:
+        if event.type == proto.EventType.NONE:
+            # Server-side session event (e.g. expiry notification).
+            return
+        for kind in self._watch_paths.values():
+            kind.discard(event.path)
+        self.emit("watch", event)
+        self._watch_emitter.emit(event.path, event)
+
+    async def _submit(self, xid: int, op: int, body) -> Optional[Reader]:
+        if not self._connected or self._writer is None:
+            raise ZKError(Err.CONNECTION_LOSS)
+        fut: asyncio.Future = asyncio.get_running_loop().create_future()
+        self._pending.append((xid, fut))
+        self._writer.write(proto.encode_request(xid, op, body))
+        try:
+            await self._writer.drain()
+        except (ConnectionError, OSError):
+            await self._teardown(expected=False)
+        return await fut
+
+    async def _call(self, op: int, body) -> Reader:
+        return await self._submit(self._next_xid(), op, body)
+
+    async def _ping_loop(self) -> None:
+        interval = max(self.negotiated_timeout_ms / 3000.0, 0.02)
+        try:
+            while self._connected:
+                await asyncio.sleep(interval)
+                if not self._connected:
+                    return
+                try:
+                    await self._submit(proto.XID_PING, OpCode.PING, None)
+                except ZKError:
+                    return
+        except asyncio.CancelledError:
+            raise
+
+    # -- znode operations (the reference's call surface) ---------------------
+
+    async def create(
+        self,
+        path: str,
+        data: bytes = b"",
+        flags: int = CreateFlag.PERSISTENT,
+        acls=None,
+    ) -> str:
+        """Create a znode; returns the created path."""
+        check_path(path)
+        r = await self._call(
+            OpCode.CREATE,
+            proto.CreateRequest(
+                path=path,
+                data=data,
+                acls=list(acls) if acls is not None else list(OPEN_ACL_UNSAFE),
+                flags=flags,
+            ),
+        )
+        return proto.CreateResponse.read(r).path
+
+    async def create_ephemeral_plus(self, path: str, data: bytes = b"") -> str:
+        """Ephemeral create that transparently creates missing parents.
+
+        The zkplus 'ephemeral_plus' flag used by the reference's
+        registerEntries stage (lib/register.js:156-158).  The registration
+        pipeline mkdirps parents beforehand, so the fallback here only
+        triggers when racing a concurrent cleanup.
+        """
+        try:
+            return await self.create(path, data, CreateFlag.EPHEMERAL)
+        except ZKError as err:
+            if err.code != Err.NO_NODE:
+                raise
+        parent = path.rsplit("/", 1)[0] or "/"
+        await self.mkdirp(parent)
+        return await self.create(path, data, CreateFlag.EPHEMERAL)
+
+    async def put(self, path: str, data: bytes) -> Stat:
+        """Set a node's data, creating it (persistent) when missing.
+
+        zkplus ``put`` semantics, used for the persistent service record
+        (reference lib/register.js:62).
+        """
+        check_path(path)
+        try:
+            r = await self._call(
+                OpCode.SET_DATA, proto.SetDataRequest(path=path, data=data)
+            )
+            return proto.SetDataResponse.read(r).stat
+        except ZKError as err:
+            if err.code != Err.NO_NODE:
+                raise
+        parent = path.rsplit("/", 1)[0] or "/"
+        await self.mkdirp(parent)
+        try:
+            await self.create(path, data, CreateFlag.PERSISTENT)
+        except ZKError as err:
+            if err.code != Err.NODE_EXISTS:
+                raise
+            r = await self._call(
+                OpCode.SET_DATA, proto.SetDataRequest(path=path, data=data)
+            )
+            return proto.SetDataResponse.read(r).stat
+        return (await self.stat(path))
+
+    async def unlink(self, path: str, version: int = -1) -> None:
+        """Delete a znode (zkplus name, reference lib/register.js:87)."""
+        check_path(path)
+        await self._call(OpCode.DELETE, proto.DeleteRequest(path=path, version=version))
+
+    async def stat(self, path: str, watch: bool = False) -> Stat:
+        """Stat a znode; raises NO_NODE when absent (heartbeat primitive)."""
+        check_path(path)
+        try:
+            r = await self._call(
+                OpCode.EXISTS, proto.ExistsRequest(path=path, watch=watch)
+            )
+        except ZKError as err:
+            if watch and err.code == Err.NO_NODE:
+                self._watch_paths["exist"].add(path)
+            raise
+        if watch:
+            self._watch_paths["data"].add(path)
+        return proto.ExistsResponse.read(r).stat
+
+    async def exists(self, path: str, watch: bool = False) -> Optional[Stat]:
+        """Like :meth:`stat` but returns None instead of raising NO_NODE."""
+        try:
+            return await self.stat(path, watch=watch)
+        except ZKError as err:
+            if err.code == Err.NO_NODE:
+                return None
+            raise
+
+    async def get(self, path: str, watch: bool = False) -> Tuple[bytes, Stat]:
+        check_path(path)
+        r = await self._call(
+            OpCode.GET_DATA, proto.GetDataRequest(path=path, watch=watch)
+        )
+        if watch:
+            self._watch_paths["data"].add(path)
+        resp = proto.GetDataResponse.read(r)
+        return (resp.data or b"", resp.stat)
+
+    async def get_children(self, path: str, watch: bool = False) -> List[str]:
+        check_path(path)
+        r = await self._call(
+            OpCode.GET_CHILDREN2, proto.GetChildrenRequest(path=path, watch=watch)
+        )
+        if watch:
+            self._watch_paths["child"].add(path)
+        return proto.GetChildren2Response.read(r).children
+
+    async def mkdirp(self, path: str) -> None:
+        """Create ``path`` and any missing ancestors (persistent, empty)."""
+        check_path(path)
+        if path == "/":
+            return
+        parts = path.strip("/").split("/")
+        current = ""
+        for comp in parts:
+            current += "/" + comp
+            try:
+                await self.create(current, b"", CreateFlag.PERSISTENT)
+            except ZKError as err:
+                if err.code != Err.NODE_EXISTS:
+                    raise
+
+    def watch(self, path: str, listener) -> None:
+        """Register a listener for one-shot watch events on ``path``."""
+        self._watch_emitter.on(path, listener)
+
+    # -- application heartbeat (reference lib/zk.js:21-59) -------------------
+
+    async def heartbeat(
+        self, nodes: Iterable[str], retry: Optional[RetryPolicy] = None
+    ) -> None:
+        """Probe liveness of owned znodes: parallel stat with bounded retry.
+
+        Retry policy: 5 attempts, exponential 1 s -> 30 s (reference
+        lib/zk.js:37-43).  Raises the final error when all attempts fail.
+        Note this is an *application-level* probe of the znodes; the session
+        keepalive pings are handled inside the client (reference README:56-58
+        makes the same distinction).
+        """
+        nodes = list(nodes)
+
+        async def check() -> None:
+            results = await asyncio.gather(
+                *(self.stat(n) for n in nodes), return_exceptions=True
+            )
+            for res in results:
+                if isinstance(res, BaseException):
+                    raise res
+
+        await call_with_backoff(check, retry or HEARTBEAT_RETRY)
+
+
+class SessionExpiredError(ZKError):
+    def __init__(self) -> None:
+        super().__init__(Err.SESSION_EXPIRED)
+
+
+async def create_zk_client(
+    servers: Sequence[Tuple[str, int]],
+    timeout_ms: int = 30000,
+    connect_timeout_ms: int = 4000,
+    on_attempt=None,
+    retry_policy: Optional[RetryPolicy] = None,
+) -> ZKClient:
+    """Create and connect a client, retrying forever (reference lib/zk.js:62-127).
+
+    The reference wraps zkplus connect in backoff with failAfter(Infinity)
+    and exponential 1 s -> 90 s, logging attempt 0 at info, attempts < 5 at
+    warn, then error, and re-emitting 'attempt' events.  Here
+    ``on_attempt(number, delay, err)`` receives the same information; abort
+    by cancelling the awaiting task (the analog of ``retry.stop()``).
+    """
+    client = ZKClient(
+        servers,
+        timeout_ms=timeout_ms,
+        connect_timeout_ms=connect_timeout_ms,
+        reconnect_policy=retry_policy,  # reconnects follow the same policy
+    )
+
+    def backoff_log(number: int, delay: float, err: Exception) -> None:
+        level = (
+            logging.INFO if number == 0
+            else logging.WARNING if number < 5
+            else logging.ERROR
+        )
+        log.log(
+            level,
+            "zookeeper: connection attempted (failed): attempt=%d delay=%.1fs err=%r",
+            number, delay, err,
+        )
+        if on_attempt is not None:
+            on_attempt(number, delay, err)
+
+    await call_with_backoff(
+        client.connect, retry_policy or CONNECT_RETRY, on_backoff=backoff_log
+    )
+    log.info("ZK: connected: %s", client)
+    return client

@@ -1,0 +1,137 @@
+"""Jute (ZooKeeper's wire serialization) primitives.
+
+ZooKeeper serializes every protocol record with "jute", a tiny big-endian
+binary format.  The reference delegates this to the external zkplus/node
+ZooKeeper stack (reference package.json:21); this rebuild implements the
+format directly so the framework is standalone.
+
+Primitive encodings (Apache ZooKeeper jute/binary format, stable since 3.x):
+
+    int      4-byte signed big-endian
+    long     8-byte signed big-endian
+    boolean  1 byte (0 or 1)
+    buffer   int length followed by raw bytes; length -1 encodes null
+    ustring  buffer holding UTF-8 text
+    vector   int count followed by elements; count -1 encodes null
+"""
+
+from __future__ import annotations
+
+import struct
+from typing import Callable, List, Optional, TypeVar
+
+T = TypeVar("T")
+
+_INT = struct.Struct(">i")
+_LONG = struct.Struct(">q")
+
+INT_MIN, INT_MAX = -(2**31), 2**31 - 1
+LONG_MIN, LONG_MAX = -(2**63), 2**63 - 1
+
+
+class JuteError(ValueError):
+    """Raised on malformed jute data."""
+
+
+class Writer:
+    """Accumulates jute-encoded primitives into a byte buffer."""
+
+    __slots__ = ("_chunks",)
+
+    def __init__(self) -> None:
+        self._chunks: List[bytes] = []
+
+    def to_bytes(self) -> bytes:
+        return b"".join(self._chunks)
+
+    def write_int(self, value: int) -> "Writer":
+        if not INT_MIN <= value <= INT_MAX:
+            raise JuteError(f"int out of range: {value}")
+        self._chunks.append(_INT.pack(value))
+        return self
+
+    def write_long(self, value: int) -> "Writer":
+        if not LONG_MIN <= value <= LONG_MAX:
+            raise JuteError(f"long out of range: {value}")
+        self._chunks.append(_LONG.pack(value))
+        return self
+
+    def write_bool(self, value: bool) -> "Writer":
+        self._chunks.append(b"\x01" if value else b"\x00")
+        return self
+
+    def write_buffer(self, value: Optional[bytes]) -> "Writer":
+        if value is None:
+            return self.write_int(-1)
+        self.write_int(len(value))
+        self._chunks.append(bytes(value))
+        return self
+
+    def write_ustring(self, value: Optional[str]) -> "Writer":
+        return self.write_buffer(None if value is None else value.encode("utf-8"))
+
+    def write_vector(
+        self, items: Optional[List[T]], write_item: Callable[["Writer", T], object]
+    ) -> "Writer":
+        if items is None:
+            return self.write_int(-1)
+        self.write_int(len(items))
+        for item in items:
+            write_item(self, item)
+        return self
+
+
+class Reader:
+    """Reads jute-encoded primitives from a byte buffer."""
+
+    __slots__ = ("_data", "_pos")
+
+    def __init__(self, data: bytes, pos: int = 0) -> None:
+        self._data = data
+        self._pos = pos
+
+    @property
+    def pos(self) -> int:
+        return self._pos
+
+    def remaining(self) -> int:
+        return len(self._data) - self._pos
+
+    def _take(self, n: int) -> bytes:
+        if self.remaining() < n:
+            raise JuteError(
+                f"truncated jute data: need {n} bytes at offset {self._pos}, "
+                f"have {self.remaining()}"
+            )
+        out = self._data[self._pos : self._pos + n]
+        self._pos += n
+        return out
+
+    def read_int(self) -> int:
+        return _INT.unpack(self._take(4))[0]
+
+    def read_long(self) -> int:
+        return _LONG.unpack(self._take(8))[0]
+
+    def read_bool(self) -> bool:
+        return self._take(1) != b"\x00"
+
+    def read_buffer(self) -> Optional[bytes]:
+        n = self.read_int()
+        if n == -1:
+            return None
+        if n < -1:
+            raise JuteError(f"negative buffer length: {n}")
+        return self._take(n)
+
+    def read_ustring(self) -> Optional[str]:
+        buf = self.read_buffer()
+        return None if buf is None else buf.decode("utf-8")
+
+    def read_vector(self, read_item: Callable[["Reader"], T]) -> Optional[List[T]]:
+        n = self.read_int()
+        if n == -1:
+            return None
+        if n < -1:
+            raise JuteError(f"negative vector length: {n}")
+        return [read_item(self) for _ in range(n)]

@@ -1,0 +1,549 @@
+"""ZooKeeper 3.4 client protocol records, opcodes, and error codes.
+
+The subset of the protocol registrar needs (reference lib/zk.js call surface:
+connect, create-ephemeral, setData/put, delete, exists/stat, getData,
+getChildren for tooling, ping, closeSession — see SURVEY.md §1 L1), encoded
+with :mod:`registrar_tpu.zk.jute`.
+
+Framing: every message on the wire is a 4-byte big-endian length followed by
+that many payload bytes.  The first client message of a connection is a
+ConnectRequest (no header); afterwards each request is
+RequestHeader + op-specific body, each response ReplyHeader + body.
+Server-initiated watch notifications arrive with xid == -1.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import List, Optional
+
+from registrar_tpu.zk.jute import Reader, Writer
+
+
+# --- opcodes ---------------------------------------------------------------
+
+class OpCode:
+    NOTIFICATION = 0
+    CREATE = 1
+    DELETE = 2
+    EXISTS = 3
+    GET_DATA = 4
+    SET_DATA = 5
+    GET_ACL = 6
+    SET_ACL = 7
+    GET_CHILDREN = 8
+    SYNC = 9
+    PING = 11
+    GET_CHILDREN2 = 12
+    CHECK = 13
+    MULTI = 14
+    AUTH = 100
+    SET_WATCHES = 101
+    SASL = 102
+    CREATE_SESSION = -10
+    CLOSE_SESSION = -11
+    ERROR = -1
+
+
+# Reserved xids (client/server agreed sentinels).
+XID_NOTIFICATION = -1
+XID_PING = -2
+XID_AUTH = -4
+XID_SET_WATCHES = -8
+
+
+# --- error codes -----------------------------------------------------------
+
+class Err:
+    OK = 0
+    SYSTEM_ERROR = -1
+    RUNTIME_INCONSISTENCY = -2
+    DATA_INCONSISTENCY = -3
+    CONNECTION_LOSS = -4
+    MARSHALLING_ERROR = -5
+    UNIMPLEMENTED = -6
+    OPERATION_TIMEOUT = -7
+    BAD_ARGUMENTS = -8
+    API_ERROR = -100
+    NO_NODE = -101
+    NO_AUTH = -102
+    BAD_VERSION = -103
+    NO_CHILDREN_FOR_EPHEMERALS = -108
+    NODE_EXISTS = -110
+    NOT_EMPTY = -111
+    SESSION_EXPIRED = -112
+    INVALID_CALLBACK = -113
+    INVALID_ACL = -114
+    AUTH_FAILED = -115
+    SESSION_MOVED = -118
+
+#: error code -> symbolic name, mirroring the names upper layers match on
+#: (the reference matches `err.name !== 'NO_NODE'`, lib/register.js:88).
+ERR_NAMES = {
+    value: name
+    for name, value in vars(Err).items()
+    if not name.startswith("_")
+}
+
+
+# --- node create flags / ACL ----------------------------------------------
+
+class CreateFlag:
+    PERSISTENT = 0
+    EPHEMERAL = 1
+    PERSISTENT_SEQUENTIAL = 2
+    EPHEMERAL_SEQUENTIAL = 3
+
+
+class Perms:
+    READ = 1
+    WRITE = 2
+    CREATE = 4
+    DELETE = 8
+    ADMIN = 16
+    ALL = 31
+
+
+@dataclass(frozen=True)
+class ACL:
+    perms: int
+    scheme: str
+    id: str
+
+    def write(self, w: Writer) -> None:
+        w.write_int(self.perms)
+        w.write_ustring(self.scheme)
+        w.write_ustring(self.id)
+
+    @classmethod
+    def read(cls, r: Reader) -> "ACL":
+        return cls(perms=r.read_int(), scheme=r.read_ustring(), id=r.read_ustring())
+
+
+#: world:anyone with all permissions — what zkplus (and thus the reference)
+#: uses for every node it creates.
+OPEN_ACL_UNSAFE = [ACL(Perms.ALL, "world", "anyone")]
+
+
+# --- watch events ----------------------------------------------------------
+
+class EventType:
+    NONE = -1
+    NODE_CREATED = 1
+    NODE_DELETED = 2
+    NODE_DATA_CHANGED = 3
+    NODE_CHILDREN_CHANGED = 4
+
+
+class KeeperState:
+    DISCONNECTED = 0
+    SYNC_CONNECTED = 3
+    AUTH_FAILED = 4
+    CONNECTED_READ_ONLY = 5
+    EXPIRED = -112
+
+
+# --- records ---------------------------------------------------------------
+
+@dataclass
+class ConnectRequest:
+    protocol_version: int = 0
+    last_zxid_seen: int = 0
+    timeout_ms: int = 30000
+    session_id: int = 0
+    passwd: bytes = b"\x00" * 16
+    read_only: bool = False
+
+    def write(self, w: Writer) -> None:
+        w.write_int(self.protocol_version)
+        w.write_long(self.last_zxid_seen)
+        w.write_int(self.timeout_ms)
+        w.write_long(self.session_id)
+        w.write_buffer(self.passwd)
+        w.write_bool(self.read_only)
+
+    @classmethod
+    def read(cls, r: Reader) -> "ConnectRequest":
+        req = cls(
+            protocol_version=r.read_int(),
+            last_zxid_seen=r.read_long(),
+            timeout_ms=r.read_int(),
+            session_id=r.read_long(),
+            passwd=r.read_buffer() or b"\x00" * 16,
+        )
+        # The trailing readOnly byte was added in 3.4; tolerate its absence.
+        if r.remaining() >= 1:
+            req.read_only = r.read_bool()
+        return req
+
+
+@dataclass
+class ConnectResponse:
+    protocol_version: int = 0
+    timeout_ms: int = 30000
+    session_id: int = 0
+    passwd: bytes = b"\x00" * 16
+    read_only: bool = False
+
+    def write(self, w: Writer) -> None:
+        w.write_int(self.protocol_version)
+        w.write_int(self.timeout_ms)
+        w.write_long(self.session_id)
+        w.write_buffer(self.passwd)
+        w.write_bool(self.read_only)
+
+    @classmethod
+    def read(cls, r: Reader) -> "ConnectResponse":
+        resp = cls(
+            protocol_version=r.read_int(),
+            timeout_ms=r.read_int(),
+            session_id=r.read_long(),
+            passwd=r.read_buffer() or b"\x00" * 16,
+        )
+        if r.remaining() >= 1:
+            resp.read_only = r.read_bool()
+        return resp
+
+
+@dataclass
+class RequestHeader:
+    xid: int
+    type: int
+
+    def write(self, w: Writer) -> None:
+        w.write_int(self.xid)
+        w.write_int(self.type)
+
+    @classmethod
+    def read(cls, r: Reader) -> "RequestHeader":
+        return cls(xid=r.read_int(), type=r.read_int())
+
+
+@dataclass
+class ReplyHeader:
+    xid: int
+    zxid: int
+    err: int
+
+    def write(self, w: Writer) -> None:
+        w.write_int(self.xid)
+        w.write_long(self.zxid)
+        w.write_int(self.err)
+
+    @classmethod
+    def read(cls, r: Reader) -> "ReplyHeader":
+        return cls(xid=r.read_int(), zxid=r.read_long(), err=r.read_int())
+
+
+@dataclass
+class Stat:
+    czxid: int = 0
+    mzxid: int = 0
+    ctime: int = 0
+    mtime: int = 0
+    version: int = 0
+    cversion: int = 0
+    aversion: int = 0
+    ephemeral_owner: int = 0
+    data_length: int = 0
+    num_children: int = 0
+    pzxid: int = 0
+
+    def write(self, w: Writer) -> None:
+        w.write_long(self.czxid)
+        w.write_long(self.mzxid)
+        w.write_long(self.ctime)
+        w.write_long(self.mtime)
+        w.write_int(self.version)
+        w.write_int(self.cversion)
+        w.write_int(self.aversion)
+        w.write_long(self.ephemeral_owner)
+        w.write_int(self.data_length)
+        w.write_int(self.num_children)
+        w.write_long(self.pzxid)
+
+    @classmethod
+    def read(cls, r: Reader) -> "Stat":
+        return cls(
+            czxid=r.read_long(),
+            mzxid=r.read_long(),
+            ctime=r.read_long(),
+            mtime=r.read_long(),
+            version=r.read_int(),
+            cversion=r.read_int(),
+            aversion=r.read_int(),
+            ephemeral_owner=r.read_long(),
+            data_length=r.read_int(),
+            num_children=r.read_int(),
+            pzxid=r.read_long(),
+        )
+
+
+@dataclass
+class CreateRequest:
+    path: str
+    data: Optional[bytes]
+    acls: List[ACL] = field(default_factory=lambda: list(OPEN_ACL_UNSAFE))
+    flags: int = CreateFlag.PERSISTENT
+
+    def write(self, w: Writer) -> None:
+        w.write_ustring(self.path)
+        w.write_buffer(self.data)
+        w.write_vector(self.acls, lambda ww, a: a.write(ww))
+        w.write_int(self.flags)
+
+    @classmethod
+    def read(cls, r: Reader) -> "CreateRequest":
+        return cls(
+            path=r.read_ustring(),
+            data=r.read_buffer(),
+            acls=r.read_vector(ACL.read) or [],
+            flags=r.read_int(),
+        )
+
+
+@dataclass
+class CreateResponse:
+    path: str
+
+    def write(self, w: Writer) -> None:
+        w.write_ustring(self.path)
+
+    @classmethod
+    def read(cls, r: Reader) -> "CreateResponse":
+        return cls(path=r.read_ustring())
+
+
+@dataclass
+class DeleteRequest:
+    path: str
+    version: int = -1
+
+    def write(self, w: Writer) -> None:
+        w.write_ustring(self.path)
+        w.write_int(self.version)
+
+    @classmethod
+    def read(cls, r: Reader) -> "DeleteRequest":
+        return cls(path=r.read_ustring(), version=r.read_int())
+
+
+@dataclass
+class ExistsRequest:
+    path: str
+    watch: bool = False
+
+    def write(self, w: Writer) -> None:
+        w.write_ustring(self.path)
+        w.write_bool(self.watch)
+
+    @classmethod
+    def read(cls, r: Reader) -> "ExistsRequest":
+        return cls(path=r.read_ustring(), watch=r.read_bool())
+
+
+@dataclass
+class ExistsResponse:
+    stat: Stat
+
+    def write(self, w: Writer) -> None:
+        self.stat.write(w)
+
+    @classmethod
+    def read(cls, r: Reader) -> "ExistsResponse":
+        return cls(stat=Stat.read(r))
+
+
+@dataclass
+class GetDataRequest:
+    path: str
+    watch: bool = False
+
+    def write(self, w: Writer) -> None:
+        w.write_ustring(self.path)
+        w.write_bool(self.watch)
+
+    @classmethod
+    def read(cls, r: Reader) -> "GetDataRequest":
+        return cls(path=r.read_ustring(), watch=r.read_bool())
+
+
+@dataclass
+class GetDataResponse:
+    data: Optional[bytes]
+    stat: Stat
+
+    def write(self, w: Writer) -> None:
+        w.write_buffer(self.data)
+        self.stat.write(w)
+
+    @classmethod
+    def read(cls, r: Reader) -> "GetDataResponse":
+        return cls(data=r.read_buffer(), stat=Stat.read(r))
+
+
+@dataclass
+class SetDataRequest:
+    path: str
+    data: Optional[bytes]
+    version: int = -1
+
+    def write(self, w: Writer) -> None:
+        w.write_ustring(self.path)
+        w.write_buffer(self.data)
+        w.write_int(self.version)
+
+    @classmethod
+    def read(cls, r: Reader) -> "SetDataRequest":
+        return cls(path=r.read_ustring(), data=r.read_buffer(), version=r.read_int())
+
+
+@dataclass
+class SetDataResponse:
+    stat: Stat
+
+    def write(self, w: Writer) -> None:
+        self.stat.write(w)
+
+    @classmethod
+    def read(cls, r: Reader) -> "SetDataResponse":
+        return cls(stat=Stat.read(r))
+
+
+@dataclass
+class GetChildrenRequest:
+    path: str
+    watch: bool = False
+
+    def write(self, w: Writer) -> None:
+        w.write_ustring(self.path)
+        w.write_bool(self.watch)
+
+    @classmethod
+    def read(cls, r: Reader) -> "GetChildrenRequest":
+        return cls(path=r.read_ustring(), watch=r.read_bool())
+
+
+@dataclass
+class GetChildrenResponse:
+    children: List[str]
+
+    def write(self, w: Writer) -> None:
+        w.write_vector(self.children, Writer.write_ustring)
+
+    @classmethod
+    def read(cls, r: Reader) -> "GetChildrenResponse":
+        return cls(children=r.read_vector(Reader.read_ustring) or [])
+
+
+@dataclass
+class GetChildren2Response:
+    children: List[str]
+    stat: Stat
+
+    def write(self, w: Writer) -> None:
+        w.write_vector(self.children, Writer.write_ustring)
+        self.stat.write(w)
+
+    @classmethod
+    def read(cls, r: Reader) -> "GetChildren2Response":
+        return cls(
+            children=r.read_vector(Reader.read_ustring) or [], stat=Stat.read(r)
+        )
+
+
+@dataclass
+class SetWatches:
+    relative_zxid: int
+    data_watches: List[str] = field(default_factory=list)
+    exist_watches: List[str] = field(default_factory=list)
+    child_watches: List[str] = field(default_factory=list)
+
+    def write(self, w: Writer) -> None:
+        w.write_long(self.relative_zxid)
+        w.write_vector(self.data_watches, Writer.write_ustring)
+        w.write_vector(self.exist_watches, Writer.write_ustring)
+        w.write_vector(self.child_watches, Writer.write_ustring)
+
+    @classmethod
+    def read(cls, r: Reader) -> "SetWatches":
+        return cls(
+            relative_zxid=r.read_long(),
+            data_watches=r.read_vector(Reader.read_ustring) or [],
+            exist_watches=r.read_vector(Reader.read_ustring) or [],
+            child_watches=r.read_vector(Reader.read_ustring) or [],
+        )
+
+
+@dataclass
+class WatcherEvent:
+    type: int
+    state: int
+    path: str
+
+    def write(self, w: Writer) -> None:
+        w.write_int(self.type)
+        w.write_int(self.state)
+        w.write_ustring(self.path)
+
+    @classmethod
+    def read(cls, r: Reader) -> "WatcherEvent":
+        return cls(type=r.read_int(), state=r.read_int(), path=r.read_ustring())
+
+
+# --- framing helpers -------------------------------------------------------
+
+def frame(payload: bytes) -> bytes:
+    """Prefix a payload with its 4-byte big-endian length."""
+    return Writer().write_int(len(payload)).to_bytes() + payload
+
+
+def encode_request(xid: int, op: int, body=None) -> bytes:
+    """Encode a framed request: RequestHeader + optional body record."""
+    w = Writer()
+    RequestHeader(xid=xid, type=op).write(w)
+    if body is not None:
+        body.write(w)
+    return frame(w.to_bytes())
+
+
+def encode_reply(xid: int, zxid: int, err: int, body=None) -> bytes:
+    """Encode a framed reply: ReplyHeader + optional body record."""
+    w = Writer()
+    ReplyHeader(xid=xid, zxid=zxid, err=err).write(w)
+    if body is not None and err == Err.OK:
+        body.write(w)
+    return frame(w.to_bytes())
+
+
+class ZKError(Exception):
+    """A ZooKeeper server-reported error, carrying the protocol code.
+
+    ``name`` holds the symbolic code name (e.g. ``"NO_NODE"``); upper layers
+    match on it exactly like the reference matches zkplus error names
+    (reference lib/register.js:88).
+    """
+
+    def __init__(self, code: int, path: Optional[str] = None):
+        self.code = code
+        self.name = ERR_NAMES.get(code, f"ZK_ERROR_{code}")
+        self.path = path
+        super().__init__(f"{self.name} ({code})" + (f": {path}" if path else ""))
+
+
+def check_path(path: str) -> str:
+    """Validate a znode path the way ZooKeeper's PathUtils does."""
+    if not isinstance(path, str) or not path:
+        raise ValueError("path must be a non-empty string")
+    if not path.startswith("/"):
+        raise ValueError(f"path must start with /: {path!r}")
+    if len(path) > 1 and path.endswith("/"):
+        raise ValueError(f"path must not end with /: {path!r}")
+    if "//" in path:
+        raise ValueError(f"empty path component: {path!r}")
+    for comp in path.split("/")[1:]:
+        if comp in (".", ".."):
+            raise ValueError(f"relative path component: {path!r}")
+        if "\x00" in comp:
+            raise ValueError(f"null byte in path component: {path!r}")
+    return path

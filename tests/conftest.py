@@ -36,12 +36,13 @@ def pytest_collection_modifyitems(items):
 
 @pytest.hookimpl(tryfirst=True)
 def pytest_pyfunc_call(pyfuncitem):
-    if inspect.iscoroutinefunction(pyfuncitem.function):
+    func = pyfuncitem.obj  # bound method for class-based tests
+    if inspect.iscoroutinefunction(func):
         kwargs = {
             name: pyfuncitem.funcargs[name]
             for name in pyfuncitem._fixtureinfo.argnames
         }
-        asyncio.run(asyncio.wait_for(pyfuncitem.function(**kwargs), timeout=60))
+        asyncio.run(asyncio.wait_for(func(**kwargs), timeout=60))
         return True
     return None
 

@@ -1,0 +1,347 @@
+"""Integration tests: our ZK client against the in-process ZK server.
+
+Unlike the reference's tests (which need a live ZooKeeper at
+127.0.0.1:2181, reference test/helper.js:57-62), these run hermetically —
+but still over a real TCP socket, exercising framing, jute encoding, xid
+ordering, watches, and session semantics end to end.
+
+Covers the reference's connection tests (reference test/zk.test.js) plus
+the session/ephemeral behavior the reference never tests.
+"""
+
+import asyncio
+
+import pytest
+
+from registrar_tpu.retry import RetryPolicy
+from registrar_tpu.testing.server import ZKServer
+from registrar_tpu.zk.client import (
+    SessionExpiredError,
+    ZKClient,
+    create_zk_client,
+)
+from registrar_tpu.zk.protocol import CreateFlag, Err, ZKError
+
+
+async def _pair(**kw):
+    server = await ZKServer().start()
+    client = await ZKClient([server.address], **kw).connect()
+    return server, client
+
+
+class TestConnect:
+    async def test_connect_and_close(self):
+        server = await ZKServer().start()
+        try:
+            client = await ZKClient([server.address]).connect()
+            assert client.connected
+            assert client.session_id != 0
+            # the patched-on heartbeat surface exists
+            # (reference test/zk.test.js:54-71 asserts the same)
+            assert callable(client.heartbeat)
+            await client.close()
+            assert not client.connected
+        finally:
+            await server.stop()
+
+    async def test_connect_failure_dead_port(self):
+        # reference test/zk.test.js:30-51: point at a dead port, bounded
+        # retry, expect an error.
+        client = ZKClient([("127.0.0.1", 1)], connect_timeout_ms=100)
+        with pytest.raises(Exception):
+            await client.connect()
+
+    async def test_create_zk_client_retries_then_aborts(self):
+        attempts = []
+        task = asyncio.ensure_future(
+            create_zk_client(
+                [("127.0.0.1", 1)],
+                connect_timeout_ms=50,
+                on_attempt=lambda n, d, e: attempts.append(n),
+                retry_policy=RetryPolicy(
+                    max_attempts=float("inf"), initial_delay=0.01, max_delay=0.05
+                ),
+            )
+        )
+        await asyncio.sleep(0.3)
+        assert len(attempts) >= 2  # kept retrying (failAfter(Infinity) analog)
+        task.cancel()  # the retry.stop() analog
+        with pytest.raises(asyncio.CancelledError):
+            await task
+
+    async def test_timeout_negotiation_clamped(self):
+        server = await ZKServer(max_session_timeout_ms=5000).start()
+        try:
+            client = await ZKClient([server.address], timeout_ms=99999).connect()
+            assert client.negotiated_timeout_ms == 5000
+            await client.close()
+        finally:
+            await server.stop()
+
+
+class TestOps:
+    async def test_create_get_stat_roundtrip(self):
+        server, client = await _pair()
+        try:
+            path = await client.create("/a", b"hello")
+            assert path == "/a"
+            data, stat = await client.get("/a")
+            assert data == b"hello"
+            assert stat.ephemeral_owner == 0
+            st = await client.stat("/a")
+            assert st.data_length == 5
+        finally:
+            await client.close()
+            await server.stop()
+
+    async def test_ephemeral_create_sets_owner(self):
+        server, client = await _pair()
+        try:
+            await client.create("/eph", b"x", CreateFlag.EPHEMERAL)
+            st = await client.stat("/eph")
+            assert st.ephemeral_owner == client.session_id
+        finally:
+            await client.close()
+            await server.stop()
+
+    async def test_mkdirp_and_nested_create(self):
+        server, client = await _pair()
+        try:
+            await client.mkdirp("/com/joyent/us-east/moray")
+            await client.create("/com/joyent/us-east/moray/1", b"{}")
+            kids = await client.get_children("/com/joyent/us-east/moray")
+            assert kids == ["1"]
+            # idempotent
+            await client.mkdirp("/com/joyent/us-east/moray")
+        finally:
+            await client.close()
+            await server.stop()
+
+    async def test_ephemeral_plus_creates_missing_parent(self):
+        server, client = await _pair()
+        try:
+            await client.create_ephemeral_plus("/x/y/z", b"d")
+            st = await client.stat("/x/y/z")
+            assert st.ephemeral_owner == client.session_id
+            # parents are persistent
+            assert (await client.stat("/x/y")).ephemeral_owner == 0
+        finally:
+            await client.close()
+            await server.stop()
+
+    async def test_put_creates_then_updates(self):
+        server, client = await _pair()
+        try:
+            await client.put("/svc", b"v1")  # node absent -> created
+            data, _ = await client.get("/svc")
+            assert data == b"v1"
+            await client.put("/svc", b"v2")  # node present -> setData
+            data, stat = await client.get("/svc")
+            assert data == b"v2"
+            assert stat.version == 1
+        finally:
+            await client.close()
+            await server.stop()
+
+    async def test_unlink_and_no_node(self):
+        server, client = await _pair()
+        try:
+            await client.create("/gone", b"")
+            await client.unlink("/gone")
+            with pytest.raises(ZKError) as ei:
+                await client.unlink("/gone")
+            assert ei.value.name == "NO_NODE"  # upper layers match this name
+        finally:
+            await client.close()
+            await server.stop()
+
+    async def test_delete_nonempty_rejected(self):
+        server, client = await _pair()
+        try:
+            await client.mkdirp("/p/c")
+            with pytest.raises(ZKError) as ei:
+                await client.unlink("/p")
+            assert ei.value.code == Err.NOT_EMPTY
+        finally:
+            await client.close()
+            await server.stop()
+
+    async def test_create_under_ephemeral_rejected(self):
+        server, client = await _pair()
+        try:
+            await client.create("/e", b"", CreateFlag.EPHEMERAL)
+            with pytest.raises(ZKError) as ei:
+                await client.create("/e/child", b"")
+            assert ei.value.code == Err.NO_CHILDREN_FOR_EPHEMERALS
+        finally:
+            await client.close()
+            await server.stop()
+
+    async def test_many_parallel_ops_keep_xid_order(self):
+        server, client = await _pair()
+        try:
+            await asyncio.gather(
+                *(client.create(f"/n{i}", str(i).encode()) for i in range(50))
+            )
+            datas = await asyncio.gather(*(client.get(f"/n{i}") for i in range(50)))
+            assert [d for d, _ in datas] == [str(i).encode() for i in range(50)]
+        finally:
+            await client.close()
+            await server.stop()
+
+
+class TestHeartbeat:
+    async def test_heartbeat_ok(self):
+        server, client = await _pair()
+        try:
+            await client.create("/hb1", b"")
+            await client.create("/hb2", b"")
+            await client.heartbeat(["/hb1", "/hb2"])  # should not raise
+        finally:
+            await client.close()
+            await server.stop()
+
+    async def test_heartbeat_fails_after_bounded_retries(self):
+        server, client = await _pair()
+        try:
+            fast = RetryPolicy(max_attempts=3, initial_delay=0.01, max_delay=0.02)
+            with pytest.raises(ZKError) as ei:
+                await client.heartbeat(["/missing"], retry=fast)
+            assert ei.value.name == "NO_NODE"
+        finally:
+            await client.close()
+            await server.stop()
+
+
+class TestSessions:
+    async def test_ephemerals_vanish_on_close(self):
+        server, client = await _pair()
+        try:
+            await client.create("/e1", b"", CreateFlag.EPHEMERAL)
+            assert server.get_node("/e1") is not None
+            await client.close()
+            assert server.get_node("/e1") is None
+        finally:
+            await server.stop()
+
+    async def test_ephemerals_vanish_on_session_expiry(self):
+        server, client = await _pair(timeout_ms=200, reconnect=False)
+        try:
+            await client.create("/e2", b"", CreateFlag.EPHEMERAL)
+            sid = client.session_id
+            # Sever the TCP connection; the expiry countdown starts.
+            await server.drop_connections()
+            await asyncio.sleep(0.6)  # > negotiated timeout
+            assert server.get_node("/e2") is None
+            assert sid not in server.sessions
+        finally:
+            await client.close()
+            await server.stop()
+
+    async def test_reconnect_reattaches_session(self):
+        server, client = await _pair(timeout_ms=5000)
+        try:
+            await client.create("/e3", b"", CreateFlag.EPHEMERAL)
+            sid = client.session_id
+            await server.drop_connections()
+            await client.wait_for("connect", timeout=10)
+            assert client.session_id == sid
+            # ephemeral survived because the session never expired
+            assert (await client.stat("/e3")).ephemeral_owner == sid
+        finally:
+            await client.close()
+            await server.stop()
+
+    async def test_session_expired_emitted_on_stale_reattach(self):
+        server, client = await _pair(timeout_ms=200)
+        try:
+            await client.create("/e4", b"", CreateFlag.EPHEMERAL)
+            expired = asyncio.Event()
+            client.on("session_expired", lambda *a: expired.set())
+            # Force-expire server-side, then let the client try to reattach.
+            await server.expire_session(client.session_id)
+            await asyncio.wait_for(expired.wait(), timeout=10)
+            assert client.closed
+        finally:
+            await server.stop()
+
+    async def test_force_expire_notifies_connected_client(self):
+        server, client = await _pair()
+        try:
+            states = []
+            client.on("state", states.append)
+            await server.expire_session(client.session_id)
+            await asyncio.sleep(0.1)
+            assert "disconnected" in states
+        finally:
+            await client.close()
+            await server.stop()
+
+
+class TestWatches:
+    async def test_data_watch_fires_on_delete(self):
+        server, client = await _pair()
+        try:
+            await client.create("/w", b"v")
+            fired = asyncio.Event()
+            events = []
+            client.watch("/w", lambda ev: (events.append(ev), fired.set()))
+            await client.stat("/w", watch=True)
+            await client.unlink("/w")
+            await asyncio.wait_for(fired.wait(), timeout=5)
+            assert events[0].path == "/w"
+        finally:
+            await client.close()
+            await server.stop()
+
+    async def test_exist_watch_fires_on_create(self):
+        server, client = await _pair()
+        try:
+            fired = asyncio.Event()
+            client.watch("/later", lambda ev: fired.set())
+            with pytest.raises(ZKError):
+                await client.stat("/later", watch=True)  # NO_NODE, watch armed
+            await client.create("/later", b"")
+            await asyncio.wait_for(fired.wait(), timeout=5)
+        finally:
+            await client.close()
+            await server.stop()
+
+    async def test_missed_watch_delivered_after_reconnect(self):
+        # A data watch armed before a disconnect must still deliver the
+        # NodeDeleted that happened during the outage (SetWatches catch-up).
+        server, client = await _pair(timeout_ms=10000)
+        try:
+            await client.create("/missed", b"v")
+            fired = asyncio.Event()
+            client.watch("/missed", lambda ev: fired.set())
+            await client.stat("/missed", watch=True)
+            reconnected = asyncio.Event()
+            client.on("connect", lambda *a: reconnected.set())
+            # Pause automatic reconnection so the deletion reliably happens
+            # while `client` is offline.
+            client.reconnect = False
+            await server.drop_connections()
+            other = await ZKClient([server.address]).connect()
+            await other.unlink("/missed")
+            await other.close()
+            client.reconnect = True
+            await client.connect()
+            await asyncio.wait_for(reconnected.wait(), timeout=10)
+            await asyncio.wait_for(fired.wait(), timeout=5)
+        finally:
+            await client.close()
+            await server.stop()
+
+    async def test_child_watch_fires(self):
+        server, client = await _pair()
+        try:
+            await client.mkdirp("/dir")
+            fired = asyncio.Event()
+            client.watch("/dir", lambda ev: fired.set())
+            await client.get_children("/dir", watch=True)
+            await client.create("/dir/kid", b"")
+            await asyncio.wait_for(fired.wait(), timeout=5)
+        finally:
+            await client.close()
+            await server.stop()
