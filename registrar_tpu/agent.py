@@ -1,0 +1,235 @@
+"""The registrar orchestrator: registration + heartbeat + health checking.
+
+Rebuild of the reference's default export ``register_plus``
+(lib/index.js:33-182).  Ties the three subsystems together and exposes a
+lifecycle event surface:
+
+    register(znodes)           registration (or re-registration) completed
+    unregister(err, znodes)    health check declared down; znodes deleted
+    heartbeat(znodes)          periodic znode liveness probe succeeded
+    heartbeatFailure(err)      probe failed after bounded retries
+    ok()                       health check recovered (was down)
+    fail(err)                  health check crossed the failure threshold
+    error(err)                 unexpected error from any subsystem
+
+Loop behavior matches the reference exactly (BASELINE.md):
+
+  * heartbeat every ``heartbeat_interval`` (default 3 s,
+    lib/index.js:132), re-armed *after* each probe completes (the
+    reference's self-rescheduling setTimeout chain, not a fixed-rate timer);
+  * after a heartbeat failure the loop backs off to
+    ``max(heartbeat_interval, 60 s)`` (lib/index.js:146);
+  * a heartbeat failure does NOT deregister or exit — recovery rides on ZK
+    session expiry + supervisor restart, or a health-check ``ok``
+    re-registration (SURVEY.md §3.2 note);
+  * on health ``fail`` with ``isDown`` the znodes are unregistered; on the
+    next health ``ok`` the full registration pipeline runs again
+    (lib/index.js:59-116).
+
+Fixed here (reference warts that are unobservable in znode state):
+``register_plus`` references an undefined ``cfg`` on initial-registration
+failure (lib/index.js:48) — the error path here just emits ``error``; and
+re-registration is guarded against overlapping ``ok`` events.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from typing import Any, Mapping, Optional
+
+from registrar_tpu import register as register_mod
+from registrar_tpu.events import EventEmitter
+from registrar_tpu.health import HealthCheck, create_health_check
+from registrar_tpu.register import SETTLE_DELAY_S
+from registrar_tpu.zk.client import ZKClient
+
+log = logging.getLogger("registrar_tpu.agent")
+
+#: reference lib/index.js:132
+DEFAULT_HEARTBEAT_INTERVAL_S = 3.0
+#: reference lib/index.js:146 — floor of the post-failure re-arm delay
+HEARTBEAT_FAILURE_BACKOFF_S = 60.0
+
+
+class RegistrarEvents(EventEmitter):
+    """Event surface returned by :func:`register_plus` (the reference's
+    EventEmitter + ``.stop()``, lib/index.js:164-171)."""
+
+    def __init__(self) -> None:
+        super().__init__()
+        self.znodes: list = []
+        self._tasks: list = []
+        self._health: Optional[HealthCheck] = None
+        self._stopped = False
+
+    def stop(self) -> None:
+        """Stop the heartbeat loop and health checker.
+
+        Does NOT delete the znodes — like the reference, a graceful library
+        stop leaves cleanup to ZK session expiry (SURVEY.md §3.4)."""
+        self._stopped = True
+        if self._health is not None:
+            self._health.stop()
+        for task in self._tasks:
+            task.cancel()
+        self._tasks.clear()
+
+    @property
+    def stopped(self) -> bool:
+        return self._stopped
+
+
+def register_plus(
+    zk: ZKClient,
+    registration: Mapping[str, Any],
+    admin_ip: Optional[str] = None,
+    health_check: Optional[Mapping[str, Any]] = None,
+    heartbeat_interval: float = DEFAULT_HEARTBEAT_INTERVAL_S,
+    hostname: Optional[str] = None,
+    settle_delay: float = SETTLE_DELAY_S,
+) -> RegistrarEvents:
+    """Register, then keep the registration alive; returns the event surface.
+
+    Must be called with a running event loop (the daemon's mainline or a
+    test harness).  ``health_check`` is the config's ``healthCheck`` object
+    (seconds-based keys, see :mod:`registrar_tpu.config` for translation).
+    """
+    ee = RegistrarEvents()
+    loop = asyncio.get_running_loop()
+    ee._tasks.append(loop.create_task(_run(ee, zk, registration, admin_ip,
+                                           health_check, heartbeat_interval,
+                                           hostname, settle_delay)))
+    return ee
+
+
+async def _run(
+    ee: RegistrarEvents,
+    zk: ZKClient,
+    registration: Mapping[str, Any],
+    admin_ip: Optional[str],
+    health_check: Optional[Mapping[str, Any]],
+    heartbeat_interval: float,
+    hostname: Optional[str],
+    settle_delay: float,
+) -> None:
+    try:
+        znodes = await register_mod.register(
+            zk, registration, admin_ip=admin_ip, hostname=hostname,
+            settle_delay=settle_delay,
+        )
+    except asyncio.CancelledError:
+        raise
+    except Exception as err:  # noqa: BLE001
+        log.debug("registration failed: %r", err)
+        ee.emit("error", err)
+        return
+
+    ee.znodes = znodes
+    if ee.stopped:
+        return
+
+    loop = asyncio.get_running_loop()
+    ee._tasks.append(loop.create_task(
+        _heartbeat_loop(ee, zk, heartbeat_interval)
+    ))
+    if health_check:
+        _start_health_consumer(
+            ee, zk, registration, admin_ip, hostname, settle_delay, health_check
+        )
+    ee.emit("register", znodes)
+
+
+async def _heartbeat_loop(
+    ee: RegistrarEvents, zk: ZKClient, interval: float
+) -> None:
+    """Hot loop #1 (SURVEY.md §3.2): self-rescheduling znode liveness probe."""
+    while not ee.stopped:
+        try:
+            await zk.heartbeat(ee.znodes)
+        except asyncio.CancelledError:
+            raise
+        except Exception as err:  # noqa: BLE001
+            log.debug("zk.heartbeat(%s) failed: %r", ee.znodes, err)
+            ee.emit("heartbeatFailure", err)
+            await asyncio.sleep(max(interval, HEARTBEAT_FAILURE_BACKOFF_S))
+            continue
+        log.debug("zk.heartbeat(%s): ok", ee.znodes)
+        ee.emit("heartbeat", ee.znodes)
+        await asyncio.sleep(interval)
+
+
+def _start_health_consumer(
+    ee: RegistrarEvents,
+    zk: ZKClient,
+    registration: Mapping[str, Any],
+    admin_ip: Optional[str],
+    hostname: Optional[str],
+    settle_delay: float,
+    health_check: Mapping[str, Any],
+) -> None:
+    """Hot loop #2 (SURVEY.md §3.3): health stream -> deregister/re-register."""
+    check = create_health_check(**health_check)
+    ee._health = check
+    down = False
+    transitioning = False
+
+    async def on_fail(err: Exception) -> None:
+        nonlocal down, transitioning
+        down = True
+        transitioning = True
+        try:
+            log.debug("healthcheck failed, deregistering (znodes=%s)", ee.znodes)
+            ee.emit("fail", err)
+            try:
+                await register_mod.unregister(zk, ee.znodes)
+            except Exception as u_err:  # noqa: BLE001
+                log.debug("healthcheck: unregister failed: %r", u_err)
+                ee.emit("error", u_err)
+            else:
+                ee.emit("unregister", err, ee.znodes)
+        finally:
+            transitioning = False
+
+    async def on_recover() -> None:
+        nonlocal down, transitioning
+        transitioning = True
+        try:
+            ee.emit("ok")
+            try:
+                znodes = await register_mod.register(
+                    zk, registration, admin_ip=admin_ip, hostname=hostname,
+                    settle_delay=settle_delay,
+                )
+            except Exception as r_err:  # noqa: BLE001
+                log.debug("register: reregister failed: %r", r_err)
+                ee.emit("error", r_err)
+            else:
+                ee.znodes = znodes
+                down = False
+                ee.emit("register", znodes)
+        finally:
+            transitioning = False
+
+    def on_data(record: Mapping[str, Any]) -> None:
+        if ee.stopped or transitioning:
+            # Mirrors the reference's implicit single-flight behavior: its
+            # `down` flag only flips after the async transition completes.
+            return
+        rtype = record.get("type")
+        if rtype == "ok":
+            if down:
+                ee._tasks.append(
+                    asyncio.get_running_loop().create_task(on_recover())
+                )
+        elif rtype == "fail":
+            if record.get("err") is not None and record.get("isDown") and not down:
+                ee._tasks.append(
+                    asyncio.get_running_loop().create_task(on_fail(record["err"]))
+                )
+        else:
+            ee.emit("error", ValueError(f"unknown check type: {rtype!r}"))
+
+    check.on("data", on_data)
+    check.on("error", lambda err: ee.emit("error", err))
+    check.start()

@@ -1,0 +1,269 @@
+"""Periodic command-based health checking.
+
+Rebuild of reference lib/health.js:22-148: every ``interval`` seconds run a
+shell command with a ``timeout`` (SIGTERM on expiry, 1 MiB output cap); a
+check fails on non-zero exit (unless ``ignore_exit_status``) or when stdout
+fails an optional regex match.  Failures accumulate; at ``threshold``
+failures within the sliding ``period`` window the service is declared down.
+
+Event surface (mirrors the reference's object-mode stream records,
+lib/health.js:77-84,117-120): listeners on ``data`` receive dicts::
+
+    {"type": "ok",   "command": ...}
+    {"type": "fail", "command": ..., "err": <Exception>, "failures": <int>,
+     "isDown": <bool>, "threshold": <int>}
+
+plus ``end`` when stopped.  Defaults are the reference's exactly
+(BASELINE.md): interval 60 s, exec timeout 1 s, threshold 5, period 300 s.
+
+Deliberate fixes over the reference (its window logic is acknowledged
+broken — reference README.md:99-102, HEAD-2282/HEAD-2283; SURVEY.md §7):
+
+  * the failure window really slides: failures older than ``period`` are
+    pruned on every check, instead of one timer wiping the list at odd
+    times (reference lib/health.js:60-64,130);
+  * a successful check while down clears the down state and the window, so
+    one later blip cannot instantly re-trigger isDown (the reference's
+    ``down`` latch never resets, lib/health.js:66-68);
+  * ``stdout_match.invert`` is implemented (the reference validates it at
+    lib/health.js:32-33 but never applies it).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import re
+import time
+from typing import Any, Dict, List, Mapping, Optional
+
+from registrar_tpu.events import EventEmitter
+
+log = logging.getLogger("registrar_tpu.health")
+
+#: Reference defaults, lib/health.js:43,51,56,58.
+DEFAULT_INTERVAL_S = 60.0
+DEFAULT_TIMEOUT_S = 1.0
+DEFAULT_THRESHOLD = 5
+DEFAULT_PERIOD_S = 300.0
+MAX_OUTPUT_BYTES = 1024 * 1024  # reference lib/health.js:50 maxBuffer
+
+
+class HealthCheckError(Exception):
+    """A single failed check (non-zero exit, timeout, or stdout mismatch)."""
+
+    def __init__(self, message: str, code: Optional[int] = None):
+        super().__init__(message)
+        self.code = code
+
+
+class DownError(Exception):
+    """Threshold failures within the window — the MultiError analog
+    (reference lib/health.js:73)."""
+
+    def __init__(self, errors: List[Exception]):
+        self.errors = list(errors)
+        super().__init__(
+            f"{len(self.errors)} consecutive health check failures: "
+            + "; ".join(str(e) for e in self.errors)
+        )
+
+
+def _compile_stdout_match(stdout_match: Optional[Mapping[str, Any]]):
+    """Compile the reference's ``stdoutMatch{pattern,flags,invert}`` config
+    (JS RegExp flags mapped to Python re flags)."""
+    if not stdout_match or not stdout_match.get("pattern"):
+        return None, False
+    flags = 0
+    for ch in stdout_match.get("flags") or "":
+        if ch == "i":
+            flags |= re.IGNORECASE
+        elif ch == "m":
+            flags |= re.MULTILINE
+        elif ch == "s":
+            flags |= re.DOTALL
+        elif ch in ("g", "u", "y"):
+            pass  # stateful/unicode JS flags: no Python equivalent needed
+        else:
+            raise ValueError(f"unsupported stdoutMatch flag: {ch!r}")
+    return re.compile(stdout_match["pattern"], flags), bool(
+        stdout_match.get("invert")
+    )
+
+
+class HealthCheck(EventEmitter):
+    """Periodic checker; see module docstring for the event surface."""
+
+    def __init__(
+        self,
+        command: str,
+        interval: float = DEFAULT_INTERVAL_S,
+        timeout: float = DEFAULT_TIMEOUT_S,
+        threshold: int = DEFAULT_THRESHOLD,
+        period: float = DEFAULT_PERIOD_S,
+        ignore_exit_status: bool = False,
+        stdout_match: Optional[Mapping[str, Any]] = None,
+    ):
+        super().__init__()
+        if not isinstance(command, str) or not command:
+            raise ValueError("command must be a non-empty string")
+        for name, val in (
+            ("interval", interval), ("timeout", timeout), ("period", period),
+        ):
+            if not isinstance(val, (int, float)) or val <= 0:
+                raise ValueError(f"{name} must be a positive number")
+        if not isinstance(threshold, int) or threshold < 1:
+            raise ValueError("threshold must be a positive integer")
+        self.command = command
+        self.interval = float(interval)
+        self.timeout = float(timeout)
+        self.threshold = threshold
+        self.period = float(period)
+        self.ignore_exit_status = bool(ignore_exit_status)
+        self._regex, self._invert = _compile_stdout_match(stdout_match)
+
+        self._fails: List[tuple] = []  # (monotonic_ts, HealthCheckError)
+        self._down = False
+        self._task: Optional[asyncio.Task] = None
+        self._running = False
+
+    @property
+    def is_down(self) -> bool:
+        return self._down
+
+    @property
+    def running(self) -> bool:
+        return self._running
+
+    def start(self) -> "HealthCheck":
+        if not self._running:
+            self._running = True
+            self._task = asyncio.get_running_loop().create_task(self._loop())
+        return self
+
+    def stop(self) -> None:
+        self._running = False
+        if self._task is not None:
+            self._task.cancel()
+            self._task = None
+        self.emit("end")
+
+    async def _loop(self) -> None:
+        try:
+            while self._running:
+                await self.check_once()
+                if not self._running:
+                    return
+                await asyncio.sleep(self.interval)
+        except asyncio.CancelledError:
+            raise
+        except Exception as err:  # noqa: BLE001
+            log.exception("health check loop crashed")
+            self.emit("error", err)
+
+    async def check_once(self) -> Dict[str, Any]:
+        """Run one check and emit its ``data`` record (also returned)."""
+        err = await self._run_command()
+        if err is None:
+            record = self._mark_ok()
+        else:
+            record = self._mark_down(err)
+        self.emit("data", record)
+        return record
+
+    async def _run_command(self) -> Optional[HealthCheckError]:
+        log.debug("check: running %s", self.command)
+        try:
+            proc = await asyncio.create_subprocess_shell(
+                self.command,
+                stdout=asyncio.subprocess.PIPE,
+                stderr=asyncio.subprocess.PIPE,
+            )
+        except OSError as e:
+            return HealthCheckError(f"{self.command} failed to spawn: {e}")
+        try:
+            stdout, _stderr = await asyncio.wait_for(
+                proc.communicate(), timeout=self.timeout
+            )
+        except asyncio.CancelledError:
+            # stop() mid-check: don't orphan the child process.
+            proc.kill()
+            await proc.wait()
+            raise
+        except asyncio.TimeoutError:
+            # SIGTERM, matching the reference's killSignal
+            # (lib/health.js:48); escalate if it lingers.  communicate()
+            # (not wait()) so the pipe transports are drained and closed.
+            proc.terminate()
+            try:
+                await asyncio.wait_for(proc.communicate(), timeout=1.0)
+            except asyncio.TimeoutError:
+                proc.kill()
+                await proc.communicate()
+            return HealthCheckError(
+                f"{self.command} timed out after {self.timeout}s"
+            )
+
+        if len(stdout) > MAX_OUTPUT_BYTES:
+            return HealthCheckError(f"{self.command} exceeded output limit")
+        if proc.returncode != 0 and not self.ignore_exit_status:
+            return HealthCheckError(
+                f"{self.command} exited {proc.returncode}", code=proc.returncode
+            )
+        if self._regex is not None:
+            text = stdout.decode("utf-8", errors="replace")
+            matched = self._regex.search(text) is not None
+            if matched == self._invert:  # invert=False: fail when no match
+                return HealthCheckError(
+                    f"stdout match ({self._regex.pattern}) failed", code=-1
+                )
+        return None
+
+    def _mark_ok(self) -> Dict[str, Any]:
+        log.debug("healthCheck: %s ok", self.command)
+        if self._down or self._fails:
+            # Recovery clears the window (fix over the reference's
+            # never-resetting down latch, see module docstring).
+            self._down = False
+            self._fails.clear()
+        return {"type": "ok", "command": self.command}
+
+    def _mark_down(self, err: HealthCheckError) -> Dict[str, Any]:
+        log.debug("check: %s failed: %s", self.command, err)
+        now = time.monotonic()
+        cutoff = now - self.period
+        self._fails = [(ts, e) for ts, e in self._fails if ts >= cutoff]
+        self._fails.append((now, err))
+        out_err: Exception = err
+        if not self._down and len(self._fails) >= self.threshold:
+            self._down = True
+            out_err = DownError([e for _, e in self._fails])
+        return {
+            "type": "fail",
+            "command": self.command,
+            "err": out_err,
+            "failures": len(self._fails),
+            "isDown": self._down,
+            "threshold": self.threshold,
+        }
+
+
+def create_health_check(
+    command: Optional[str] = None, **options: Any
+) -> HealthCheck:
+    """Factory mirroring the reference's createHealthCheck(options)
+    (lib/health.js:22).  Accepts either snake_case kwargs or a config-shaped
+    mapping with the reference's camelCase keys::
+
+        create_health_check(command="...", interval=5, threshold=3)
+        create_health_check(**{"command": "...", "ignoreExitStatus": True,
+                               "stdoutMatch": {"pattern": "ok"}})
+    """
+    rename = {
+        "ignoreExitStatus": "ignore_exit_status",
+        "stdoutMatch": "stdout_match",
+    }
+    kwargs = {rename.get(k, k): v for k, v in options.items()}
+    # The reference's interval/timeout/period are milliseconds; the Python
+    # surface is seconds.  Config-file translation happens in config.py.
+    return HealthCheck(command=command, **kwargs)

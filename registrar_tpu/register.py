@@ -1,0 +1,174 @@
+"""Registration engine: writes this host's service-discovery records.
+
+The rebuild of reference lib/register.js:174-304.  ``register`` runs the
+same observable five-stage pipeline against ZooKeeper:
+
+  1. cleanup previous entries — parallel unlink of every target znode,
+     ignoring NO_NODE (reference lib/register.js:78-105);
+  2. settle delay — fixed 1 s pause "to be nice to watchers"
+     (reference lib/register.js:232-235; configurable here, same default);
+  3. setup directories — parallel mkdirp of each znode's parent
+     (reference lib/register.js:108-129);
+  4. register entries — parallel ephemeral-plus create of the host record
+     at each znode (reference lib/register.js:132-171);
+  5. register service — when a service is configured, a *persistent* put of
+     the service record at the domain node itself, which is then appended
+     to the owned-node list (reference lib/register.js:45-75).
+
+``unregister`` deletes the znodes sequentially (reference
+lib/register.js:254-295).  Two reference bugs are fixed here without
+changing znode state (SURVEY.md §7 "faithful-vs-fixed"):
+
+  * reference unregister invokes the *outer* callback after the first
+    successful unlink (`cb()` instead of `_cb()`, lib/register.js:281), so
+    callers observed completion while later deletes were still in flight —
+    here completion means every node was processed;
+  * the reference re-validates + mutates the caller's service config in
+    place; here record construction is side-effect-free.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import socket
+from typing import Any, List, Mapping, Optional, Sequence
+
+from registrar_tpu.records import (
+    default_address,
+    domain_to_path,
+    host_record,
+    payload_bytes,
+    service_record,
+)
+from registrar_tpu.zk.client import ZKClient
+from registrar_tpu.zk.protocol import Err, ZKError
+
+log = logging.getLogger("registrar_tpu.register")
+
+#: Stage-2 pause before re-creating nodes, reference lib/register.js:232-235.
+SETTLE_DELAY_S = 1.0
+
+
+def _validate_registration(registration: Mapping[str, Any]) -> None:
+    """Schema check mirroring the reference's assert-plus block
+    (lib/register.js:174-201)."""
+    if not isinstance(registration, Mapping):
+        raise ValueError("registration must be an object")
+    if not isinstance(registration.get("domain"), str) or not registration["domain"]:
+        raise ValueError("registration.domain must be a non-empty string")
+    if not isinstance(registration.get("type"), str) or not registration["type"]:
+        raise ValueError("registration.type must be a non-empty string")
+    ttl = registration.get("ttl")
+    if ttl is not None and (not isinstance(ttl, int) or isinstance(ttl, bool)):
+        raise ValueError("registration.ttl must be an integer")
+    ports = registration.get("ports")
+    if ports is not None:
+        if not isinstance(ports, Sequence) or isinstance(ports, (str, bytes)):
+            raise ValueError("registration.ports must be an array of integers")
+        for p in ports:
+            if not isinstance(p, int) or isinstance(p, bool):
+                raise ValueError("registration.ports must be an array of integers")
+    aliases = registration.get("aliases")
+    if aliases is not None:
+        if not isinstance(aliases, Sequence) or isinstance(aliases, (str, bytes)):
+            raise ValueError("registration.aliases must be an array of strings")
+        for a in aliases:
+            if not isinstance(a, str):
+                raise ValueError("registration.aliases must be an array of strings")
+
+
+def znode_paths(
+    registration: Mapping[str, Any], hostname: Optional[str] = None
+) -> List[str]:
+    """The znodes a registration owns: ``$path/$(hostname)`` plus one per
+    alias (aliases are full DNS names, each mapped through domain_to_path;
+    reference lib/register.js:217-227)."""
+    path = domain_to_path(registration["domain"])
+    hostname = hostname or socket.gethostname()
+    nodes = [f"{path}/{hostname}" if path != "/" else f"/{hostname}"]
+    nodes.extend(domain_to_path(a) for a in registration.get("aliases") or [])
+    return nodes
+
+
+async def register(
+    zk: ZKClient,
+    registration: Mapping[str, Any],
+    admin_ip: Optional[str] = None,
+    hostname: Optional[str] = None,
+    settle_delay: float = SETTLE_DELAY_S,
+) -> List[str]:
+    """Run the five-stage registration pipeline; returns the owned znodes.
+
+    ``registration`` is the config's ``registration`` object (domain, type,
+    aliases?, ttl?, ports?, service?).  ``admin_ip`` overrides the
+    interface-probe address (reference lib/register.js:141,148 uses
+    opts.adminIp the same way).
+    """
+    _validate_registration(registration)
+    service = registration.get("service")
+    service_payload = (
+        payload_bytes(service_record(service)) if service is not None else None
+    )
+
+    path = domain_to_path(registration["domain"])
+    nodes = znode_paths(registration, hostname)
+    address = admin_ip if admin_ip else default_address()
+
+    ports = registration.get("ports")
+    if ports is None and service is not None:
+        ports = [service["service"]["port"]]
+    record = host_record(
+        registration["type"], address, ttl=registration.get("ttl"), ports=ports
+    )
+    record_payload = payload_bytes(record)
+
+    log.debug("register: entered (domain=%s nodes=%s)", registration["domain"], nodes)
+
+    # Stage 1: cleanup previous entries (parallel, NO_NODE ignored).
+    async def _cleanup(node: str) -> None:
+        try:
+            await zk.unlink(node)
+        except ZKError as err:
+            if err.code != Err.NO_NODE:
+                raise
+
+    await asyncio.gather(*(_cleanup(n) for n in nodes))
+
+    # Stage 2: be nice to watchers and wait for them to catch up.
+    if settle_delay > 0:
+        await asyncio.sleep(settle_delay)
+
+    # Stage 3: parent directories (parallel mkdirp).
+    parents = {n.rsplit("/", 1)[0] or "/" for n in nodes}
+    await asyncio.gather(*(zk.mkdirp(p) for p in parents if p != "/"))
+
+    # Stage 4: ephemeral host records (parallel).
+    await asyncio.gather(
+        *(zk.create_ephemeral_plus(n, record_payload) for n in nodes)
+    )
+
+    # Stage 5: persistent service record at the domain node.
+    if service_payload is not None:
+        await zk.put(path, service_payload)
+        if path not in nodes:
+            nodes.append(path)
+
+    log.debug("register: done (znodes=%s)", nodes)
+    return nodes
+
+
+async def unregister(zk: ZKClient, znodes: Sequence[str]) -> None:
+    """Delete the owned znodes, sequentially (reference lib/register.js:254-295).
+
+    Every node is processed before this returns (the reference fires its
+    callback after the first delete — fixed, see module docstring).  The
+    first error aborts the walk and propagates, matching the reference's
+    forEachPipeline semantics.
+    """
+    if not isinstance(znodes, Sequence) or isinstance(znodes, (str, bytes)):
+        raise ValueError("znodes must be a sequence of paths")
+    for node in znodes:
+        log.debug("unregister: deleting %s", node)
+        await zk.unlink(node)
+    log.debug("unregister: done")

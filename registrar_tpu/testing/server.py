@@ -605,11 +605,7 @@ class ZKServer:
             return self._reply(hdr.xid, Err.BAD_ARGUMENTS)
 
     def _reply(self, xid: int, err: int, body=None) -> bytes:
-        w = Writer()
-        proto.ReplyHeader(xid=xid, zxid=self.zxid, err=err).write(w)
-        if body is not None and err == Err.OK:
-            body.write(w)
-        return w.to_bytes()
+        return proto.encode_reply_payload(xid, self.zxid, err, body)
 
 
 async def _amain(argv=None) -> None:

@@ -319,10 +319,16 @@ class ZKClient(EventEmitter):
             return
         expected_xid, fut = self._pending.popleft()
         if expected_xid != reply.xid:
-            log.error("xid mismatch: expected %d got %d", expected_xid, reply.xid)
+            # FIFO pairing is broken: the connection is permanently
+            # desynchronized.  Raise so _read_loop tears it down and the
+            # reconnect machinery takes over (a fresh connection resets the
+            # xid stream); limping on would turn every later op into a
+            # mismatched zombie reply.
             if not fut.done():
                 fut.set_exception(ZKError(Err.CONNECTION_LOSS))
-            return
+            raise ConnectionError(
+                f"xid mismatch: expected {expected_xid} got {reply.xid}"
+            )
         if fut.done():
             return
         if reply.err != Err.OK:
@@ -330,12 +336,22 @@ class ZKClient(EventEmitter):
         else:
             fut.set_result(r)
 
+    #: which client-side watch registrations each event type consumes
+    #: (matching real ZK: data/exist watches fire on created/deleted/
+    #: dataChanged; child watches fire on childrenChanged and deleted).
+    _EVENT_CLEARS = {
+        proto.EventType.NODE_CREATED: ("data", "exist"),
+        proto.EventType.NODE_DATA_CHANGED: ("data", "exist"),
+        proto.EventType.NODE_DELETED: ("data", "exist", "child"),
+        proto.EventType.NODE_CHILDREN_CHANGED: ("child",),
+    }
+
     def _on_watch_event(self, event: proto.WatcherEvent) -> None:
         if event.type == proto.EventType.NONE:
             # Server-side session event (e.g. expiry notification).
             return
-        for kind in self._watch_paths.values():
-            kind.discard(event.path)
+        for kind in self._EVENT_CLEARS.get(event.type, ()):
+            self._watch_paths[kind].discard(event.path)
         self.emit("watch", event)
         self._watch_emitter.emit(event.path, event)
 

@@ -507,13 +507,18 @@ def encode_request(xid: int, op: int, body=None) -> bytes:
     return frame(w.to_bytes())
 
 
-def encode_reply(xid: int, zxid: int, err: int, body=None) -> bytes:
-    """Encode a framed reply: ReplyHeader + optional body record."""
+def encode_reply_payload(xid: int, zxid: int, err: int, body=None) -> bytes:
+    """Encode an unframed reply: ReplyHeader + body (body suppressed on error)."""
     w = Writer()
     ReplyHeader(xid=xid, zxid=zxid, err=err).write(w)
     if body is not None and err == Err.OK:
         body.write(w)
-    return frame(w.to_bytes())
+    return w.to_bytes()
+
+
+def encode_reply(xid: int, zxid: int, err: int, body=None) -> bytes:
+    """Encode a framed reply: ReplyHeader + optional body record."""
+    return frame(encode_reply_payload(xid, zxid, err, body))
 
 
 class ZKError(Exception):

@@ -42,7 +42,13 @@ def pytest_pyfunc_call(pyfuncitem):
             name: pyfuncitem.funcargs[name]
             for name in pyfuncitem._fixtureinfo.argnames
         }
-        asyncio.run(asyncio.wait_for(func(**kwargs), timeout=60))
+        async def _run():
+            await asyncio.wait_for(func(**kwargs), timeout=60)
+            # One extra tick so subprocess/socket transports finish closing
+            # before asyncio.run tears the loop down (avoids GC warnings).
+            await asyncio.sleep(0.01)
+
+        asyncio.run(_run())
         return True
     return None
 

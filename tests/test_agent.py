@@ -1,0 +1,170 @@
+"""Orchestrator (register_plus) integration tests.
+
+Rebuild + extension of the reference's register_plus smoke test
+(reference test/register.test.js:189-214), plus the failure paths the
+reference left untested (its `cfg` bug at lib/index.js:48 proves the
+initial-registration-failure path never ran; SURVEY.md §4).
+"""
+
+import asyncio
+
+import pytest
+
+from registrar_tpu.agent import (
+    DEFAULT_HEARTBEAT_INTERVAL_S,
+    HEARTBEAT_FAILURE_BACKOFF_S,
+    register_plus,
+)
+from registrar_tpu.records import parse_payload
+from registrar_tpu.testing.server import ZKServer
+from registrar_tpu.zk.client import ZKClient
+
+DOMAIN = "agent.test.registrar"
+PATH = "/registrar/test/agent"
+REGISTRATION = {"domain": DOMAIN, "type": "load_balancer"}
+
+
+async def _pair():
+    server = await ZKServer().start()
+    client = await ZKClient([server.address]).connect()
+    return server, client
+
+
+def _plus(client, **kw):
+    kw.setdefault("settle_delay", 0.01)
+    kw.setdefault("hostname", "agenthost")
+    kw.setdefault("admin_ip", "10.7.7.7")
+    return register_plus(client, kw.pop("registration", REGISTRATION), **kw)
+
+
+class TestTimingDefaults:
+    def test_reference_constants(self):
+        # BASELINE.md: 3s heartbeat, 60s post-failure backoff
+        assert DEFAULT_HEARTBEAT_INTERVAL_S == 3.0
+        assert HEARTBEAT_FAILURE_BACKOFF_S == 60.0
+
+
+class TestLifecycle:
+    async def test_register_event_and_znodes(self):
+        # reference test/register.test.js:189-214
+        server, client = await _pair()
+        try:
+            ee = _plus(client)
+            (znodes,) = await ee.wait_for("register", timeout=10)
+            assert znodes == [f"{PATH}/agenthost"]
+            data, st = await client.get(znodes[0])
+            assert st.ephemeral_owner == client.session_id
+            assert parse_payload(data)["type"] == "load_balancer"
+            ee.stop()
+        finally:
+            await client.close()
+            await server.stop()
+
+    async def test_heartbeat_events_flow(self):
+        server, client = await _pair()
+        try:
+            ee = _plus(client, heartbeat_interval=0.05)
+            await ee.wait_for("register", timeout=10)
+            (nodes1,) = await ee.wait_for("heartbeat", timeout=10)
+            (nodes2,) = await ee.wait_for("heartbeat", timeout=10)
+            assert nodes1 == nodes2 == ee.znodes
+            ee.stop()
+        finally:
+            await client.close()
+            await server.stop()
+
+    async def test_initial_registration_failure_emits_error(self):
+        # the path the reference's cfg bug (lib/index.js:48) would crash
+        server, client = await _pair()
+        try:
+            ee = _plus(client, registration={"domain": DOMAIN, "type": ""})
+            (err,) = await ee.wait_for("error", timeout=10)
+            assert isinstance(err, ValueError)
+        finally:
+            await client.close()
+            await server.stop()
+
+    async def test_stop_halts_loops(self):
+        server, client = await _pair()
+        try:
+            ee = _plus(client, heartbeat_interval=0.05)
+            await ee.wait_for("register", timeout=10)
+            ee.stop()
+            beats = []
+            ee.on("heartbeat", beats.append)
+            await asyncio.sleep(0.2)
+            assert beats == []
+            # stop() does NOT delete znodes (left to session expiry)
+            assert await client.exists(f"{PATH}/agenthost") is not None
+        finally:
+            await client.close()
+            await server.stop()
+
+
+class TestHealthIntegration:
+    async def test_fail_deregisters_then_ok_reregisters(self):
+        # SURVEY.md §3.3 end to end, with a command whose behavior we flip
+        # via the filesystem (the reference flips /usr/bin/true|false).
+        server, client = await _pair()
+        try:
+            import tempfile, os
+            flag = tempfile.NamedTemporaryFile(delete=False)
+            flag.close()
+            cmd = f"test -f {flag.name}"
+
+            ee = _plus(
+                client,
+                health_check={
+                    "command": cmd,
+                    "interval": 0.03,
+                    "timeout": 1.0,
+                    "threshold": 2,
+                },
+            )
+            (znodes,) = await ee.wait_for("register", timeout=10)
+
+            events = []
+            for name in ("fail", "unregister", "ok", "register"):
+                ee.on(name, lambda *a, _n=name: events.append(_n))
+
+            unregistered = asyncio.Event()
+            ee.on("unregister", lambda *a: unregistered.set())
+            os.unlink(flag.name)  # start failing
+            await asyncio.wait_for(unregistered.wait(), timeout=10)
+            assert await client.exists(znodes[0]) is None  # really deleted
+
+            reregistered = asyncio.Event()
+            ee.on("register", lambda *a: reregistered.set())
+            open(flag.name, "w").close()  # recover
+            await asyncio.wait_for(reregistered.wait(), timeout=10)
+            assert await client.exists(znodes[0]) is not None
+
+            assert events[:4] == ["fail", "unregister", "ok", "register"]
+            ee.stop()
+            os.unlink(flag.name)
+        finally:
+            await client.close()
+            await server.stop()
+
+    async def test_flapping_does_not_double_register(self):
+        server, client = await _pair()
+        try:
+            ee = _plus(
+                client,
+                health_check={
+                    "command": "false",
+                    "interval": 0.02,
+                    "threshold": 1,
+                },
+            )
+            await ee.wait_for("register", timeout=10)
+            await ee.wait_for("unregister", timeout=10)
+            # health keeps failing; no further unregister/fail spam
+            fails = []
+            ee.on("fail", fails.append)
+            await asyncio.sleep(0.15)
+            assert len(fails) == 0
+            ee.stop()
+        finally:
+            await client.close()
+            await server.stop()

@@ -1,0 +1,194 @@
+"""Health checker unit tests.
+
+Rebuild of reference test/health.test.js (the reference's only hermetic
+tests) — same real-shell-command strategy: ``true``, ``false``, ``sleep``,
+``echo``.  Adds coverage for the behaviors the reference never tested
+(SURVEY.md §4): stdoutMatch.invert, window expiry, recovery clearing the
+down state.
+"""
+
+import asyncio
+
+import pytest
+
+from registrar_tpu.health import (
+    DEFAULT_INTERVAL_S,
+    DEFAULT_PERIOD_S,
+    DEFAULT_THRESHOLD,
+    DEFAULT_TIMEOUT_S,
+    DownError,
+    HealthCheck,
+    HealthCheckError,
+    create_health_check,
+)
+
+
+class TestDefaults:
+    def test_reference_timing_constants(self):
+        # BASELINE.md: 60s interval, 1s timeout, threshold 5, 300s window
+        assert DEFAULT_INTERVAL_S == 60.0
+        assert DEFAULT_TIMEOUT_S == 1.0
+        assert DEFAULT_THRESHOLD == 5
+        assert DEFAULT_PERIOD_S == 300.0
+        hc = HealthCheck(command="true")
+        assert (hc.interval, hc.timeout, hc.threshold, hc.period) == (
+            60.0, 1.0, 5, 300.0,
+        )
+
+    def test_camelcase_config_keys(self):
+        hc = create_health_check(
+            **{
+                "command": "true",
+                "ignoreExitStatus": True,
+                "stdoutMatch": {"pattern": "x", "invert": True},
+            }
+        )
+        assert hc.ignore_exit_status is True
+        assert hc._invert is True
+
+    @pytest.mark.parametrize(
+        "bad",
+        [
+            {"command": ""},
+            {"command": "true", "interval": 0},
+            {"command": "true", "threshold": 0},
+            {"command": "true", "timeout": -1},
+            {"command": "true", "stdout_match": {"pattern": "x", "flags": "q"}},
+        ],
+    )
+    def test_validation(self, bad):
+        with pytest.raises(ValueError):
+            create_health_check(**bad)
+
+
+class TestSingleChecks:
+    async def test_ok(self):
+        # reference test/health.test.js:29-52
+        hc = HealthCheck(command="true")
+        rec = await hc.check_once()
+        assert rec == {"type": "ok", "command": "true"}
+
+    async def test_exit_failure(self):
+        # reference test/health.test.js:83-112
+        hc = HealthCheck(command="false")
+        rec = await hc.check_once()
+        assert rec["type"] == "fail"
+        assert rec["failures"] == 1
+        assert rec["isDown"] is False
+        assert rec["threshold"] == 5
+        assert isinstance(rec["err"], HealthCheckError)
+        assert rec["err"].code == 1
+
+    async def test_ignore_exit_status(self):
+        # reference test/health.test.js:56-80
+        hc = HealthCheck(command="false", ignore_exit_status=True)
+        rec = await hc.check_once()
+        assert rec["type"] == "ok"
+
+    async def test_timeout_kills_command(self):
+        # reference test/health.test.js:115-145 (sleep 2 vs 1s timeout)
+        hc = HealthCheck(command="sleep 2", timeout=0.2)
+        rec = await hc.check_once()
+        assert rec["type"] == "fail"
+        assert "timed out" in str(rec["err"])
+
+    async def test_stdout_match_ok(self):
+        hc = HealthCheck(
+            command="echo hello", stdout_match={"pattern": "^hel", "flags": "m"}
+        )
+        rec = await hc.check_once()
+        assert rec["type"] == "ok"
+
+    async def test_stdout_match_failure(self):
+        # reference test/health.test.js:148-180
+        hc = HealthCheck(command="echo nope", stdout_match={"pattern": "hello"})
+        rec = await hc.check_once()
+        assert rec["type"] == "fail"
+        assert rec["err"].code == -1
+
+    async def test_stdout_match_invert(self):
+        # invert is validated but unimplemented in the reference
+        # (lib/health.js:32-33) — implemented here
+        hc = HealthCheck(
+            command="echo ERROR: kaboom",
+            stdout_match={"pattern": "ERROR", "invert": True},
+        )
+        rec = await hc.check_once()
+        assert rec["type"] == "fail"
+
+        hc2 = HealthCheck(
+            command="echo all fine",
+            stdout_match={"pattern": "ERROR", "invert": True},
+        )
+        assert (await hc2.check_once())["type"] == "ok"
+
+    async def test_case_insensitive_flag(self):
+        hc = HealthCheck(
+            command="echo HELLO", stdout_match={"pattern": "hello", "flags": "i"}
+        )
+        assert (await hc.check_once())["type"] == "ok"
+
+    async def test_unspawnable_command_is_failure(self):
+        hc = HealthCheck(command="/nonexistent/binary/xyz")
+        rec = await hc.check_once()
+        assert rec["type"] == "fail"
+
+
+class TestThreshold:
+    async def test_threshold_crossing_sets_down(self):
+        # reference test/health.test.js:183-225 (interval 5ms, threshold 3)
+        hc = HealthCheck(command="false", threshold=3)
+        records = [await hc.check_once() for _ in range(4)]
+        assert [r["isDown"] for r in records] == [False, False, True, True]
+        crossing = records[2]
+        assert isinstance(crossing["err"], DownError)
+        assert len(crossing["err"].errors) == 3
+        assert hc.is_down
+
+    async def test_window_expiry_prunes_old_failures(self):
+        # failures separated by more than `period` never accumulate
+        hc = HealthCheck(command="false", threshold=2, period=0.05)
+        r1 = await hc.check_once()
+        await asyncio.sleep(0.08)
+        r2 = await hc.check_once()
+        assert r1["failures"] == 1
+        assert r2["failures"] == 1  # the first aged out of the window
+        assert not hc.is_down
+
+    async def test_recovery_clears_down_and_window(self):
+        # fix over the reference: ok while down resets everything
+        hc = HealthCheck(command="false", threshold=2)
+        await hc.check_once()
+        await hc.check_once()
+        assert hc.is_down
+        hc.command = "true"
+        assert (await hc.check_once())["type"] == "ok"
+        assert not hc.is_down
+        hc.command = "false"
+        rec = await hc.check_once()
+        assert rec["failures"] == 1  # fresh window, not instant re-down
+        assert rec["isDown"] is False
+
+
+class TestLoop:
+    async def test_start_stop_stream(self):
+        hc = HealthCheck(command="true", interval=0.02)
+        seen = []
+        ended = asyncio.Event()
+        hc.on("data", seen.append)
+        hc.on("end", lambda *a: ended.set())
+        hc.start()
+        await asyncio.sleep(0.08)
+        hc.stop()
+        await asyncio.wait_for(ended.wait(), 1)
+        assert len(seen) >= 2
+        assert all(r["type"] == "ok" for r in seen)
+        assert not hc.running
+
+    async def test_start_idempotent(self):
+        hc = HealthCheck(command="true", interval=0.02)
+        hc.start()
+        task = hc._task
+        hc.start()
+        assert hc._task is task
+        hc.stop()

@@ -1,0 +1,260 @@
+"""Registration pipeline integration tests.
+
+Python rebuild of reference test/register.test.js — but hermetic: each test
+registers against the in-process ZK server, then *reads back from ZooKeeper*
+and asserts on the stored payloads, exactly like the reference's read-back
+helper (reference test/register.test.js:26-66).  Also covers the reference's
+known coverage gaps (SURVEY.md §4): multi-node unregister, aliases, ports
+arrays.
+"""
+
+import asyncio
+import json
+
+import pytest
+
+from registrar_tpu.records import parse_payload
+from registrar_tpu.register import register, unregister, znode_paths
+from registrar_tpu.testing.server import ZKServer
+from registrar_tpu.zk.client import ZKClient
+from registrar_tpu.zk.protocol import ZKError
+
+DOMAIN = "unit.test.registrar"  # -> /registrar/test/unit
+PATH = "/registrar/test/unit"
+
+
+async def _pair():
+    server = await ZKServer().start()
+    client = await ZKClient([server.address]).connect()
+    return server, client
+
+
+async def _register(client, registration, **kw):
+    kw.setdefault("settle_delay", 0.01)
+    kw.setdefault("hostname", "testhost")
+    return await register(client, registration, **kw)
+
+
+class TestRegister:
+    async def test_host_only(self):
+        # reference test/register.test.js:76-86
+        server, client = await _pair()
+        try:
+            nodes = await _register(
+                client, {"domain": DOMAIN, "type": "host"}, admin_ip="10.0.0.1"
+            )
+            assert nodes == [f"{PATH}/testhost"]
+            st = await client.stat(nodes[0])
+            assert st.ephemeral_owner == client.session_id  # really ephemeral
+            data, _ = await client.get(nodes[0])
+            assert parse_payload(data)["type"] == "host"
+        finally:
+            await client.close()
+            await server.stop()
+
+    async def test_admin_ip_payload_exact(self):
+        # reference test/register.test.js:112-131 (deepEqual on payload)
+        server, client = await _pair()
+        try:
+            nodes = await _register(
+                client,
+                {"domain": DOMAIN, "type": "host"},
+                admin_ip="192.168.0.5",
+            )
+            data, _ = await client.get(nodes[0])
+            assert data == (
+                b'{"type":"host","address":"192.168.0.5",'
+                b'"host":{"address":"192.168.0.5"}}'
+            )
+        finally:
+            await client.close()
+            await server.stop()
+
+    async def test_admin_ip_and_ttl(self):
+        # reference test/register.test.js:134-155
+        server, client = await _pair()
+        try:
+            nodes = await _register(
+                client,
+                {"domain": DOMAIN, "type": "host", "ttl": 30},
+                admin_ip="192.168.0.5",
+            )
+            data, _ = await client.get(nodes[0])
+            assert parse_payload(data) == {
+                "type": "host",
+                "address": "192.168.0.5",
+                "ttl": 30,
+                "host": {"address": "192.168.0.5"},
+            }
+        finally:
+            await client.close()
+            await server.stop()
+
+    async def test_service_record_written_persistent(self):
+        # reference test/register.test.js:158-186
+        server, client = await _pair()
+        try:
+            registration = {
+                "domain": DOMAIN,
+                "type": "load_balancer",
+                "service": {
+                    "type": "service",
+                    "service": {"srvce": "_http", "proto": "_tcp", "port": 80},
+                },
+            }
+            nodes = await _register(client, registration, admin_ip="10.9.9.9")
+            # service node appended to the owned list
+            assert nodes == [f"{PATH}/testhost", PATH]
+            svc_data, svc_stat = await client.get(PATH)
+            assert svc_stat.ephemeral_owner == 0  # persistent
+            assert parse_payload(svc_data) == {
+                "type": "service",
+                "service": {
+                    "type": "service",
+                    "service": {
+                        "srvce": "_http", "proto": "_tcp", "port": 80, "ttl": 60,
+                    },
+                },
+            }
+            # host record inherits the service port when no ports configured
+            host_data, _ = await client.get(f"{PATH}/testhost")
+            assert parse_payload(host_data)["load_balancer"]["ports"] == [80]
+        finally:
+            await client.close()
+            await server.stop()
+
+    async def test_aliases_create_additional_host_records(self):
+        # coverage gap in the reference suite (SURVEY.md §4)
+        server, client = await _pair()
+        try:
+            registration = {
+                "domain": DOMAIN,
+                "type": "load_balancer",
+                "aliases": [f"a1.{DOMAIN}", f"a2.{DOMAIN}"],
+            }
+            nodes = await _register(client, registration, admin_ip="10.0.0.2")
+            assert nodes == [
+                f"{PATH}/testhost",
+                f"{PATH}/a1",
+                f"{PATH}/a2",
+            ]
+            for n in nodes:
+                data, st = await client.get(n)
+                assert st.ephemeral_owner == client.session_id
+                assert parse_payload(data)["address"] == "10.0.0.2"
+        finally:
+            await client.close()
+            await server.stop()
+
+    async def test_explicit_ports_override_service_port(self):
+        server, client = await _pair()
+        try:
+            registration = {
+                "domain": DOMAIN,
+                "type": "moray_host",
+                "ports": [2020, 2021],
+                "service": {
+                    "type": "service",
+                    "service": {"srvce": "_moray", "proto": "_tcp", "port": 2020},
+                },
+            }
+            nodes = await _register(client, registration, admin_ip="10.0.0.3")
+            data, _ = await client.get(f"{PATH}/testhost")
+            assert parse_payload(data)["moray_host"]["ports"] == [2020, 2021]
+        finally:
+            await client.close()
+            await server.stop()
+
+    async def test_reregister_replaces_stale_entries(self):
+        # the cleanup stage: re-running the pipeline over stale state works
+        server, client = await _pair()
+        try:
+            registration = {"domain": DOMAIN, "type": "host"}
+            await _register(client, registration, admin_ip="10.0.0.4")
+            nodes = await _register(client, registration, admin_ip="10.0.0.5")
+            data, _ = await client.get(nodes[0])
+            assert parse_payload(data)["address"] == "10.0.0.5"
+        finally:
+            await client.close()
+            await server.stop()
+
+    async def test_service_config_not_mutated(self):
+        server, client = await _pair()
+        try:
+            svc = {
+                "type": "service",
+                "service": {"srvce": "_s", "proto": "_t", "port": 1},
+            }
+            registration = {"domain": DOMAIN, "type": "load_balancer", "service": svc}
+            await _register(client, registration, admin_ip="10.0.0.6")
+            assert "ttl" not in svc["service"]  # reference mutates; we must not
+        finally:
+            await client.close()
+            await server.stop()
+
+    @pytest.mark.parametrize(
+        "bad",
+        [
+            {},
+            {"domain": DOMAIN},
+            {"type": "host"},
+            {"domain": DOMAIN, "type": "host", "ttl": "x"},
+            {"domain": DOMAIN, "type": "host", "ports": "80"},
+            {"domain": DOMAIN, "type": "host", "ports": [True]},
+            {"domain": DOMAIN, "type": "host", "aliases": "a.b"},
+            {"domain": DOMAIN, "type": "host", "service": {"type": "wrong"}},
+        ],
+    )
+    async def test_validation(self, bad):
+        server, client = await _pair()
+        try:
+            with pytest.raises(ValueError):
+                await _register(client, bad, admin_ip="10.0.0.1")
+        finally:
+            await client.close()
+            await server.stop()
+
+
+class TestUnregister:
+    async def test_unregister_deletes_all_nodes(self):
+        # reference test/register.test.js:89-109, plus the multi-node case
+        # the reference's early-cb bug (lib/register.js:281) left untested
+        server, client = await _pair()
+        try:
+            registration = {
+                "domain": DOMAIN,
+                "type": "load_balancer",
+                "aliases": [f"x.{DOMAIN}", f"y.{DOMAIN}"],
+                "service": {
+                    "type": "service",
+                    "service": {"srvce": "_http", "proto": "_tcp", "port": 80},
+                },
+            }
+            nodes = await _register(client, registration, admin_ip="10.1.1.1")
+            assert len(nodes) == 4
+            await unregister(client, nodes)
+            for n in nodes:
+                assert await client.exists(n) is None, n
+        finally:
+            await client.close()
+            await server.stop()
+
+    async def test_unregister_missing_node_raises(self):
+        # parity: reference unregister does NOT ignore NO_NODE
+        server, client = await _pair()
+        try:
+            with pytest.raises(ZKError) as ei:
+                await unregister(client, ["/never/existed"])
+            assert ei.value.name == "NO_NODE"
+        finally:
+            await client.close()
+            await server.stop()
+
+
+class TestZnodePaths:
+    def test_paths(self):
+        reg = {"domain": "1.moray.us-east.joyent.com", "aliases": ["a.b"]}
+        assert znode_paths(reg, hostname="h0") == [
+            "/com/joyent/us-east/moray/1/h0",
+            "/b/a",
+        ]
