@@ -1,0 +1,43 @@
+# Build/test/release targets — the analog of the reference's Makefile
+# (reference Makefile:36-95: check/test/release/publish via the eng.git
+# framework).  No submodules here; everything is stdlib Python.
+
+PYTHON ?= python3
+NAME = registrar
+RELEASE_TARBALL = $(NAME)-release.tar.gz
+RELSTAGEDIR = /tmp/$(NAME)-release
+
+.PHONY: all check test bench release clean
+
+all: check test
+
+# Lint gate (the reference's `make check` runs jsl+jsstyle; here:
+# byte-compile + pyflakes-ish import check).
+check:
+	$(PYTHON) -m compileall -q registrar_tpu tests bench.py __graft_entry__.py
+	$(PYTHON) -c "import registrar_tpu, registrar_tpu.main, \
+	    registrar_tpu.testing.server, registrar_tpu.config"
+
+test:
+	$(PYTHON) -m pytest tests/ -x -q
+
+bench:
+	$(PYTHON) bench.py
+
+# Release tarball rooted at /opt/registrar (the reference roots its
+# tarball at /opt/smartdc/registrar, Makefile:70-95).
+release:
+	rm -rf $(RELSTAGEDIR)
+	mkdir -p $(RELSTAGEDIR)/opt/registrar/etc
+	cp -r registrar_tpu $(RELSTAGEDIR)/opt/registrar/
+	cp -r systemd $(RELSTAGEDIR)/opt/registrar/
+	cp etc/config.coal.json $(RELSTAGEDIR)/opt/registrar/etc/
+	cp README.md pyproject.toml $(RELSTAGEDIR)/opt/registrar/
+	find $(RELSTAGEDIR) -name __pycache__ -type d | xargs rm -rf
+	tar -czf $(RELEASE_TARBALL) -C $(RELSTAGEDIR) opt
+	rm -rf $(RELSTAGEDIR)
+	@echo "release: $(RELEASE_TARBALL)"
+
+clean:
+	rm -f $(RELEASE_TARBALL)
+	find . -name __pycache__ -type d | xargs rm -rf

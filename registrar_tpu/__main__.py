@@ -1,0 +1,5 @@
+"""``python -m registrar_tpu`` entry point (the reference's `node main.js`)."""
+
+from registrar_tpu.main import main
+
+main()

@@ -1,0 +1,183 @@
+"""Configuration loading and validation.
+
+The config file is the same JSON shape the reference reads (reference
+main.js:52-84, README.md "Configuration reference"; sample:
+etc/config.coal.json)::
+
+    {
+      "adminIp": "10.0.0.5",                   # optional
+      "zookeeper": {
+        "servers": [{"host": "...", "port": 2181}, ...],
+        "timeout": 30000,                      # session timeout, ms
+        "connectTimeout": 4000                 # per-attempt dial timeout, ms
+      },
+      "registration": {
+        "domain": "...", "type": "...",
+        "aliases": [...], "ttl": 30, "ports": [...],
+        "service": {"type": "service",
+                    "service": {"srvce": "...", "proto": "...", "port": N,
+                                "ttl": N}},
+        "heartbeatInterval": 3000              # ms (undocumented upstream,
+      },                                       #  honored for parity)
+      "healthCheck": {                         # optional; ms-based values
+        "command": "...", "interval": 60000, "timeout": 1000,
+        "threshold": 5, "period": 300000, "ignoreExitStatus": false,
+        "stdoutMatch": {"pattern": "...", "flags": "...", "invert": false}
+      },
+      "logLevel": "info",                      # optional
+      "maxAttempts": 5                         # heartbeat retry attempts
+    }
+
+All reference keys are camelCase and all durations are milliseconds; this
+module translates them into the seconds-based snake_case surface of the
+Python modules.  ``maxAttempts`` appears in the reference's sample config
+but is read by nothing there (SURVEY.md §2.7 calls it inert) — here it is
+wired to the heartbeat retry policy, which is what it was evidently meant
+to configure.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Mapping, Optional, Tuple
+
+from registrar_tpu.retry import HEARTBEAT_RETRY, RetryPolicy
+
+
+class ConfigError(ValueError):
+    """Invalid or unreadable configuration."""
+
+
+@dataclass
+class ZookeeperConfig:
+    servers: List[Tuple[str, int]]
+    timeout_ms: int = 30000
+    connect_timeout_ms: int = 4000
+
+
+@dataclass
+class Config:
+    zookeeper: ZookeeperConfig
+    registration: Dict[str, Any]
+    admin_ip: Optional[str] = None
+    health_check: Optional[Dict[str, Any]] = None  # seconds-based kwargs
+    log_level: Optional[str] = None
+    heartbeat_interval_s: float = 3.0
+    heartbeat_retry: RetryPolicy = field(default_factory=lambda: HEARTBEAT_RETRY)
+
+
+def parse_config(raw: Mapping[str, Any]) -> Config:
+    if not isinstance(raw, Mapping):
+        raise ConfigError("config must be a JSON object")
+
+    zk_raw = raw.get("zookeeper")
+    if not isinstance(zk_raw, Mapping):
+        raise ConfigError("config.zookeeper must be an object")
+    servers_raw = zk_raw.get("servers")
+    if not isinstance(servers_raw, list) or not servers_raw:
+        raise ConfigError("config.zookeeper.servers must be a non-empty array")
+    servers: List[Tuple[str, int]] = []
+    for i, s in enumerate(servers_raw):
+        if (
+            not isinstance(s, Mapping)
+            or not isinstance(s.get("host"), str)
+            or not isinstance(s.get("port"), int)
+            or isinstance(s.get("port"), bool)
+        ):
+            raise ConfigError(
+                f"config.zookeeper.servers[{i}] must be {{host, port}}"
+            )
+        servers.append((s["host"], s["port"]))
+    zookeeper = ZookeeperConfig(
+        servers=servers,
+        timeout_ms=_ms(zk_raw, "timeout", 30000),
+        connect_timeout_ms=_ms(zk_raw, "connectTimeout", 4000),
+    )
+
+    registration = raw.get("registration")
+    if not isinstance(registration, Mapping):
+        raise ConfigError("config.registration must be an object")
+    registration = dict(registration)
+
+    # Back-compat shim: top-level adminIp hoisted into the registration
+    # (reference main.js:146-147).
+    admin_ip = registration.get("adminIp") or raw.get("adminIp")
+    if admin_ip is not None and not isinstance(admin_ip, str):
+        raise ConfigError("config.adminIp must be a string")
+
+    heartbeat_interval_s = (
+        _ms(registration, "heartbeatInterval", 3000) / 1000.0
+    )
+    registration.pop("heartbeatInterval", None)
+    registration.pop("adminIp", None)
+
+    health_check = None
+    hc_raw = raw.get("healthCheck")
+    if hc_raw is not None:
+        if not isinstance(hc_raw, Mapping):
+            raise ConfigError("config.healthCheck must be an object")
+        if not isinstance(hc_raw.get("command"), str) or not hc_raw["command"]:
+            raise ConfigError("config.healthCheck.command must be a string")
+        health_check = {
+            "command": hc_raw["command"],
+            "interval": _ms(hc_raw, "interval", 60000) / 1000.0,
+            "timeout": _ms(hc_raw, "timeout", 1000) / 1000.0,
+            "period": _ms(hc_raw, "period", 300000) / 1000.0,
+            "threshold": hc_raw.get("threshold", 5),
+            "ignore_exit_status": bool(hc_raw.get("ignoreExitStatus", False)),
+        }
+        if hc_raw.get("stdoutMatch") is not None:
+            health_check["stdout_match"] = hc_raw["stdoutMatch"]
+
+    log_level = raw.get("logLevel")
+    if log_level is not None and not isinstance(log_level, str):
+        raise ConfigError("config.logLevel must be a string")
+
+    max_attempts = raw.get("maxAttempts")
+    if max_attempts is not None and (
+        not isinstance(max_attempts, int) or max_attempts < 1
+    ):
+        raise ConfigError("config.maxAttempts must be a positive integer")
+    heartbeat_retry = (
+        RetryPolicy(
+            max_attempts=max_attempts,
+            initial_delay=HEARTBEAT_RETRY.initial_delay,
+            max_delay=HEARTBEAT_RETRY.max_delay,
+        )
+        if max_attempts is not None
+        else HEARTBEAT_RETRY
+    )
+
+    return Config(
+        zookeeper=zookeeper,
+        registration=registration,
+        admin_ip=admin_ip,
+        health_check=health_check,
+        log_level=log_level,
+        heartbeat_interval_s=heartbeat_interval_s,
+        heartbeat_retry=heartbeat_retry,
+    )
+
+
+def load_config(path: str) -> Config:
+    """Read + parse the JSON config at ``path`` (reference main.js:57-62)."""
+    try:
+        with open(path, "r", encoding="utf-8") as f:
+            raw = json.load(f)
+    except (OSError, json.JSONDecodeError) as e:
+        raise ConfigError(f"unable to read configuration {path}: {e}") from e
+    return parse_config(raw)
+
+
+def _ms(obj: Mapping[str, Any], key: str, default: int) -> int:
+    value = obj.get(key, default)
+    if (
+        not isinstance(value, (int, float))
+        or isinstance(value, bool)
+        or not math.isfinite(value)
+        or value <= 0
+    ):
+        raise ConfigError(f"config {key} must be a positive number (ms)")
+    return int(value)

@@ -1,0 +1,174 @@
+"""The registrar daemon mainline (CLI).
+
+Rebuild of reference main.js:102-200.  Usage::
+
+    python -m registrar_tpu -f /opt/registrar/etc/config.json [-v ...]
+
+Behavior parity:
+
+  * ``-f`` config file (required), ``-v`` repeatable verbosity escalation,
+    ``-h`` usage (reference main.js:29-46,107-121);
+  * log level: LOG_LEVEL env < config ``logLevel`` < ``-v`` flags
+    (reference main.js:24,66-76); bunyan-shaped JSON lines on stdout;
+  * ZooKeeper connect retries forever with exponential 1-90 s backoff
+    (reference lib/zk.js:97-101);
+  * ``session_expired`` => log fatal + ``exit(1)`` so the supervisor
+    (systemd/SMF) restarts the process with a fresh session — crash-restart
+    is the load-bearing recovery design (reference main.js:141-144,
+    SURVEY.md §3.4);
+  * every lifecycle event is logged, with heartbeat failures edge-triggered
+    through an ``is_down`` latch so a long outage logs once
+    (reference main.js:149,187-198).
+
+Addition over the reference: SIGTERM/SIGINT run a graceful stop that
+closes the ZK session, which deletes the ephemerals *immediately* instead
+of waiting out the session timeout — an instance drained with
+``systemctl stop registrar`` leaves DNS as fast as Binder's cache allows.
+(The reference is stopped with SMF ``:kill`` and waits for expiry,
+README.md:85-87.)
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import logging
+import signal
+import sys
+
+from registrar_tpu import __version__
+from registrar_tpu import jlog
+from registrar_tpu.agent import register_plus
+from registrar_tpu.config import Config, ConfigError, load_config
+from registrar_tpu.zk.client import ZKClient, create_zk_client
+
+
+def parse_args(argv=None) -> argparse.Namespace:
+    parser = argparse.ArgumentParser(
+        prog="registrar",
+        description="service-discovery sidecar: registers this host in "
+        "ZooKeeper for Binder-served DNS",
+    )
+    parser.add_argument(
+        "-f", "--file", metavar="FILE", required=True,
+        help="configuration file to process",
+    )
+    parser.add_argument(
+        "-v", "--verbose", action="count", default=0,
+        help="verbose output; use multiple times for more verbosity",
+    )
+    parser.add_argument(
+        "--version", action="version", version=f"registrar {__version__}"
+    )
+    return parser.parse_args(argv)
+
+
+def configure(argv=None) -> Config:
+    """Parse args + config, set up logging (reference main.js:52-84)."""
+    args = parse_args(argv)
+    log = jlog.setup("registrar")
+    try:
+        cfg = load_config(args.file)
+    except ConfigError as e:
+        log.critical("unable to read configuration %s", args.file,
+                     exc_info=(type(e), e, e.__traceback__))
+        sys.exit(1)
+    if cfg.log_level:
+        level = jlog.LEVELS.get(cfg.log_level.lower())
+        if level is None:
+            log.critical("invalid logLevel %r", cfg.log_level)
+            sys.exit(1)
+        logging.getLogger().setLevel(level)
+    if args.verbose:
+        jlog.escalate(args.verbose)
+    log.info("configuration loaded from %s", args.file,
+             extra={"zdata": {"file": args.file}})
+    return cfg
+
+
+async def run(cfg: Config, *, _exit=sys.exit) -> None:
+    """Connect, register, and serve events until stopped or expired."""
+    log = logging.getLogger("registrar")
+
+    zk = await create_zk_client(
+        cfg.zookeeper.servers,
+        timeout_ms=cfg.zookeeper.timeout_ms,
+        connect_timeout_ms=cfg.zookeeper.connect_timeout_ms,
+    )
+
+    zk.on("close", lambda *a: log.warning("zookeeper: disconnected"))
+    # The initial connect already happened; later connects are reconnects
+    # (the reference ignores the first 'connect' for the same reason,
+    # main.js:135-139).
+    zk.on("connect", lambda *a: log.info("zookeeper: reconnected"))
+
+    stopping = asyncio.Event()
+
+    def on_session_expired(*_a) -> None:
+        log.critical("ZooKeeper session_expired event; exiting")
+        stopping.set()
+        _exit(1)
+
+    zk.on("session_expired", on_session_expired)
+
+    ee = register_plus(
+        zk,
+        cfg.registration,
+        admin_ip=cfg.admin_ip,
+        health_check=cfg.health_check,
+        heartbeat_interval=cfg.heartbeat_interval_s,
+    )
+
+    ee.on("fail", lambda err: log.error(
+        "registrar: healthcheck failed", extra={"zdata": {"err": err}}))
+    ee.on("ok", lambda: log.info("registrar: healthcheck ok (was down)"))
+    ee.on("error", lambda err: log.error(
+        "registrar: unexpected error", extra={"zdata": {"err": err}}))
+    ee.on("register", lambda nodes: log.info(
+        "registrar: registered", extra={"zdata": {"znodes": nodes}}))
+    ee.on("unregister", lambda err, nodes: log.warning(
+        "registrar: unregistered",
+        extra={"zdata": {"err": err, "znodes": nodes}}))
+
+    # Edge-triggered heartbeat logging (reference main.js:149,187-198).
+    is_down = False
+
+    def on_heartbeat_failure(err) -> None:
+        nonlocal is_down
+        if not is_down:
+            log.error("zookeeper: heartbeat failed",
+                      extra={"zdata": {"err": err}})
+        is_down = True
+
+    def on_heartbeat(_nodes) -> None:
+        nonlocal is_down
+        if is_down:
+            log.info("zookeeper heartbeat ok")
+        is_down = False
+
+    ee.on("heartbeatFailure", on_heartbeat_failure)
+    ee.on("heartbeat", on_heartbeat)
+
+    loop = asyncio.get_running_loop()
+    for sig in (signal.SIGTERM, signal.SIGINT):
+        try:
+            loop.add_signal_handler(sig, stopping.set)
+        except NotImplementedError:  # non-unix test environments
+            pass
+
+    await stopping.wait()
+    log.info("registrar: shutting down")
+    ee.stop()
+    await zk.close()  # deletes our ephemerals immediately (see docstring)
+
+
+def main(argv=None) -> None:
+    cfg = configure(argv)
+    try:
+        asyncio.run(run(cfg))
+    except KeyboardInterrupt:
+        pass
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,149 @@
+"""Config loading/validation tests (reference main.js:52-84, SURVEY.md §2.7)."""
+
+import json
+
+import pytest
+
+from registrar_tpu.config import ConfigError, load_config, parse_config
+
+
+def _coal():
+    # mirror of the reference's sample config, etc/config.coal.json
+    return {
+        "registration": {
+            "domain": "test.coal.joyent.us",
+            "type": "host",
+            "aliases": ["alias-1.test.coal.joyent.us"],
+        },
+        "zookeeper": {
+            "connectTimeout": 1000,
+            "servers": [{"host": "10.99.99.11", "port": 2181}],
+            "timeout": 6000,
+        },
+        "maxAttempts": 10,
+    }
+
+
+class TestParse:
+    def test_coal_sample(self):
+        cfg = parse_config(_coal())
+        assert cfg.zookeeper.servers == [("10.99.99.11", 2181)]
+        assert cfg.zookeeper.timeout_ms == 6000
+        assert cfg.zookeeper.connect_timeout_ms == 1000
+        assert cfg.registration["domain"] == "test.coal.joyent.us"
+        # maxAttempts is inert in the reference (read by nothing,
+        # SURVEY.md §2.7); here it configures the heartbeat retry.
+        assert cfg.heartbeat_retry.max_attempts == 10
+
+    def test_defaults(self):
+        cfg = parse_config(
+            {
+                "registration": {"domain": "a.b", "type": "host"},
+                "zookeeper": {"servers": [{"host": "h", "port": 1}]},
+            }
+        )
+        assert cfg.zookeeper.timeout_ms == 30000
+        assert cfg.heartbeat_interval_s == 3.0
+        assert cfg.heartbeat_retry.max_attempts == 5
+        assert cfg.health_check is None
+        assert cfg.admin_ip is None
+
+    def test_top_level_admin_ip_shim(self):
+        # reference main.js:146-147
+        cfg = parse_config(
+            {
+                "adminIp": "10.0.0.9",
+                "registration": {"domain": "a.b", "type": "host"},
+                "zookeeper": {"servers": [{"host": "h", "port": 1}]},
+            }
+        )
+        assert cfg.admin_ip == "10.0.0.9"
+
+    def test_registration_admin_ip_wins(self):
+        cfg = parse_config(
+            {
+                "adminIp": "10.0.0.1",
+                "registration": {
+                    "domain": "a.b", "type": "host", "adminIp": "10.0.0.2",
+                },
+                "zookeeper": {"servers": [{"host": "h", "port": 1}]},
+            }
+        )
+        assert cfg.admin_ip == "10.0.0.2"
+        assert "adminIp" not in cfg.registration
+
+    def test_health_check_ms_to_seconds(self):
+        cfg = parse_config(
+            {
+                "registration": {"domain": "a.b", "type": "host"},
+                "zookeeper": {"servers": [{"host": "h", "port": 1}]},
+                "healthCheck": {
+                    "command": "true",
+                    "interval": 5000,
+                    "timeout": 500,
+                    "threshold": 3,
+                    "period": 60000,
+                    "ignoreExitStatus": True,
+                    "stdoutMatch": {"pattern": "ok", "invert": True},
+                },
+            }
+        )
+        hc = cfg.health_check
+        assert hc["interval"] == 5.0
+        assert hc["timeout"] == 0.5
+        assert hc["period"] == 60.0
+        assert hc["threshold"] == 3
+        assert hc["ignore_exit_status"] is True
+        assert hc["stdout_match"]["invert"] is True
+
+    def test_heartbeat_interval_ms(self):
+        cfg = parse_config(
+            {
+                "registration": {
+                    "domain": "a.b", "type": "host", "heartbeatInterval": 500,
+                },
+                "zookeeper": {"servers": [{"host": "h", "port": 1}]},
+            }
+        )
+        assert cfg.heartbeat_interval_s == 0.5
+        assert "heartbeatInterval" not in cfg.registration
+
+    @pytest.mark.parametrize(
+        "mutate",
+        [
+            lambda c: c.pop("zookeeper"),
+            lambda c: c.pop("registration"),
+            lambda c: c["zookeeper"].update(servers=[]),
+            lambda c: c["zookeeper"].update(servers=[{"host": "h"}]),
+            lambda c: c["zookeeper"].update(servers=[{"host": 1, "port": 1}]),
+            lambda c: c["zookeeper"].update(timeout=-5),
+            lambda c: c.update(adminIp=42),
+            lambda c: c.update(healthCheck={"interval": 5}),
+            lambda c: c.update(healthCheck={"command": ""}),
+            lambda c: c.update(logLevel=3),
+            lambda c: c.update(maxAttempts=0),
+        ],
+    )
+    def test_invalid(self, mutate):
+        raw = _coal()
+        mutate(raw)
+        with pytest.raises(ConfigError):
+            parse_config(raw)
+
+
+class TestLoad:
+    def test_load_from_file(self, tmp_path):
+        p = tmp_path / "config.json"
+        p.write_text(json.dumps(_coal()))
+        cfg = load_config(str(p))
+        assert cfg.registration["type"] == "host"
+
+    def test_missing_file(self):
+        with pytest.raises(ConfigError):
+            load_config("/nonexistent/config.json")
+
+    def test_malformed_json(self, tmp_path):
+        p = tmp_path / "bad.json"
+        p.write_text("{nope")
+        with pytest.raises(ConfigError):
+            load_config(str(p))

@@ -1,0 +1,22 @@
+"""Driver-harness compliance tests for __graft_entry__.py.
+
+The conftest pins JAX to the virtual 8-device CPU platform before import.
+"""
+
+import jax
+import pytest
+
+
+def test_entry_jit_compiles():
+    import __graft_entry__ as g
+
+    fn, args = g.entry()
+    out = jax.jit(fn)(*args)
+    assert out.shape == (g.BATCH, g.DOUT)
+
+
+@pytest.mark.parametrize("n", [1, 2, 4, 8])
+def test_dryrun_multichip(n):
+    import __graft_entry__ as g
+
+    g.dryrun_multichip(n)

@@ -1,0 +1,81 @@
+"""Bunyan-format logging tests: downstream log tooling compatibility."""
+
+import io
+import json
+import logging
+
+from registrar_tpu import jlog
+
+
+def _setup(level=None):
+    buf = io.StringIO()
+    log = jlog.setup("registrar", level=level, stream=buf)
+    return log, buf
+
+
+def _records(buf):
+    return [json.loads(line) for line in buf.getvalue().splitlines()]
+
+
+class TestFormat:
+    def test_bunyan_required_fields(self):
+        log, buf = _setup(level=logging.INFO)
+        log.info("hello %s", "world")
+        (rec,) = _records(buf)
+        # the bunyan record contract: v/level/name/hostname/pid/time/msg
+        assert rec["v"] == 0
+        assert rec["level"] == 30
+        assert rec["name"] == "registrar"
+        assert rec["msg"] == "hello world"
+        assert isinstance(rec["pid"], int)
+        assert rec["time"].endswith("Z")
+        assert "T" in rec["time"]
+
+    def test_level_numbers(self):
+        log, buf = _setup(level=jlog.TRACE)
+        log.log(jlog.TRACE, "t")
+        log.debug("d")
+        log.info("i")
+        log.warning("w")
+        log.error("e")
+        log.critical("f")
+        assert [r["level"] for r in _records(buf)] == [10, 20, 30, 40, 50, 60]
+
+    def test_extra_zdata_fields(self):
+        log, buf = _setup(level=logging.INFO)
+        log.info("registered", extra={"zdata": {"znodes": ["/a", "/b"]}})
+        (rec,) = _records(buf)
+        assert rec["znodes"] == ["/a", "/b"]
+
+    def test_err_serializer(self):
+        log, buf = _setup(level=logging.INFO)
+        try:
+            raise RuntimeError("kaboom")
+        except RuntimeError:
+            log.exception("failed")
+        (rec,) = _records(buf)
+        assert rec["err"]["name"] == "RuntimeError"
+        assert rec["err"]["message"] == "kaboom"
+        assert "Traceback" in rec["err"]["stack"]
+
+    def test_exception_value_in_zdata(self):
+        log, buf = _setup(level=logging.INFO)
+        log.error("e", extra={"zdata": {"err": ValueError("bad")}})
+        (rec,) = _records(buf)
+        assert rec["err"] == {"message": "bad", "name": "ValueError"}
+
+
+class TestLevels:
+    def test_env_level(self, monkeypatch):
+        monkeypatch.setenv("LOG_LEVEL", "debug")
+        _, buf = _setup()
+        assert logging.getLogger().level == logging.DEBUG
+
+    def test_escalate(self):
+        _setup(level=logging.INFO)
+        jlog.escalate(1)
+        assert logging.getLogger().level == logging.DEBUG
+        jlog.escalate(1)
+        assert logging.getLogger().level == jlog.TRACE
+        jlog.escalate(5)  # clamped at TRACE
+        assert logging.getLogger().level == jlog.TRACE

@@ -1,0 +1,168 @@
+"""Daemon mainline tests, including a full end-to-end subprocess run.
+
+The e2e test is the rebuild's version of SURVEY.md §7's "minimum end-to-end
+slice": start the in-process ZK server, run the *real* daemon process
+against a coal-style config, verify the znode JSON byte-for-byte, then
+kill the daemon and watch the ephemeral vanish on session expiry.
+"""
+
+import asyncio
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+
+import pytest
+
+from registrar_tpu.main import parse_args
+from registrar_tpu.testing.server import ZKServer
+from registrar_tpu.zk.client import ZKClient
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+class TestArgs:
+    def test_file_required(self, capsys):
+        with pytest.raises(SystemExit) as ei:
+            parse_args([])
+        assert ei.value.code == 2
+
+    def test_verbose_count(self):
+        args = parse_args(["-f", "x.json", "-v", "-v"])
+        assert args.verbose == 2
+        assert args.file == "x.json"
+
+    def test_help_exits_zero(self):
+        with pytest.raises(SystemExit) as ei:
+            parse_args(["-h"])
+        assert ei.value.code == 0
+
+
+class TestEndToEnd:
+    async def test_daemon_lifecycle(self, tmp_path):
+        server = await ZKServer(max_session_timeout_ms=1000).start()
+        observer = await ZKClient([server.address]).connect()
+        try:
+            config = {
+                "registration": {
+                    "domain": "e2e.test.registrar",
+                    "type": "load_balancer",
+                    "heartbeatInterval": 100,
+                    "service": {
+                        "type": "service",
+                        "service": {
+                            "srvce": "_http", "proto": "_tcp", "port": 80,
+                        },
+                    },
+                },
+                "adminIp": "10.66.66.66",
+                "zookeeper": {
+                    "servers": [
+                        {"host": server.host, "port": server.port}
+                    ],
+                    "timeout": 800,
+                },
+                "logLevel": "debug",
+            }
+            cfg_path = tmp_path / "config.json"
+            cfg_path.write_text(json.dumps(config))
+
+            proc = subprocess.Popen(
+                [sys.executable, "-m", "registrar_tpu", "-f", str(cfg_path)],
+                cwd=REPO,
+                stdout=subprocess.PIPE,
+                stderr=subprocess.STDOUT,
+                env={**os.environ, "PYTHONPATH": REPO},
+            )
+            try:
+                hostname = socket.gethostname()
+                host_node = f"/registrar/test/e2e/{hostname}"
+                svc_node = "/registrar/test/e2e"
+
+                # up to ~10s for daemon start + 1s settle delay
+                for _ in range(100):
+                    if await observer.exists(host_node):
+                        break
+                    await asyncio.sleep(0.1)
+                else:
+                    raise AssertionError("host znode never appeared")
+
+                data, st = await observer.get(host_node)
+                assert st.ephemeral_owner != 0
+                assert data == (
+                    b'{"type":"load_balancer","address":"10.66.66.66",'
+                    b'"load_balancer":{"address":"10.66.66.66","ports":[80]}}'
+                )
+                svc, svc_st = await observer.get(svc_node)
+                assert svc_st.ephemeral_owner == 0
+                assert json.loads(svc)["type"] == "service"
+
+                # SIGKILL (the SMF ':kill' analog): no graceful cleanup;
+                # the ephemeral must vanish via session expiry.
+                proc.send_signal(signal.SIGKILL)
+                proc.wait(timeout=10)
+                for _ in range(100):
+                    if not await observer.exists(host_node):
+                        break
+                    await asyncio.sleep(0.1)
+                else:
+                    raise AssertionError("ephemeral survived session expiry")
+                # the persistent service record survives
+                assert await observer.exists(svc_node) is not None
+            finally:
+                if proc.poll() is None:
+                    proc.kill()
+                out = proc.stdout.read().decode()
+                # every log line must be valid bunyan JSON
+                for line in out.splitlines():
+                    rec = json.loads(line)
+                    assert rec["name"] == "registrar"
+        finally:
+            await observer.close()
+            await server.stop()
+
+    async def test_daemon_graceful_stop_drains_immediately(self, tmp_path):
+        # SIGTERM: our addition — ephemerals deleted at once, not after
+        # session timeout.
+        server = await ZKServer(max_session_timeout_ms=30000).start()
+        observer = await ZKClient([server.address]).connect()
+        try:
+            config = {
+                "registration": {"domain": "drain.test.registrar",
+                                  "type": "host"},
+                "adminIp": "10.66.66.67",
+                "zookeeper": {
+                    "servers": [{"host": server.host, "port": server.port}],
+                    "timeout": 30000,
+                },
+            }
+            cfg_path = tmp_path / "config.json"
+            cfg_path.write_text(json.dumps(config))
+            proc = subprocess.Popen(
+                [sys.executable, "-m", "registrar_tpu", "-f", str(cfg_path)],
+                cwd=REPO,
+                stdout=subprocess.DEVNULL,
+                stderr=subprocess.STDOUT,
+                env={**os.environ, "PYTHONPATH": REPO},
+            )
+            try:
+                hostname = socket.gethostname()
+                node = f"/registrar/test/drain/{hostname}"
+                for _ in range(100):
+                    if await observer.exists(node):
+                        break
+                    await asyncio.sleep(0.1)
+                else:
+                    raise AssertionError("znode never appeared")
+                proc.send_signal(signal.SIGTERM)
+                proc.wait(timeout=10)
+                # gone well before the 30s session timeout
+                assert await observer.exists(node) is None
+            finally:
+                if proc.poll() is None:
+                    proc.kill()
+        finally:
+            await observer.close()
+            await server.stop()
