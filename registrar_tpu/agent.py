@@ -42,6 +42,7 @@ from registrar_tpu import register as register_mod
 from registrar_tpu.events import EventEmitter
 from registrar_tpu.health import HealthCheck, create_health_check
 from registrar_tpu.register import SETTLE_DELAY_S
+from registrar_tpu.retry import RetryPolicy
 from registrar_tpu.zk.client import ZKClient
 
 log = logging.getLogger("registrar_tpu.agent")
@@ -88,18 +89,22 @@ def register_plus(
     heartbeat_interval: float = DEFAULT_HEARTBEAT_INTERVAL_S,
     hostname: Optional[str] = None,
     settle_delay: float = SETTLE_DELAY_S,
+    heartbeat_retry: Optional[RetryPolicy] = None,
 ) -> RegistrarEvents:
     """Register, then keep the registration alive; returns the event surface.
 
     Must be called with a running event loop (the daemon's mainline or a
     test harness).  ``health_check`` is the config's ``healthCheck`` object
     (seconds-based keys, see :mod:`registrar_tpu.config` for translation).
+    ``heartbeat_retry`` overrides the per-probe retry policy (configured
+    from the sample config's ``maxAttempts``, see config.py).
     """
     ee = RegistrarEvents()
     loop = asyncio.get_running_loop()
     ee._tasks.append(loop.create_task(_run(ee, zk, registration, admin_ip,
                                            health_check, heartbeat_interval,
-                                           hostname, settle_delay)))
+                                           hostname, settle_delay,
+                                           heartbeat_retry)))
     return ee
 
 
@@ -112,6 +117,7 @@ async def _run(
     heartbeat_interval: float,
     hostname: Optional[str],
     settle_delay: float,
+    heartbeat_retry: Optional[RetryPolicy] = None,
 ) -> None:
     try:
         znodes = await register_mod.register(
@@ -131,7 +137,7 @@ async def _run(
 
     loop = asyncio.get_running_loop()
     ee._tasks.append(loop.create_task(
-        _heartbeat_loop(ee, zk, heartbeat_interval)
+        _heartbeat_loop(ee, zk, heartbeat_interval, heartbeat_retry)
     ))
     if health_check:
         _start_health_consumer(
@@ -141,12 +147,15 @@ async def _run(
 
 
 async def _heartbeat_loop(
-    ee: RegistrarEvents, zk: ZKClient, interval: float
+    ee: RegistrarEvents,
+    zk: ZKClient,
+    interval: float,
+    retry: Optional[RetryPolicy] = None,
 ) -> None:
     """Hot loop #1 (SURVEY.md §3.2): self-rescheduling znode liveness probe."""
     while not ee.stopped:
         try:
-            await zk.heartbeat(ee.znodes)
+            await zk.heartbeat(ee.znodes, retry=retry)
         except asyncio.CancelledError:
             raise
         except Exception as err:  # noqa: BLE001

@@ -1,0 +1,231 @@
+"""Binder's view of the records: resolve DNS names from ZooKeeper state.
+
+Binder (the DNS server, a separate repository) is the sole consumer of the
+records registrar writes; its behavior is specified in the reference's
+README ("ZooKeeper data format", README.md:443-757, and the host-record
+type table at README.md:274-282).  This module implements that documented
+resolution logic over our ZK client.  It is not a DNS server — it exists
+so tests and operators can validate, end to end, that what registrar wrote
+resolves to exactly the answers Binder would serve:
+
+  * host-record lookups (``$zonename.$domain``) — A answers for the
+    directly-queryable types only (``ops_host``/``rr_host`` resolve as if
+    absent, README.md:284-287);
+  * service lookups (``$domain``) — the children of the service node,
+    filtered to the usable-under-service types (``db_host``/``host``
+    excluded, README.md:289-293);
+  * SRV lookups (``_svc._proto.$domain``) — one SRV per port per instance
+    with A additionals, exactly the dig output shown at README.md:421-424;
+  * the TTL precedence chains from "About TTLs" (README.md:680-757).
+
+Used by the ``resolve`` subcommand of the zkcli operator tool and by
+tests/test_binderview.py (which pins the README's worked dig examples).
+"""
+
+from __future__ import annotations
+
+import asyncio
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+from registrar_tpu.records import (
+    HOST_RECORD_TYPES,
+    domain_to_path,
+    parse_payload,
+)
+from registrar_tpu.zk.client import ZKClient
+from registrar_tpu.zk.protocol import Err, ZKError
+
+#: Binder's fallback TTL when no record supplies one (typical deploys use
+#: 30 s answers, reference README.md:87-89).
+DEFAULT_TTL = 30
+
+#: SRV priority/weight are fixed — "DNS SRV records also support weights,
+#: but these are not supported by Registrar or Binder" (README.md:678).
+SRV_PRIORITY = 0
+SRV_WEIGHT = 10
+
+
+@dataclass
+class Answer:
+    """One DNS answer (shape mirrors dig output lines)."""
+
+    name: str
+    rtype: str  # "A" | "SRV"
+    ttl: int
+    #: A: the IPv4 address.  SRV: "<prio> <weight> <port> <target>".
+    data: str
+
+    def __str__(self) -> str:
+        return f"{self.name}. {self.ttl} IN {self.rtype} {self.data}"
+
+
+@dataclass
+class Resolution:
+    answers: List[Answer] = field(default_factory=list)
+    additionals: List[Answer] = field(default_factory=list)
+
+    @property
+    def empty(self) -> bool:
+        return not self.answers
+
+
+def _host_ttl(record: Dict[str, Any]) -> int:
+    """A-record TTL for a host record: inner ttl, then top-level ttl
+    (README.md:692-697)."""
+    inner = record.get(record.get("type"), {})
+    if isinstance(inner, dict) and isinstance(inner.get("ttl"), int):
+        return inner["ttl"]
+    if isinstance(record.get("ttl"), int):
+        return record["ttl"]
+    return DEFAULT_TTL
+
+
+def _service_ttl(record: Dict[str, Any]) -> int:
+    """SRV TTL for a service record: service.service.ttl, then service.ttl,
+    then top-level ttl (README.md:744-750)."""
+    svc = record.get("service")
+    if isinstance(svc, dict):
+        inner = svc.get("service")
+        if isinstance(inner, dict) and isinstance(inner.get("ttl"), int):
+            return inner["ttl"]
+        if isinstance(svc.get("ttl"), int):
+            return svc["ttl"]
+    if isinstance(record.get("ttl"), int):
+        return record["ttl"]
+    return DEFAULT_TTL
+
+
+async def _get_record(zk: ZKClient, path: str) -> Optional[Dict[str, Any]]:
+    try:
+        data, _ = await zk.get(path)
+    except ZKError as err:
+        if err.code == Err.NO_NODE:
+            return None
+        raise
+    if not data:
+        return None
+    try:
+        record = parse_payload(data)
+    except ValueError:
+        return None
+    return record if isinstance(record, dict) else None
+
+
+def _queryable_directly(rtype: str) -> bool:
+    entry = HOST_RECORD_TYPES.get(rtype)
+    return bool(entry and entry[0])
+
+
+def _usable_for_service(rtype: str) -> bool:
+    entry = HOST_RECORD_TYPES.get(rtype)
+    return bool(entry and entry[1])
+
+
+def _host_address(record: Dict[str, Any]) -> Optional[str]:
+    inner = record.get(record.get("type"), {})
+    if isinstance(inner, dict) and isinstance(inner.get("address"), str):
+        return inner["address"]
+    return None
+
+
+async def _service_instances(zk: ZKClient, path: str):
+    """Fetch the usable child host records of a service node (children
+    fetched concurrently — one ZK round-trip of gets, not N)."""
+    children = await zk.get_children(path)
+    records = await asyncio.gather(
+        *(_get_record(zk, f"{path}/{child}") for child in children)
+    )
+    instances = []
+    for child, rec in zip(children, records):
+        if rec is None or rec.get("type") == "service":
+            continue
+        if not _usable_for_service(rec.get("type", "")):
+            continue
+        addr = _host_address(rec)
+        if addr is None:
+            continue
+        instances.append((child, rec, addr))
+    return instances
+
+
+async def resolve_a(zk: ZKClient, name: str) -> Resolution:
+    """Answer an A query for ``name`` the way Binder would."""
+    name = name.rstrip(".").lower()
+    path = domain_to_path(name)
+    record = await _get_record(zk, path)
+    res = Resolution()
+    if record is None:
+        return res
+
+    rtype = record.get("type")
+    if rtype != "service":
+        # Direct host-record lookup (README.md:547-552).
+        if not _queryable_directly(rtype or ""):
+            return res  # behaves as though it weren't there (README:284-287)
+        addr = _host_address(record)
+        if addr is not None:
+            res.answers.append(Answer(name, "A", _host_ttl(record), addr))
+        return res
+
+    # Service lookup: one A per usable instance (README.md:522-534); the
+    # A TTL is min(service-chain TTL, host-record TTL) (README.md:752-757).
+    svc_ttl = _service_ttl(record)
+    for _child, rec, addr in await _service_instances(zk, path):
+        res.answers.append(Answer(name, "A", min(svc_ttl, _host_ttl(rec)), addr))
+    return res
+
+
+async def resolve_srv(zk: ZKClient, name: str) -> Resolution:
+    """Answer an SRV query (``_service._proto.domain``) the way Binder would.
+
+    Produces one SRV per port per instance plus A additionals for the
+    instance names (README.md:406-424).
+    """
+    name = name.rstrip(".").lower()
+    labels = name.split(".")
+    res = Resolution()
+    if len(labels) < 3 or not (
+        labels[0].startswith("_") and labels[1].startswith("_")
+    ):
+        return res
+    srvce, proto = labels[0], labels[1]
+    domain = ".".join(labels[2:])
+    path = domain_to_path(domain)
+    record = await _get_record(zk, path)
+    if record is None or record.get("type") != "service":
+        return res
+    svc = record.get("service", {})
+    inner = svc.get("service", {}) if isinstance(svc, dict) else {}
+    if inner.get("srvce") != srvce or inner.get("proto") != proto:
+        return res
+
+    svc_ttl = _service_ttl(record)
+    default_port = inner.get("port")
+    for child, rec, addr in await _service_instances(zk, path):
+        target = f"{child}.{domain}"
+        rec_inner = rec.get(rec.get("type"), {})
+        ports = rec_inner.get("ports") if isinstance(rec_inner, dict) else None
+        if not isinstance(ports, list) or not ports:
+            # "port to use for SRV answers when a child host record does
+            # not contain its own array of ports" (README.md:370-372)
+            ports = [default_port] if default_port is not None else []
+        for port in ports:
+            res.answers.append(
+                Answer(
+                    name, "SRV", svc_ttl,
+                    f"{SRV_PRIORITY} {SRV_WEIGHT} {port} {target}.",
+                )
+            )
+        res.additionals.append(Answer(target, "A", _host_ttl(rec), addr))
+    return res
+
+
+async def resolve(zk: ZKClient, name: str, qtype: str = "A") -> Resolution:
+    """Resolve ``name`` for query type ``qtype`` ("A" or "SRV")."""
+    qtype = qtype.upper()
+    if qtype == "A":
+        return await resolve_a(zk, name)
+    if qtype == "SRV":
+        return await resolve_srv(zk, name)
+    raise ValueError(f"unsupported query type: {qtype}")

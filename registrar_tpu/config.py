@@ -137,7 +137,9 @@ def parse_config(raw: Mapping[str, Any]) -> Config:
 
     max_attempts = raw.get("maxAttempts")
     if max_attempts is not None and (
-        not isinstance(max_attempts, int) or max_attempts < 1
+        not isinstance(max_attempts, int)
+        or isinstance(max_attempts, bool)
+        or max_attempts < 1
     ):
         raise ConfigError("config.maxAttempts must be a positive integer")
     heartbeat_retry = (

@@ -81,6 +81,14 @@ class BunyanFormatter(logging.Formatter):
             + f".{int(record.msecs):03d}Z",
             "v": 0,
         }
+        if logging.getLogger().level <= logging.DEBUG:
+            # bunyan's `src: true` — caller provenance once debugging is on
+            # (the reference enables it the same way, main.js:75-76).
+            rec["src"] = {
+                "file": record.pathname,
+                "line": record.lineno,
+                "func": record.funcName,
+            }
         zdata = getattr(record, "zdata", None)
         if isinstance(zdata, Mapping):
             for key, value in zdata.items():

@@ -117,6 +117,7 @@ async def run(cfg: Config, *, _exit=sys.exit) -> None:
         admin_ip=cfg.admin_ip,
         health_check=cfg.health_check,
         heartbeat_interval=cfg.heartbeat_interval_s,
+        heartbeat_retry=cfg.heartbeat_retry,
     )
 
     ee.on("fail", lambda err: log.error(

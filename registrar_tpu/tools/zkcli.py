@@ -1,0 +1,204 @@
+"""Operator CLI for inspecting service-discovery state in ZooKeeper.
+
+The reference's debugging docs tell operators to poke at znodes with
+ZooKeeper's ``zkCli.sh`` (README.md "Debugging Notes"); this ships the
+equivalent, plus a ``resolve`` command that answers exactly as Binder
+would (see :mod:`registrar_tpu.binderview`), so "what will DNS say?" is
+one command instead of manual tree-walking::
+
+    python -m registrar_tpu.tools.zkcli -s 127.0.0.1:2181 ls /us/joyent
+    python -m registrar_tpu.tools.zkcli -s 127.0.0.1:2181 get /us/joyent/emy-10/authcache
+    python -m registrar_tpu.tools.zkcli -s 127.0.0.1:2181 stat /us/joyent/emy-10/authcache
+    python -m registrar_tpu.tools.zkcli -s 127.0.0.1:2181 tree /us
+    python -m registrar_tpu.tools.zkcli -s 127.0.0.1:2181 rm /us/joyent/emy-10/stale
+    python -m registrar_tpu.tools.zkcli -s 127.0.0.1:2181 resolve authcache.emy-10.joyent.us
+    python -m registrar_tpu.tools.zkcli -s 127.0.0.1:2181 resolve -t SRV _http._tcp.example.joyent.us
+
+Exit status: 0 on success, 1 on ZK errors (e.g. no such node), 2 on usage.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import sys
+from typing import List, Tuple
+
+from registrar_tpu import binderview
+from registrar_tpu.zk.client import ZKClient
+from registrar_tpu.zk.protocol import Stat, ZKError
+
+
+def _parse_servers(value: str) -> List[Tuple[str, int]]:
+    servers = []
+    for part in value.split(","):
+        host, _, port = part.strip().rpartition(":")
+        if not host:
+            raise argparse.ArgumentTypeError(
+                f"expected host:port[,host:port...], got {value!r}"
+            )
+        try:
+            servers.append((host, int(port)))
+        except ValueError:
+            raise argparse.ArgumentTypeError(f"bad port in {part!r}")
+    return servers
+
+
+def _fmt_stat(stat: Stat) -> str:
+    lines = [
+        f"czxid = 0x{stat.czxid:x}",
+        f"mzxid = 0x{stat.mzxid:x}",
+        f"ctime = {stat.ctime}",
+        f"mtime = {stat.mtime}",
+        f"version = {stat.version}",
+        f"cversion = {stat.cversion}",
+        f"ephemeralOwner = 0x{stat.ephemeral_owner:x}",
+        f"dataLength = {stat.data_length}",
+        f"numChildren = {stat.num_children}",
+        f"pzxid = 0x{stat.pzxid:x}",
+    ]
+    return "\n".join(lines)
+
+
+async def _cmd_ls(zk: ZKClient, args) -> int:
+    for child in await zk.get_children(args.path):
+        print(child)
+    return 0
+
+
+async def _cmd_get(zk: ZKClient, args) -> int:
+    data, _ = await zk.get(args.path)
+    if not data:
+        return 0
+    try:
+        print(json.dumps(json.loads(data), indent=2 if args.pretty else None,
+                         separators=None if args.pretty else (",", ":")))
+    except ValueError:
+        sys.stdout.buffer.write(data + b"\n")
+    return 0
+
+
+async def _cmd_stat(zk: ZKClient, args) -> int:
+    print(_fmt_stat(await zk.stat(args.path)))
+    return 0
+
+
+async def _cmd_tree(zk: ZKClient, args) -> int:
+    async def walk(path: str, depth: int) -> None:
+        name = path.rsplit("/", 1)[-1] or "/"
+        data, stat = await zk.get(path)
+        suffix = ""
+        if stat.ephemeral_owner:
+            suffix += f"  [ephemeral 0x{stat.ephemeral_owner:x}]"
+        if data:
+            body = data.decode("utf-8", errors="replace")
+            if len(body) > 60:
+                body = body[:57] + "..."
+            suffix += f"  {body}"
+        print("  " * depth + name + suffix)
+        for child in await zk.get_children(path):
+            base = path.rstrip("/")
+            await walk(f"{base}/{child}", depth + 1)
+
+    await walk(args.path, 0)
+    return 0
+
+
+async def _cmd_rm(zk: ZKClient, args) -> int:
+    await zk.unlink(args.path)
+    return 0
+
+
+async def _cmd_resolve(zk: ZKClient, args) -> int:
+    res = await binderview.resolve(zk, args.name, args.qtype)
+    if res.empty:
+        print(f"no answers for {args.name} ({args.qtype})", file=sys.stderr)
+        return 1
+    for ans in res.answers:
+        print(ans)
+    if res.additionals:
+        print(";; ADDITIONAL:")
+        for ans in res.additionals:
+            print(ans)
+    return 0
+
+
+def build_parser() -> argparse.ArgumentParser:
+    parser = argparse.ArgumentParser(
+        prog="zkcli",
+        description="inspect registrar service-discovery state in ZooKeeper",
+    )
+    parser.add_argument(
+        "-s", "--servers", type=_parse_servers,
+        default=[("127.0.0.1", 2181)], metavar="HOST:PORT[,...]",
+        help="ZooKeeper servers (default 127.0.0.1:2181)",
+    )
+    sub = parser.add_subparsers(dest="command", required=True)
+
+    p = sub.add_parser("ls", help="list children of a znode")
+    p.add_argument("path")
+    p.set_defaults(fn=_cmd_ls)
+
+    p = sub.add_parser("get", help="print a znode's JSON payload")
+    p.add_argument("path")
+    p.add_argument("--pretty", action="store_true", help="indent the JSON")
+    p.set_defaults(fn=_cmd_get)
+
+    p = sub.add_parser("stat", help="print a znode's stat")
+    p.add_argument("path")
+    p.set_defaults(fn=_cmd_stat)
+
+    p = sub.add_parser("tree", help="print a subtree with payloads")
+    p.add_argument("path", nargs="?", default="/")
+    p.set_defaults(fn=_cmd_tree)
+
+    p = sub.add_parser("rm", help="delete a znode")
+    p.add_argument("path")
+    p.set_defaults(fn=_cmd_rm)
+
+    p = sub.add_parser(
+        "resolve", help="answer a DNS query the way Binder would"
+    )
+    p.add_argument("name")
+    p.add_argument("-t", "--qtype", default="A", type=str.upper,
+                   choices=["A", "SRV"])
+    p.set_defaults(fn=_cmd_resolve)
+
+    return parser
+
+
+async def _amain(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    try:
+        zk = await asyncio.wait_for(
+            ZKClient(args.servers, reconnect=False).connect(), timeout=10
+        )
+    except Exception as e:  # noqa: BLE001
+        print(f"zkcli: cannot connect to {args.servers}: {e}", file=sys.stderr)
+        return 1
+    try:
+        return await args.fn(zk, args)
+    except ZKError as e:
+        print(f"zkcli: {e}", file=sys.stderr)
+        return 1
+    finally:
+        await zk.close()
+
+
+def main(argv=None) -> None:
+    try:
+        code = asyncio.run(_amain(argv))
+    except BrokenPipeError:
+        # Output piped into head/grep that exited early: not an error.
+        # Redirect stdout to devnull so the interpreter's shutdown flush
+        # doesn't raise again.
+        import os
+
+        os.dup2(os.open(os.devnull, os.O_WRONLY), sys.stdout.fileno())
+        code = 0
+    sys.exit(code)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,247 @@
+"""Binder-semantics resolution tests, pinned to the reference README's
+worked dig examples (README.md:500-560 authcache, README.md:406-424 SRV).
+
+State is written through our own registration pipeline where possible, so
+these are true end-to-end contract tests: register -> ZooKeeper -> resolve
+exactly as Binder would.
+"""
+
+import asyncio
+
+from registrar_tpu import binderview
+from registrar_tpu.records import host_record, payload_bytes
+from registrar_tpu.register import register
+from registrar_tpu.testing.server import ZKServer
+from registrar_tpu.zk.client import ZKClient
+from registrar_tpu.zk.protocol import CreateFlag
+
+
+async def _pair():
+    server = await ZKServer().start()
+    client = await ZKClient([server.address]).connect()
+    return server, client
+
+
+async def _put_host(client, path, rtype, addr, ttl=None, ports=None):
+    await client.mkdirp(path.rsplit("/", 1)[0])
+    await client.create(
+        path, payload_bytes(host_record(rtype, addr, ttl=ttl, ports=ports)),
+        CreateFlag.EPHEMERAL,
+    )
+
+
+class TestReadmeAuthcacheExample:
+    """README.md:500-560: the authcache service with two redis_host zones."""
+
+    async def _setup(self, client):
+        reg = {
+            "domain": "authcache.emy-10.joyent.us",
+            "type": "redis_host",
+            "ttl": 30,
+            "service": {
+                "type": "service",
+                "service": {
+                    "srvce": "_redis", "proto": "_tcp", "port": 6379, "ttl": 60,
+                },
+                "ttl": 60,
+            },
+        }
+        await register(client, reg, admin_ip="172.27.10.62",
+                       hostname="a2674d3b-a9c4-46bc-a835-b6ce21d522c2",
+                       settle_delay=0)
+        # second instance (a second registrar process in production)
+        await _put_host(
+            client,
+            "/us/joyent/emy-10/authcache/a4ae094d-da07-4911-94f9-c982dc88f3cc",
+            "redis_host", "172.27.10.67", ttl=30, ports=[6379],
+        )
+
+    async def test_service_a_query_lists_both_instances(self):
+        # $ dig authcache.emy-10.joyent.us -> two A answers, TTL 30
+        server, client = await _pair()
+        try:
+            await self._setup(client)
+            res = await binderview.resolve(
+                client, "authcache.emy-10.joyent.us", "A"
+            )
+            assert sorted(a.data for a in res.answers) == [
+                "172.27.10.62", "172.27.10.67",
+            ]
+            assert all(a.ttl == 30 for a in res.answers)  # min(60, 30)
+        finally:
+            await client.close()
+            await server.stop()
+
+    async def test_direct_host_query(self):
+        # $ dig a2674d3b-....authcache.emy-10.joyent.us -> 30 IN A 172.27.10.62
+        server, client = await _pair()
+        try:
+            await self._setup(client)
+            name = ("a2674d3b-a9c4-46bc-a835-b6ce21d522c2"
+                    ".authcache.emy-10.joyent.us")
+            res = await binderview.resolve(client, name, "A")
+            (ans,) = res.answers
+            assert (ans.data, ans.ttl, ans.rtype) == ("172.27.10.62", 30, "A")
+        finally:
+            await client.close()
+            await server.stop()
+
+
+class TestReadmeSrvExample:
+    """README.md:406-424: _http._tcp.example.joyent.us SRV resolution."""
+
+    async def test_srv_answers_and_additionals(self):
+        server, client = await _pair()
+        try:
+            reg = {
+                "domain": "example.joyent.us",
+                "type": "load_balancer",
+                "service": {
+                    "type": "service",
+                    "service": {"srvce": "_http", "proto": "_tcp", "port": 80},
+                },
+            }
+            await register(client, reg, admin_ip="172.27.10.72",
+                           hostname="b44c74d6", settle_delay=0)
+            res = await binderview.resolve(
+                client, "_http._tcp.example.joyent.us", "SRV"
+            )
+            (srv,) = res.answers
+            # _http._tcp.example.joyent.us. 60 IN SRV 0 10 80 b44c74d6.example.joyent.us.
+            assert srv.ttl == 60  # injected service default ttl
+            assert srv.data == "0 10 80 b44c74d6.example.joyent.us."
+            (add,) = res.additionals
+            # b44c74d6.example.joyent.us. 30 IN A 172.27.10.72
+            assert (add.name, add.ttl, add.data) == (
+                "b44c74d6.example.joyent.us", 30, "172.27.10.72",
+            )
+        finally:
+            await client.close()
+            await server.stop()
+
+    async def test_srv_per_port_fanout(self):
+        # SRV-based discovery for multi-process zones (README.md:104-110):
+        # one SRV answer per port in the host record's ports array.
+        server, client = await _pair()
+        try:
+            reg = {
+                "domain": "moray.emy-10.joyent.us",
+                "type": "moray_host",
+                "ports": [2021, 2022, 2023],
+                "service": {
+                    "type": "service",
+                    "service": {"srvce": "_moray", "proto": "_tcp", "port": 2020},
+                },
+            }
+            await register(client, reg, admin_ip="172.27.10.80",
+                           hostname="m0", settle_delay=0)
+            res = await binderview.resolve(
+                client, "_moray._tcp.moray.emy-10.joyent.us", "SRV"
+            )
+            ports = sorted(int(a.data.split()[2]) for a in res.answers)
+            assert ports == [2021, 2022, 2023]
+            assert len(res.additionals) == 1
+        finally:
+            await client.close()
+            await server.stop()
+
+    async def test_srv_mismatched_service_name(self):
+        server, client = await _pair()
+        try:
+            reg = {
+                "domain": "example.joyent.us",
+                "type": "load_balancer",
+                "service": {
+                    "type": "service",
+                    "service": {"srvce": "_http", "proto": "_tcp", "port": 80},
+                },
+            }
+            await register(client, reg, admin_ip="172.27.10.72",
+                           hostname="b44c74d6", settle_delay=0)
+            res = await binderview.resolve(
+                client, "_https._tcp.example.joyent.us", "SRV"
+            )
+            assert res.empty
+        finally:
+            await client.close()
+            await server.stop()
+
+
+class TestTypeTable:
+    """README.md:274-293: queried-directly vs usable-for-service."""
+
+    async def test_ops_host_not_directly_queryable(self):
+        server, client = await _pair()
+        try:
+            await _put_host(client, "/us/test/ops/box1", "ops_host", "10.0.0.1")
+            res = await binderview.resolve(client, "box1.ops.test.us", "A")
+            assert res.empty  # behaves as though it weren't there
+        finally:
+            await client.close()
+            await server.stop()
+
+    async def test_host_type_excluded_from_service(self):
+        server, client = await _pair()
+        try:
+            reg = {
+                "domain": "mixed.test.us",
+                "type": "load_balancer",
+                "service": {
+                    "type": "service",
+                    "service": {"srvce": "_http", "proto": "_tcp", "port": 80},
+                },
+            }
+            await register(client, reg, admin_ip="10.0.0.2",
+                           hostname="lb0", settle_delay=0)
+            # a "host"-type record parked under the same service node
+            await _put_host(client, "/us/test/mixed/plain0", "host", "10.0.0.3")
+            res = await binderview.resolve(client, "mixed.test.us", "A")
+            assert [a.data for a in res.answers] == ["10.0.0.2"]
+            # ...but it still resolves directly
+            direct = await binderview.resolve(client, "plain0.mixed.test.us", "A")
+            assert [a.data for a in direct.answers] == ["10.0.0.3"]
+        finally:
+            await client.close()
+            await server.stop()
+
+    async def test_missing_name_empty(self):
+        server, client = await _pair()
+        try:
+            res = await binderview.resolve(client, "no.such.name", "A")
+            assert res.empty
+        finally:
+            await client.close()
+            await server.stop()
+
+
+class TestConvergence:
+    async def test_two_registrars_one_service(self):
+        """The production story: N independent registrar processes converge
+        on one ZooKeeper ensemble (SURVEY.md §2 'distributed aspect')."""
+        server = await ZKServer().start()
+        c1 = await ZKClient([server.address]).connect()
+        c2 = await ZKClient([server.address]).connect()
+        try:
+            reg = {
+                "domain": "web.prod.us",
+                "type": "load_balancer",
+                "service": {
+                    "type": "service",
+                    "service": {"srvce": "_http", "proto": "_tcp", "port": 80},
+                },
+            }
+            await register(c1, reg, admin_ip="10.1.0.1", hostname="web0",
+                           settle_delay=0)
+            await register(c2, reg, admin_ip="10.1.0.2", hostname="web1",
+                           settle_delay=0)
+            res = await binderview.resolve(c1, "web.prod.us", "A")
+            assert sorted(a.data for a in res.answers) == [
+                "10.1.0.1", "10.1.0.2",
+            ]
+            # one instance dies (session close) -> it leaves DNS
+            await c2.close()
+            res = await binderview.resolve(c1, "web.prod.us", "A")
+            assert [a.data for a in res.answers] == ["10.1.0.1"]
+        finally:
+            await c1.close()
+            await server.stop()

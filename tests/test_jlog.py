@@ -65,6 +65,21 @@ class TestFormat:
         assert rec["err"] == {"message": "bad", "name": "ValueError"}
 
 
+class TestSrc:
+    def test_src_present_at_debug(self):
+        log, buf = _setup(level=logging.DEBUG)
+        log.debug("x")
+        (rec,) = _records(buf)
+        assert rec["src"]["file"].endswith("test_jlog.py")
+        assert isinstance(rec["src"]["line"], int)
+
+    def test_src_absent_at_info(self):
+        log, buf = _setup(level=logging.INFO)
+        log.info("x")
+        (rec,) = _records(buf)
+        assert "src" not in rec
+
+
 class TestLevels:
     def test_env_level(self, monkeypatch):
         monkeypatch.setenv("LOG_LEVEL", "debug")

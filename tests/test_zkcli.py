@@ -1,0 +1,116 @@
+"""zkcli operator tool tests: drive the real CLI against the test server."""
+
+import asyncio
+import json
+import os
+import subprocess
+import sys
+
+from registrar_tpu.records import host_record, payload_bytes
+from registrar_tpu.register import register
+from registrar_tpu.testing.server import ZKServer
+from registrar_tpu.zk.client import ZKClient
+from registrar_tpu.zk.protocol import CreateFlag
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _run_cli(server, *args):
+    return subprocess.run(
+        [sys.executable, "-m", "registrar_tpu.tools.zkcli",
+         "-s", f"{server.host}:{server.port}", *args],
+        cwd=REPO, capture_output=True, text=True, timeout=30,
+        env={**os.environ, "PYTHONPATH": REPO},
+    )
+
+
+async def _seed(server):
+    client = await ZKClient([server.address]).connect()
+    reg = {
+        "domain": "cli.test.us",
+        "type": "load_balancer",
+        "service": {
+            "type": "service",
+            "service": {"srvce": "_http", "proto": "_tcp", "port": 80},
+        },
+    }
+    await register(client, reg, admin_ip="10.5.5.5", hostname="box0",
+                   settle_delay=0)
+    return client
+
+
+class TestZkCli:
+    async def test_ls_get_stat_resolve_rm(self):
+        server = await ZKServer().start()
+        client = await _seed(server)
+        try:
+            out = await asyncio.to_thread(_run_cli, server, "ls", "/us/test/cli")
+            assert out.returncode == 0
+            assert "box0" in out.stdout.splitlines()
+
+            out = await asyncio.to_thread(
+                _run_cli, server, "get", "/us/test/cli/box0"
+            )
+            assert out.returncode == 0
+            rec = json.loads(out.stdout)
+            assert rec["load_balancer"]["address"] == "10.5.5.5"
+
+            out = await asyncio.to_thread(
+                _run_cli, server, "stat", "/us/test/cli/box0"
+            )
+            assert out.returncode == 0
+            assert "ephemeralOwner = 0x" in out.stdout
+            assert "ephemeralOwner = 0x0" not in out.stdout  # it IS ephemeral
+
+            out = await asyncio.to_thread(
+                _run_cli, server, "resolve", "cli.test.us"
+            )
+            assert out.returncode == 0
+            assert "10.5.5.5" in out.stdout
+
+            out = await asyncio.to_thread(
+                _run_cli, server, "resolve", "-t", "SRV",
+                "_http._tcp.cli.test.us",
+            )
+            assert out.returncode == 0
+            assert "0 10 80 box0.cli.test.us." in out.stdout
+            assert "ADDITIONAL" in out.stdout
+
+            out = await asyncio.to_thread(_run_cli, server, "tree", "/us")
+            assert out.returncode == 0
+            assert "box0" in out.stdout
+            assert "[ephemeral" in out.stdout
+
+            out = await asyncio.to_thread(
+                _run_cli, server, "rm", "/us/test/cli/box0"
+            )
+            assert out.returncode == 0
+            assert await client.exists("/us/test/cli/box0") is None
+        finally:
+            await client.close()
+            await server.stop()
+
+    async def test_error_paths(self):
+        server = await ZKServer().start()
+        try:
+            out = await asyncio.to_thread(_run_cli, server, "get", "/missing")
+            assert out.returncode == 1
+            assert "NO_NODE" in out.stderr
+
+            out = await asyncio.to_thread(
+                _run_cli, server, "resolve", "ghost.test.us"
+            )
+            assert out.returncode == 1
+            assert "no answers" in out.stderr
+        finally:
+            await server.stop()
+
+    async def test_unreachable_server(self):
+        proc = subprocess.run(
+            [sys.executable, "-m", "registrar_tpu.tools.zkcli",
+             "-s", "127.0.0.1:1", "ls", "/"],
+            cwd=REPO, capture_output=True, text=True, timeout=30,
+            env={**os.environ, "PYTHONPATH": REPO},
+        )
+        assert proc.returncode == 1
+        assert "cannot connect" in proc.stderr
