@@ -197,6 +197,8 @@ async def resolve_srv(zk: ZKClient, name: str) -> Resolution:
         return res
     svc = record.get("service", {})
     inner = svc.get("service", {}) if isinstance(svc, dict) else {}
+    if not isinstance(inner, dict):
+        return res  # malformed record: resolve as absent, don't crash
     if inner.get("srvce") != srvce or inner.get("proto") != proto:
         return res
 
@@ -210,6 +212,8 @@ async def resolve_srv(zk: ZKClient, name: str) -> Resolution:
             # "port to use for SRV answers when a child host record does
             # not contain its own array of ports" (README.md:370-372)
             ports = [default_port] if default_port is not None else []
+        if not ports:
+            continue  # no SRV answers for this instance -> no orphan A
         for port in ports:
             res.answers.append(
                 Answer(

@@ -81,7 +81,7 @@ class BunyanFormatter(logging.Formatter):
             + f".{int(record.msecs):03d}Z",
             "v": 0,
         }
-        if logging.getLogger().level <= logging.DEBUG:
+        if logging.getLogger(record.name).getEffectiveLevel() <= logging.DEBUG:
             # bunyan's `src: true` — caller provenance once debugging is on
             # (the reference enables it the same way, main.js:75-76).
             rec["src"] = {

@@ -169,6 +169,10 @@ class ZKServer:
         }
         #: number of sessions expired by the sweeper (test observability)
         self.expired_count = 0
+        #: when True, requests are read but never answered (still counted as
+        #: session liveness) — simulates a wedged-but-connected server for
+        #: client watchdog tests
+        self.freeze = False
 
     # -- lifecycle ----------------------------------------------------------
 
@@ -446,6 +450,8 @@ class ZKServer:
                 proto.ReplyHeader(hdr.xid, self.zxid, Err.OK).write(w)
                 await conn.send(w.to_bytes())
                 return
+            if self.freeze:
+                continue  # swallow the request: wedged-server simulation
             reply = await self._dispatch(conn, sess, hdr, r)
             if reply is not None:
                 await conn.send(reply)

@@ -102,6 +102,7 @@ class ZKClient(EventEmitter):
         self._reconnect_task: Optional[asyncio.Task] = None
         self._closed = False
         self._connected = False
+        self._last_response = 0.0  # monotonic time of last server frame
         # one-shot watches to re-arm after reconnect: kind -> set of paths
         self._watch_paths = {"data": set(), "exist": set(), "child": set()}
         self._watch_emitter = EventEmitter()
@@ -185,6 +186,7 @@ class ZKClient(EventEmitter):
         self._reader = reader
         self._writer = writer
         self._connected = True
+        self._last_response = time.monotonic()
         self._read_task = asyncio.create_task(self._read_loop())
         self._ping_task = asyncio.create_task(self._ping_loop())
         if reattached:
@@ -306,6 +308,7 @@ class ZKClient(EventEmitter):
             await self._teardown(expected=False)
 
     def _dispatch_frame(self, payload: bytes) -> None:
+        self._last_response = time.monotonic()
         r = Reader(payload)
         reply = proto.ReplyHeader.read(r)
         if reply.zxid > 0:
@@ -313,6 +316,10 @@ class ZKClient(EventEmitter):
         if reply.xid == proto.XID_NOTIFICATION:
             event = proto.WatcherEvent.read(r)
             self._on_watch_event(event)
+            return
+        if reply.xid == proto.XID_PING:
+            # Pings are fire-and-forget (no _pending entry); their replies
+            # matter only as liveness, recorded in _last_response above.
             return
         if not self._pending:
             log.warning("unmatched reply xid=%d", reply.xid)
@@ -371,15 +378,39 @@ class ZKClient(EventEmitter):
         return await self._submit(self._next_xid(), op, body)
 
     async def _ping_loop(self) -> None:
+        """Session keepalive + server-liveness watchdog.
+
+        Pings every timeout/3.  If the server has produced *no* frame for
+        more than 2/3 of the session timeout — TCP alive but unresponsive —
+        the connection is torn down so the reconnect machinery can find a
+        working server before the session expires (the same policy as the
+        Apache ZooKeeper client's readTimeout)."""
         interval = max(self.negotiated_timeout_ms / 3000.0, 0.02)
+        dead_after = max(self.negotiated_timeout_ms * 2 / 3000.0, 2 * interval)
         try:
             while self._connected:
                 await asyncio.sleep(interval)
                 if not self._connected:
                     return
+                if time.monotonic() - self._last_response > dead_after:
+                    log.warning(
+                        "no server response in %.1fs; dropping connection",
+                        dead_after,
+                    )
+                    await self._teardown(expected=False)
+                    return
                 try:
-                    await self._submit(proto.XID_PING, OpCode.PING, None)
-                except ZKError:
+                    # Fire-and-forget: the reply (whenever it arrives)
+                    # refreshes _last_response via _dispatch_frame; awaiting
+                    # it here would wedge the watchdog behind the very
+                    # stall it exists to detect.
+                    if self._writer is not None:
+                        self._writer.write(
+                            proto.encode_request(proto.XID_PING, OpCode.PING)
+                        )
+                        await self._writer.drain()
+                except (ConnectionError, OSError):
+                    await self._teardown(expected=False)
                     return
         except asyncio.CancelledError:
             raise

@@ -167,6 +167,46 @@ class TestReadmeSrvExample:
             await server.stop()
 
 
+class TestMalformedRecords:
+    async def test_malformed_service_record_resolves_as_absent(self):
+        server, client = await _pair()
+        try:
+            await client.mkdirp("/us/test/bad")
+            await client.put(
+                "/us/test/bad",
+                b'{"type":"service","service":{"service":"oops"}}',
+            )
+            res = await binderview.resolve(client, "_x._tcp.bad.test.us", "SRV")
+            assert res.empty
+            assert res.additionals == []
+        finally:
+            await client.close()
+            await server.stop()
+
+    async def test_instance_without_ports_yields_no_orphan_additional(self):
+        # service record lacking a port + host record lacking ports: no SRV
+        # answers, so no A additionals either (additionals only resolve
+        # names that appear in SRV answers).
+        server, client = await _pair()
+        try:
+            await client.mkdirp("/us/test/noport")
+            await client.put(
+                "/us/test/noport",
+                b'{"type":"service","service":{"type":"service",'
+                b'"service":{"srvce":"_x","proto":"_tcp"}}}',
+            )
+            await _put_host(client, "/us/test/noport/i0", "load_balancer",
+                            "10.0.0.9")
+            res = await binderview.resolve(
+                client, "_x._tcp.noport.test.us", "SRV"
+            )
+            assert res.empty
+            assert res.additionals == []
+        finally:
+            await client.close()
+            await server.stop()
+
+
 class TestTypeTable:
     """README.md:274-293: queried-directly vs usable-for-service."""
 

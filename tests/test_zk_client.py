@@ -265,6 +265,27 @@ class TestSessions:
         finally:
             await server.stop()
 
+    async def test_unresponsive_server_detected_by_watchdog(self):
+        # TCP stays up but the server stops answering: the client must
+        # drop the connection within ~2/3 of the session timeout instead
+        # of letting ops hang forever.
+        server, client = await _pair(timeout_ms=600)
+        try:
+            await client.create("/alive", b"")
+            server.freeze = True
+            disconnected = asyncio.Event()
+            client.on("close", lambda *a: disconnected.set())
+            await asyncio.wait_for(disconnected.wait(), timeout=10)
+            # after the server thaws, the reconnect loop restores service
+            server.freeze = False
+            reconnected = asyncio.Event()
+            client.on("connect", lambda *a: reconnected.set())
+            await asyncio.wait_for(reconnected.wait(), timeout=10)
+            assert await client.exists("/alive") is not None
+        finally:
+            await client.close()
+            await server.stop()
+
     async def test_force_expire_notifies_connected_client(self):
         server, client = await _pair()
         try:
