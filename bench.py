@@ -21,12 +21,13 @@ behavior the survey pins).  The settle-free pipeline cost is reported in
 
 import asyncio
 import json
+import os
 import sys
 import time
 
-sys.path.insert(0, ".")
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
-from registrar_tpu.register import register, unregister  # noqa: E402
+from registrar_tpu.registration import register, unregister  # noqa: E402
 from registrar_tpu.testing.server import ZKServer  # noqa: E402
 from registrar_tpu.zk.client import ZKClient  # noqa: E402
 

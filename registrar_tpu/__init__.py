@@ -18,7 +18,7 @@ Layer map (mirrors SURVEY.md §1):
 
     main.py      CLI/daemon mainline                  (ref main.js)
     agent.py     register_plus orchestrator           (ref lib/index.js)
-    register.py  znode registration pipeline          (ref lib/register.js)
+    registration.py  znode registration pipeline      (ref lib/register.js)
     health.py    periodic command health checker      (ref lib/health.js)
     zk/          ZooKeeper client, written from scratch against the
                  public ZooKeeper 3.4 wire protocol   (ref lib/zk.js + zkplus)
@@ -45,8 +45,8 @@ _EXPORTS = {
     "service_record": "registrar_tpu.records",
     "default_address": "registrar_tpu.records",
     "HOST_RECORD_TYPES": "registrar_tpu.records",
-    "register": "registrar_tpu.register",
-    "unregister": "registrar_tpu.register",
+    "register": "registrar_tpu.registration",
+    "unregister": "registrar_tpu.registration",
     "ZKClient": "registrar_tpu.zk.client",
     "create_zk_client": "registrar_tpu.zk.client",
 }

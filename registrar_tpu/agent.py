@@ -38,10 +38,10 @@ import asyncio
 import logging
 from typing import Any, Mapping, Optional
 
-from registrar_tpu import register as register_mod
+from registrar_tpu import registration as register_mod
 from registrar_tpu.events import EventEmitter
 from registrar_tpu.health import HealthCheck, create_health_check
-from registrar_tpu.register import SETTLE_DELAY_S
+from registrar_tpu.registration import SETTLE_DELAY_S
 from registrar_tpu.retry import RetryPolicy
 from registrar_tpu.zk.client import ZKClient
 

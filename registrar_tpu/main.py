@@ -103,13 +103,20 @@ async def run(cfg: Config, *, _exit=sys.exit) -> None:
     zk.on("connect", lambda *a: log.info("zookeeper: reconnected"))
 
     stopping = asyncio.Event()
+    exit_code = 0
 
-    def on_session_expired(*_a) -> None:
-        log.critical("ZooKeeper session_expired event; exiting")
+    def _die(msg: str) -> None:
+        # Route fatal conditions through the orderly shutdown below rather
+        # than raising SystemExit inside the emitting task: zk.close()
+        # then completes (deleting any half-registered ephemerals
+        # immediately) before the process exits nonzero.
+        nonlocal exit_code
+        log.critical(msg)
+        exit_code = 1
         stopping.set()
-        _exit(1)
 
-    zk.on("session_expired", on_session_expired)
+    zk.on("session_expired",
+          lambda *_a: _die("ZooKeeper session_expired event; exiting"))
 
     ee = register_plus(
         zk,
@@ -123,8 +130,17 @@ async def run(cfg: Config, *, _exit=sys.exit) -> None:
     ee.on("fail", lambda err: log.error(
         "registrar: healthcheck failed", extra={"zdata": {"err": err}}))
     ee.on("ok", lambda: log.info("registrar: healthcheck ok (was down)"))
-    ee.on("error", lambda err: log.error(
-        "registrar: unexpected error", extra={"zdata": {"err": err}}))
+
+    def on_error(err) -> None:
+        log.error("registrar: unexpected error", extra={"zdata": {"err": err}})
+        if not ee.znodes:
+            # Initial registration failed: nothing will retry it (the
+            # reference just logs and idles broken, lib/index.js:46-50).
+            # Exit so the supervisor restarts us — the same crash-restart
+            # policy as session expiry.
+            _die("registrar: initial registration failed; exiting")
+
+    ee.on("error", on_error)
     ee.on("register", lambda nodes: log.info(
         "registrar: registered", extra={"zdata": {"znodes": nodes}}))
     ee.on("unregister", lambda err, nodes: log.warning(
@@ -161,6 +177,8 @@ async def run(cfg: Config, *, _exit=sys.exit) -> None:
     log.info("registrar: shutting down")
     ee.stop()
     await zk.close()  # deletes our ephemerals immediately (see docstring)
+    if exit_code:
+        _exit(exit_code)
 
 
 def main(argv=None) -> None:

@@ -44,7 +44,7 @@ from registrar_tpu.records import (
 from registrar_tpu.zk.client import ZKClient
 from registrar_tpu.zk.protocol import Err, ZKError
 
-log = logging.getLogger("registrar_tpu.register")
+log = logging.getLogger("registrar_tpu.registration")
 
 #: Stage-2 pause before re-creating nodes, reference lib/register.js:232-235.
 SETTLE_DELAY_S = 1.0

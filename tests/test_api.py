@@ -1,0 +1,39 @@
+"""Public API surface test.
+
+The reference flat-re-exports every symbol from health/register/zk beside
+the default register_plus export (reference lib/index.js:184-186), and its
+tests consume that surface (reference test/helper.js:45).  Pin ours.
+"""
+
+import registrar_tpu
+
+
+def test_flat_reexport_surface():
+    # the reference's module surface, translated
+    assert callable(registrar_tpu.register_plus)
+    assert callable(registrar_tpu.register)
+    assert callable(registrar_tpu.unregister)
+    assert callable(registrar_tpu.create_health_check)
+    assert callable(registrar_tpu.create_zk_client)
+    assert callable(registrar_tpu.domain_to_path)
+    assert callable(registrar_tpu.host_record)
+    assert callable(registrar_tpu.service_record)
+    assert callable(registrar_tpu.default_address)
+    assert isinstance(registrar_tpu.HOST_RECORD_TYPES, dict)
+    # classes
+    assert isinstance(registrar_tpu.ZKClient, type)
+    assert isinstance(registrar_tpu.HealthCheck, type)
+    assert isinstance(registrar_tpu.RegistrarEvents, type)
+
+
+def test_version():
+    assert registrar_tpu.__version__
+
+
+def test_unknown_attribute_raises():
+    try:
+        registrar_tpu.nope
+    except AttributeError as e:
+        assert "nope" in str(e)
+    else:
+        raise AssertionError("expected AttributeError")

@@ -10,7 +10,7 @@ import asyncio
 
 from registrar_tpu import binderview
 from registrar_tpu.records import host_record, payload_bytes
-from registrar_tpu.register import register
+from registrar_tpu.registration import register
 from registrar_tpu.testing.server import ZKServer
 from registrar_tpu.zk.client import ZKClient
 from registrar_tpu.zk.protocol import CreateFlag

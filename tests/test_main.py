@@ -123,6 +123,37 @@ class TestEndToEnd:
             await observer.close()
             await server.stop()
 
+    async def test_daemon_exits_when_initial_registration_fails(self, tmp_path):
+        # Reliability fix over the reference (which logs and idles broken,
+        # lib/index.js:46-50): a failed initial registration exits(1) so
+        # the supervisor restarts us.
+        server = await ZKServer().start()
+        try:
+            config = {
+                "registration": {"domain": "bad.test", "type": ""},  # invalid
+                "adminIp": "10.0.0.1",
+                "zookeeper": {
+                    "servers": [{"host": server.host, "port": server.port}],
+                },
+            }
+            cfg_path = tmp_path / "config.json"
+            cfg_path.write_text(json.dumps(config))
+            proc = subprocess.Popen(
+                [sys.executable, "-m", "registrar_tpu", "-f", str(cfg_path)],
+                cwd=REPO, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+                env={**os.environ, "PYTHONPATH": REPO},
+            )
+            try:
+                rc = await asyncio.to_thread(proc.wait, 15)
+                out = proc.stdout.read().decode()
+                assert rc == 1, out
+                assert "initial registration failed" in out
+            finally:
+                if proc.poll() is None:
+                    proc.kill()
+        finally:
+            await server.stop()
+
     async def test_daemon_graceful_stop_drains_immediately(self, tmp_path):
         # SIGTERM: our addition — ephemerals deleted at once, not after
         # session timeout.

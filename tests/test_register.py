@@ -14,7 +14,7 @@ import json
 import pytest
 
 from registrar_tpu.records import parse_payload
-from registrar_tpu.register import register, unregister, znode_paths
+from registrar_tpu.registration import register, unregister, znode_paths
 from registrar_tpu.testing.server import ZKServer
 from registrar_tpu.zk.client import ZKClient
 from registrar_tpu.zk.protocol import ZKError

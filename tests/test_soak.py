@@ -1,0 +1,102 @@
+"""Scale/soak tests: many concurrent registrars against one server.
+
+The production deployment is N independent registrar processes (one per
+zone) converging on one ZooKeeper ensemble (SURVEY.md §2).  The reference
+has no multi-node test story at all; these exercise it.
+"""
+
+import asyncio
+
+from registrar_tpu import binderview
+from registrar_tpu.registration import register, unregister
+from registrar_tpu.testing.server import ZKServer
+from registrar_tpu.zk.client import ZKClient
+
+DOMAIN = "soak.prod.us"
+PATH = "/us/prod/soak"
+N = 25
+
+
+def _reg():
+    return {
+        "domain": DOMAIN,
+        "type": "load_balancer",
+        "service": {
+            "type": "service",
+            "service": {"srvce": "_http", "proto": "_tcp", "port": 80},
+        },
+    }
+
+
+class TestSoak:
+    async def test_many_registrars_converge_and_heartbeat(self):
+        server = await ZKServer().start()
+        clients = []
+        try:
+            clients = await asyncio.gather(
+                *(ZKClient([server.address]).connect() for _ in range(N))
+            )
+            all_nodes = await asyncio.gather(
+                *(
+                    register(c, _reg(), admin_ip=f"10.2.{i // 256}.{i % 256}",
+                             hostname=f"soak{i}", settle_delay=0.01)
+                    for i, c in enumerate(clients)
+                )
+            )
+            # every instance is visible in the Binder view
+            res = await binderview.resolve(clients[0], DOMAIN, "A")
+            assert len(res.answers) == N
+            # all heartbeats succeed concurrently
+            await asyncio.gather(
+                *(c.heartbeat(nodes) for c, nodes in zip(clients, all_nodes))
+            )
+            # half the fleet dies; the survivors' records remain
+            for c in clients[: N // 2]:
+                await c.close()
+            res = await binderview.resolve(clients[-1], DOMAIN, "A")
+            assert len(res.answers) == N - N // 2
+        finally:
+            for c in clients:
+                if not c.closed:
+                    await c.close()
+            await server.stop()
+
+    async def test_register_unregister_churn(self):
+        server = await ZKServer().start()
+        client = await ZKClient([server.address]).connect()
+        try:
+            for i in range(20):
+                nodes = await register(
+                    client, _reg(), admin_ip="10.3.0.1",
+                    hostname="churn", settle_delay=0,
+                )
+                assert await client.exists(nodes[0]) is not None
+                await unregister(client, nodes)
+                assert await client.exists(nodes[0]) is None
+        finally:
+            await client.close()
+            await server.stop()
+
+    async def test_concurrent_same_domain_reregistration_race(self):
+        # Two registrars with the SAME hostname racing (e.g. a stale
+        # process and its replacement): the pipeline's cleanup stage makes
+        # this converge rather than deadlock; last writer owns the node.
+        server = await ZKServer().start()
+        c1 = await ZKClient([server.address]).connect()
+        c2 = await ZKClient([server.address]).connect()
+        try:
+            r1, r2 = await asyncio.gather(
+                register(c1, _reg(), admin_ip="10.4.0.1", hostname="dup",
+                         settle_delay=0.02),
+                register(c2, _reg(), admin_ip="10.4.0.2", hostname="dup",
+                         settle_delay=0.02),
+                return_exceptions=True,
+            )
+            winners = [r for r in (r1, r2) if not isinstance(r, Exception)]
+            assert winners, f"both racers failed: {r1!r} / {r2!r}"
+            st = await c1.stat(f"{PATH}/dup")
+            assert st.ephemeral_owner in (c1.session_id, c2.session_id)
+        finally:
+            await c1.close()
+            await c2.close()
+            await server.stop()
