@@ -27,7 +27,7 @@ from typing import List, Tuple
 
 from registrar_tpu import binderview
 from registrar_tpu.zk.client import ZKClient
-from registrar_tpu.zk.protocol import Stat, ZKError
+from registrar_tpu.zk.protocol import Err, EventType, Stat, ZKError
 
 
 def _parse_servers(value: str) -> List[Tuple[str, int]]:
@@ -110,6 +110,49 @@ async def _cmd_rm(zk: ZKClient, args) -> int:
     return 0
 
 
+async def _cmd_watch(zk: ZKClient, args) -> int:
+    """Stream change events for a path (data + children) until interrupted."""
+    names = {
+        EventType.NODE_CREATED: "created",
+        EventType.NODE_DELETED: "deleted",
+        EventType.NODE_DATA_CHANGED: "dataChanged",
+        EventType.NODE_CHILDREN_CHANGED: "childrenChanged",
+    }
+    queue: asyncio.Queue = asyncio.Queue()
+    zk.watch(args.path, queue.put_nowait)
+
+    async def arm() -> None:
+        # NO_NODE is fine (the exist-watch fires on creation); anything
+        # else — above all CONNECTION_LOSS, since this client does not
+        # reconnect — must surface rather than leave a silent dead watch.
+        try:
+            await zk.stat(args.path, watch=True)
+        except ZKError as err:
+            if err.code != Err.NO_NODE:
+                raise
+        try:
+            await zk.get_children(args.path, watch=True)
+        except ZKError as err:
+            if err.code != Err.NO_NODE:
+                raise
+
+    await arm()
+    print(f"watching {args.path} (ctrl-C to stop)", file=sys.stderr)
+    deadline = asyncio.get_running_loop().time() + args.duration
+    while True:
+        remaining = deadline - asyncio.get_running_loop().time()
+        if args.duration and remaining <= 0:
+            return 0
+        try:
+            ev = await asyncio.wait_for(
+                queue.get(), timeout=remaining if args.duration else None
+            )
+        except asyncio.TimeoutError:
+            return 0
+        print(f"{names.get(ev.type, ev.type)} {ev.path}", flush=True)
+        await arm()  # watches are one-shot; re-arm
+
+
 async def _cmd_resolve(zk: ZKClient, args) -> int:
     res = await binderview.resolve(zk, args.name, args.qtype)
     if res.empty:
@@ -157,6 +200,14 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("path")
     p.set_defaults(fn=_cmd_rm)
 
+    p = sub.add_parser("watch", help="stream change events for a znode")
+    p.add_argument("path")
+    p.add_argument(
+        "--duration", type=float, default=0.0, metavar="SECONDS",
+        help="stop after this many seconds (default: run until ctrl-C)",
+    )
+    p.set_defaults(fn=_cmd_watch)
+
     p = sub.add_parser(
         "resolve", help="answer a DNS query the way Binder would"
     )
@@ -189,6 +240,8 @@ async def _amain(argv=None) -> int:
 def main(argv=None) -> None:
     try:
         code = asyncio.run(_amain(argv))
+    except KeyboardInterrupt:
+        code = 0  # the documented way to stop `watch`
     except BrokenPipeError:
         # Output piped into head/grep that exited early: not an error.
         # Redirect stdout to devnull so the interpreter's shutdown flush

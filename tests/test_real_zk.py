@@ -1,0 +1,102 @@
+"""Opt-in interop tests against a real ZooKeeper ensemble.
+
+The reference's integration tests target a live ZooKeeper selected via
+``ZK_HOST``/``ZK_PORT`` env vars (reference test/helper.js:57-62).  The
+rebuild's suite is hermetic by default, but wire-protocol interop with
+real ZooKeeper still matters: set ``ZK_HOST`` (and optionally
+``ZK_PORT``) to run this module against it, e.g.::
+
+    ZK_HOST=127.0.0.1 ZK_PORT=2181 python -m pytest tests/test_real_zk.py
+
+Skipped automatically when ``ZK_HOST`` is unset.
+"""
+
+import os
+import uuid
+
+import pytest
+
+from registrar_tpu.records import parse_payload
+from registrar_tpu.registration import register, unregister
+from registrar_tpu.zk.client import ZKClient
+from registrar_tpu.zk.protocol import CreateFlag
+
+pytestmark = pytest.mark.skipif(
+    not os.environ.get("ZK_HOST"),
+    reason="set ZK_HOST (and optionally ZK_PORT) to run real-ZooKeeper interop tests",
+)
+
+
+def _servers():
+    return [(os.environ["ZK_HOST"], int(os.environ.get("ZK_PORT", "2181")))]
+
+
+class TestRealZooKeeper:
+    async def test_connect_and_roundtrip(self):
+        client = await ZKClient(_servers()).connect()
+        try:
+            base = f"/registrar-interop-{uuid.uuid4().hex[:8]}"
+            await client.mkdirp(base)
+            path = await client.create(
+                f"{base}/node", b'{"k":"v"}', CreateFlag.EPHEMERAL
+            )
+            data, stat = await client.get(path)
+            assert data == b'{"k":"v"}'
+            assert stat.ephemeral_owner == client.session_id
+            assert await client.get_children(base) == ["node"]
+            await client.unlink(path)
+            await client.unlink(base)
+        finally:
+            await client.close()
+
+    async def test_register_unregister_against_real_zk(self):
+        client = await ZKClient(_servers()).connect()
+        try:
+            domain = f"interop-{uuid.uuid4().hex[:8]}.test.registrar"
+            registration = {
+                "domain": domain,
+                "type": "load_balancer",
+                "service": {
+                    "type": "service",
+                    "service": {"srvce": "_http", "proto": "_tcp", "port": 80},
+                },
+            }
+            nodes = await register(
+                client, registration, admin_ip="10.250.0.1",
+                hostname="interophost", settle_delay=0.05,
+            )
+            for n in nodes:
+                st = await client.stat(n)
+                data, _ = await client.get(n)
+                assert parse_payload(data)["type"] in ("load_balancer", "service")
+            await unregister(client, nodes)
+            for n in nodes:
+                assert await client.exists(n) is None
+            # clean the persistent directory chain we created
+            for p in sorted(
+                {n.rsplit("/", 1)[0] for n in nodes}, key=len, reverse=True
+            ):
+                while p and p != "/":
+                    try:
+                        await client.unlink(p)
+                    except Exception:  # noqa: BLE001 - shared parents may remain
+                        break
+                    p = p.rsplit("/", 1)[0]
+        finally:
+            await client.close()
+
+    async def test_watch_fires_on_real_zk(self):
+        import asyncio
+
+        client = await ZKClient(_servers()).connect()
+        try:
+            path = f"/registrar-interop-watch-{uuid.uuid4().hex[:8]}"
+            await client.create(path, b"x")
+            fired = asyncio.Event()
+            client.watch(path, lambda ev: fired.set())
+            await client.stat(path, watch=True)
+            await client.put(path, b"y")
+            await asyncio.wait_for(fired.wait(), timeout=10)
+            await client.unlink(path)
+        finally:
+            await client.close()

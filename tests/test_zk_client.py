@@ -69,6 +69,21 @@ class TestConnect:
         with pytest.raises(asyncio.CancelledError):
             await task
 
+    async def test_failover_to_live_server_in_list(self):
+        # An ensemble list with dead members: connect() must find the live
+        # one (the reference relies on zkplus for this).
+        server = await ZKServer().start()
+        try:
+            client = await ZKClient(
+                [("127.0.0.1", 1), server.address, ("127.0.0.1", 2)],
+                connect_timeout_ms=200,
+            ).connect()
+            assert client.connected
+            await client.create("/failover", b"")
+            await client.close()
+        finally:
+            await server.stop()
+
     async def test_timeout_negotiation_clamped(self):
         server = await ZKServer(max_session_timeout_ms=5000).start()
         try:

@@ -90,6 +90,35 @@ class TestZkCli:
             await client.close()
             await server.stop()
 
+    async def test_watch_streams_events(self):
+        server = await ZKServer().start()
+        client = await ZKClient([server.address]).connect()
+        try:
+            await client.mkdirp("/w")
+            proc = subprocess.Popen(
+                [sys.executable, "-m", "registrar_tpu.tools.zkcli",
+                 "-s", f"{server.host}:{server.port}",
+                 "watch", "/w", "--duration", "3"],
+                cwd=REPO, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+                text=True, env={**os.environ, "PYTHONPATH": REPO},
+            )
+            try:
+                # the stderr banner is printed after the watches are armed
+                ready = await asyncio.to_thread(proc.stderr.readline)
+                assert "watching /w" in ready
+                await client.create("/w/kid", b"")
+                await client.put("/w", b"new")
+                out, _ = await asyncio.to_thread(proc.communicate, 10)
+            finally:
+                if proc.poll() is None:
+                    proc.kill()
+            events = out.splitlines()
+            assert any("childrenChanged /w" in e for e in events), events
+            assert any("dataChanged /w" in e for e in events), events
+        finally:
+            await client.close()
+            await server.stop()
+
     async def test_error_paths(self):
         server = await ZKServer().start()
         try:
