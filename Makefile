@@ -7,7 +7,7 @@ NAME = registrar
 RELEASE_TARBALL = $(NAME)-release.tar.gz
 RELSTAGEDIR = /tmp/$(NAME)-release
 
-.PHONY: all check test bench release clean
+.PHONY: all check test bench release publish clean
 
 all: check test
 
@@ -29,14 +29,21 @@ bench:
 release:
 	rm -rf $(RELSTAGEDIR)
 	mkdir -p $(RELSTAGEDIR)/opt/registrar/etc
-	cp -r registrar_tpu $(RELSTAGEDIR)/opt/registrar/
-	cp -r systemd $(RELSTAGEDIR)/opt/registrar/
+	cp -r registrar_tpu systemd smf docs $(RELSTAGEDIR)/opt/registrar/
 	cp etc/config.coal.json $(RELSTAGEDIR)/opt/registrar/etc/
 	cp README.md pyproject.toml $(RELSTAGEDIR)/opt/registrar/
 	find $(RELSTAGEDIR) -name __pycache__ -type d | xargs rm -rf
 	tar -czf $(RELEASE_TARBALL) -C $(RELSTAGEDIR) opt
 	rm -rf $(RELSTAGEDIR)
 	@echo "release: $(RELEASE_TARBALL)"
+
+# Parity with the reference's `make publish` (Makefile:70-95 uploads the
+# tarball to a bits directory); here: copy to $(PUBLISH_DIR).
+PUBLISH_DIR ?= /tmp/registrar-bits
+publish: release
+	mkdir -p $(PUBLISH_DIR)
+	cp $(RELEASE_TARBALL) $(PUBLISH_DIR)/
+	@echo "published: $(PUBLISH_DIR)/$(RELEASE_TARBALL)"
 
 clean:
 	rm -f $(RELEASE_TARBALL)

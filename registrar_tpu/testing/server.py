@@ -147,17 +147,37 @@ class ZKServer:
         min_session_timeout_ms: int = 100,
         max_session_timeout_ms: int = 60_000,
         tick_ms: int = 50,
+        snapshot: Optional["ZKServer"] = None,
     ):
+        """``snapshot``: adopt another (stopped) server's tree, sessions,
+        and zxid — models a real ensemble surviving a member restart, so
+        rolling-restart scenarios (client reattaches, ephemerals survive)
+        are testable.  Session expiry countdowns restart from now."""
         self.host = host
         self._requested_port = port
         self.port: Optional[int] = None
         self.min_session_timeout_ms = min_session_timeout_ms
         self.max_session_timeout_ms = max_session_timeout_ms
         self.tick_ms = tick_ms
-        self.root = ZNode(czxid=0, ctime=_now_ms(), mtime=_now_ms())
-        self.zxid = 0
-        self.sessions: Dict[int, Session] = {}
-        self._next_session = int(time.time()) << 24
+        if snapshot is not None:
+            if snapshot._server is not None:
+                raise ValueError(
+                    "snapshot donor must be stopped first (its tree and "
+                    "sessions are adopted by reference)"
+                )
+            self.root = snapshot.root
+            self.zxid = snapshot.zxid
+            self.sessions = snapshot.sessions
+            self._next_session = snapshot._next_session
+            now = time.monotonic()
+            for sess in self.sessions.values():
+                sess.conn = None
+                sess.last_heard = now
+        else:
+            self.root = ZNode(czxid=0, ctime=_now_ms(), mtime=_now_ms())
+            self.zxid = 0
+            self.sessions = {}
+            self._next_session = int(time.time()) << 24
         self._server: Optional[asyncio.AbstractServer] = None
         self._sweeper: Optional[asyncio.Task] = None
         self._conns: Set[_Connection] = set()
@@ -197,6 +217,7 @@ class ZKServer:
         if self._server:
             self._server.close()
             await self._server.wait_closed()
+            self._server = None  # marks this instance as a valid snapshot donor
 
     async def __aenter__(self) -> "ZKServer":
         return await self.start()

@@ -267,6 +267,27 @@ class TestSessions:
             await client.close()
             await server.stop()
 
+    async def test_rolling_restart_preserves_session_and_ephemerals(self):
+        # A real ensemble keeps state across a member restart: the client
+        # reattaches with the same session and its ephemerals survive.
+        server = await ZKServer(port=0).start()
+        port = server.port
+        client = await ZKClient([("127.0.0.1", port)], timeout_ms=60000).connect()
+        try:
+            await client.create("/roll", b"x", CreateFlag.EPHEMERAL)
+            sid = client.session_id
+            reconnected = asyncio.Event()
+            client.on("connect", lambda *a: reconnected.set())
+            await server.stop()
+            server = await ZKServer(port=port, snapshot=server).start()
+            await asyncio.wait_for(reconnected.wait(), timeout=15)
+            assert client.session_id == sid
+            st = await client.stat("/roll")
+            assert st.ephemeral_owner == sid
+        finally:
+            await client.close()
+            await server.stop()
+
     async def test_session_expired_emitted_on_stale_reattach(self):
         server, client = await _pair(timeout_ms=200)
         try:
