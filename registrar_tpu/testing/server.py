@@ -169,10 +169,9 @@ class ZKServer:
             self.zxid = snapshot.zxid
             self.sessions = snapshot.sessions
             self._next_session = snapshot._next_session
-            now = time.monotonic()
+            self._adopted_sessions = True
             for sess in self.sessions.values():
                 sess.conn = None
-                sess.last_heard = now
         else:
             self.root = ZNode(czxid=0, ctime=_now_ms(), mtime=_now_ms())
             self.zxid = 0
@@ -197,6 +196,14 @@ class ZKServer:
     # -- lifecycle ----------------------------------------------------------
 
     async def start(self) -> "ZKServer":
+        if getattr(self, "_adopted_sessions", False):
+            # Expiry countdowns restart when service resumes, not at
+            # construction — a gap between __init__ and start() must not
+            # expire adopted sessions.
+            now = time.monotonic()
+            for sess in self.sessions.values():
+                sess.last_heard = now
+            self._adopted_sessions = False
         self._server = await asyncio.start_server(
             self._handle_conn, self.host, self._requested_port
         )

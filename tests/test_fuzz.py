@@ -1,0 +1,158 @@
+"""Property-based and fuzz tests for the wire layer and data contract.
+
+Two goals: (1) encode/decode are exact inverses for arbitrary valid
+values; (2) arbitrary malformed bytes never produce anything but the
+typed JuteError / a dropped connection — no hangs, no stray exceptions,
+no server crashes.
+"""
+
+import asyncio
+import random
+
+from hypothesis import given, settings, strategies as st
+
+from registrar_tpu.records import (
+    domain_to_path,
+    host_record,
+    parse_payload,
+    path_to_domain,
+    payload_bytes,
+)
+from registrar_tpu.testing.server import ZKServer
+from registrar_tpu.zk import protocol as proto
+from registrar_tpu.zk.jute import INT_MAX, INT_MIN, LONG_MAX, LONG_MIN, JuteError, Reader, Writer
+from registrar_tpu.zk.client import ZKClient
+
+ints = st.integers(INT_MIN, INT_MAX)
+longs = st.integers(LONG_MIN, LONG_MAX)
+
+
+class TestJuteProperties:
+    @given(ints)
+    def test_int_roundtrip(self, v):
+        assert Reader(Writer().write_int(v).to_bytes()).read_int() == v
+
+    @given(longs)
+    def test_long_roundtrip(self, v):
+        assert Reader(Writer().write_long(v).to_bytes()).read_long() == v
+
+    @given(st.one_of(st.none(), st.binary(max_size=2048)))
+    def test_buffer_roundtrip(self, v):
+        assert Reader(Writer().write_buffer(v).to_bytes()).read_buffer() == v
+
+    @given(st.one_of(st.none(), st.text(max_size=256)))
+    def test_ustring_roundtrip(self, v):
+        assert Reader(Writer().write_ustring(v).to_bytes()).read_ustring() == v
+
+    @given(st.lists(st.text(max_size=32), max_size=32))
+    def test_vector_roundtrip(self, v):
+        data = Writer().write_vector(v, Writer.write_ustring).to_bytes()
+        assert Reader(data).read_vector(Reader.read_ustring) == v
+
+    @given(st.binary(max_size=512))
+    @settings(max_examples=300)
+    def test_arbitrary_bytes_never_crash_reader(self, data):
+        """Malformed input must yield JuteError/Unicode errors only."""
+        r = Reader(data)
+        for op in (Reader.read_int, Reader.read_long, Reader.read_bool,
+                   Reader.read_buffer, Reader.read_ustring):
+            try:
+                op(Reader(data))
+            except (JuteError, UnicodeDecodeError):
+                pass
+        try:
+            r.read_vector(Reader.read_ustring)
+        except (JuteError, UnicodeDecodeError):
+            pass
+
+
+class TestRecordProperties:
+    @given(longs, longs, ints, st.integers(0, INT_MAX))
+    def test_stat_roundtrip(self, a, b, c, d):
+        stat = proto.Stat(czxid=a, mzxid=b, version=c, data_length=d)
+        w = Writer()
+        stat.write(w)
+        assert proto.Stat.read(Reader(w.to_bytes())) == stat
+
+    @given(st.binary(max_size=256))
+    @settings(max_examples=200)
+    def test_arbitrary_payload_never_crashes_record_readers(self, data):
+        for record in (proto.ConnectRequest, proto.ConnectResponse,
+                       proto.CreateRequest, proto.ReplyHeader,
+                       proto.WatcherEvent, proto.SetWatches):
+            try:
+                record.read(Reader(data))
+            except (JuteError, UnicodeDecodeError):
+                pass
+
+    @given(
+        st.text(
+            alphabet=st.characters(
+                whitelist_categories=("Ll", "Nd"), max_codepoint=0x7F
+            ),
+            min_size=1, max_size=20,
+        )
+    )
+    def test_domain_path_roundtrip(self, label):
+        domain = f"{label}.example.com"
+        assert path_to_domain(domain_to_path(domain)) == domain
+
+    @given(
+        st.sampled_from(["host", "load_balancer", "redis_host"]),
+        st.one_of(st.none(), st.integers(0, 86400)),
+        st.one_of(st.none(), st.lists(st.integers(1, 65535), max_size=8)),
+    )
+    def test_host_record_payload_roundtrip(self, rtype, ttl, ports):
+        rec = host_record(rtype, "10.0.0.1", ttl=ttl, ports=ports)
+        parsed = parse_payload(payload_bytes(rec))
+        assert parsed == rec
+        assert list(parsed) == list(rec)  # key order preserved
+
+
+class TestServerFuzz:
+    async def test_random_garbage_connections_dont_kill_server(self):
+        rng = random.Random(0xC0FFEE)
+        server = await ZKServer().start()
+        try:
+            for _ in range(30):
+                try:
+                    r, w = await asyncio.open_connection(*server.address)
+                    n = rng.randrange(1, 64)
+                    w.write(bytes(rng.randrange(256) for _ in range(n)))
+                    await w.drain()
+                    w.close()
+                except (ConnectionError, OSError):
+                    pass
+            # server still healthy for a real client
+            client = await ZKClient([server.address]).connect()
+            await client.create("/post-fuzz", b"ok")
+            data, _ = await client.get("/post-fuzz")
+            assert data == b"ok"
+            await client.close()
+        finally:
+            await server.stop()
+
+    async def test_valid_handshake_then_garbage_frames(self):
+        rng = random.Random(0xFACADE)
+        server = await ZKServer().start()
+        try:
+            for _ in range(15):
+                client = ZKClient([server.address], reconnect=False)
+                await client.connect()
+                # inject garbage directly into the socket after handshake
+                payload = bytes(rng.randrange(256) for _ in range(rng.randrange(1, 48)))
+                client._writer.write(proto.frame(payload))
+                try:
+                    await client._writer.drain()
+                except (ConnectionError, OSError):
+                    pass
+                await asyncio.sleep(0)
+                try:
+                    await client.close()
+                except Exception:  # noqa: BLE001 - teardown races are fine here
+                    pass
+            probe = await ZKClient([server.address]).connect()
+            await probe.create("/still-alive", b"")
+            await probe.close()
+        finally:
+            await server.stop()
