@@ -134,4 +134,9 @@ class Reader:
             return None
         if n < -1:
             raise JuteError(f"negative vector length: {n}")
+        if n > self.remaining():
+            # Every element costs >= 1 byte, so a count beyond the buffer
+            # is malformed; reject before allocating the list (a hostile
+            # frame could otherwise declare a 2^31 count).
+            raise JuteError(f"vector length {n} exceeds remaining data")
         return [read_item(self) for _ in range(n)]

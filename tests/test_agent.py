@@ -101,6 +101,43 @@ class TestLifecycle:
             await server.stop()
 
 
+class TestHeartbeatFailure:
+    async def test_failure_backs_off_then_recovers(self, monkeypatch):
+        # SURVEY.md §4 coverage gap: heartbeat-failure backoff. After a
+        # failed probe the loop re-arms at max(interval, 60s) — shrunk
+        # here via monkeypatch — and keeps probing without deregistering.
+        import registrar_tpu.agent as agent_mod
+        from registrar_tpu.retry import RetryPolicy
+
+        monkeypatch.setattr(agent_mod, "HEARTBEAT_FAILURE_BACKOFF_S", 0.1)
+        server, client = await _pair()
+        try:
+            ee = _plus(
+                client, heartbeat_interval=0.03,
+                heartbeat_retry=RetryPolicy(
+                    max_attempts=1, initial_delay=0.01, max_delay=0.01
+                ),
+            )
+            (znodes,) = await ee.wait_for("register", timeout=10)
+            # destroy the node out from under the agent -> probe fails
+            await client.unlink(znodes[0])
+            unregisters = []
+            ee.on("unregister", lambda *a: unregisters.append(a))
+            (err,) = await ee.wait_for("heartbeatFailure", timeout=10)
+            assert err is not None
+            # re-create it; the backed-off loop recovers on its next probe
+            await client.create(znodes[0], b"{}")
+            await ee.wait_for("heartbeat", timeout=10)
+            # heartbeat failure must NOT have deregistered (design: recovery
+            # rides on session expiry or health-check ok; SURVEY.md §3.2)
+            assert unregisters == []
+            assert ee.znodes == znodes  # registration state untouched
+            ee.stop()
+        finally:
+            await client.close()
+            await server.stop()
+
+
 class TestHealthIntegration:
     async def test_fail_deregisters_then_ok_reregisters(self):
         # SURVEY.md §3.3 end to end, with a command whose behavior we flip

@@ -123,6 +123,68 @@ class TestEndToEnd:
             await observer.close()
             await server.stop()
 
+    async def test_daemon_rides_through_zk_rolling_restart(self, tmp_path):
+        # The ensemble restarts (state preserved, as a real quorum would):
+        # the daemon must reattach its session and keep its registration
+        # without restarting.
+        server = await ZKServer(max_session_timeout_ms=30000).start()
+        port = server.port
+        config = {
+            "registration": {"domain": "roll.e2e.registrar", "type": "host",
+                              "heartbeatInterval": 200},
+            "adminIp": "10.66.66.68",
+            "zookeeper": {
+                "servers": [{"host": "127.0.0.1", "port": port}],
+                "timeout": 30000,
+            },
+        }
+        cfg_path = tmp_path / "config.json"
+        cfg_path.write_text(json.dumps(config))
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "registrar_tpu", "-f", str(cfg_path)],
+            cwd=REPO, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            env={**os.environ, "PYTHONPATH": REPO},
+        )
+        try:
+            hostname = socket.gethostname()
+            node = f"/registrar/e2e/roll/{hostname}"
+            observer = await ZKClient([("127.0.0.1", port)]).connect()
+            try:
+                for _ in range(100):
+                    if await observer.exists(node):
+                        break
+                    await asyncio.sleep(0.1)
+                else:
+                    raise AssertionError("znode never appeared")
+            finally:
+                await observer.close()
+
+            await server.stop()
+            await asyncio.sleep(0.5)
+            server = await ZKServer(port=port, snapshot=server).start()
+
+            observer = await ZKClient([("127.0.0.1", port)]).connect()
+            try:
+                # the daemon's ephemeral must still be there (same session)
+                # and the daemon must still be alive
+                for _ in range(100):
+                    st = await observer.exists(node)
+                    if st is not None:
+                        break
+                    await asyncio.sleep(0.1)
+                else:
+                    raise AssertionError("ephemeral did not survive restart")
+                assert st.ephemeral_owner != 0
+                assert proc.poll() is None  # never crashed/restarted
+            finally:
+                await observer.close()
+        finally:
+            if proc.poll() is None:
+                proc.kill()
+                proc.wait(timeout=10)
+            proc.stdout.close()
+            await server.stop()
+
     async def test_daemon_exits_when_initial_registration_fails(self, tmp_path):
         # Reliability fix over the reference (which logs and idles broken,
         # lib/index.js:46-50): a failed initial registration exits(1) so
