@@ -49,6 +49,8 @@ _EXPORTS = {
     "unregister": "registrar_tpu.registration",
     "ZKClient": "registrar_tpu.zk.client",
     "create_zk_client": "registrar_tpu.zk.client",
+    "Op": "registrar_tpu.zk.client",
+    "MultiError": "registrar_tpu.zk.client",
 }
 
 
@@ -72,5 +74,7 @@ __all__ = [
     "unregister",
     "ZKClient",
     "create_zk_client",
+    "Op",
+    "MultiError",
     "__version__",
 ]

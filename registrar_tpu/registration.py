@@ -41,7 +41,7 @@ from registrar_tpu.records import (
     payload_bytes,
     service_record,
 )
-from registrar_tpu.zk.client import ZKClient
+from registrar_tpu.zk.client import Op, ZKClient
 from registrar_tpu.zk.protocol import Err, ZKError
 
 log = logging.getLogger("registrar_tpu.registration")
@@ -158,16 +158,28 @@ async def register(
     return nodes
 
 
-async def unregister(zk: ZKClient, znodes: Sequence[str]) -> None:
+async def unregister(
+    zk: ZKClient, znodes: Sequence[str], atomic: bool = False
+) -> None:
     """Delete the owned znodes, sequentially (reference lib/register.js:254-295).
 
     Every node is processed before this returns (the reference fires its
     callback after the first delete — fixed, see module docstring).  The
     first error aborts the walk and propagates, matching the reference's
     forEachPipeline semantics.
+
+    ``atomic=True`` (beyond the reference's surface) instead deletes all
+    nodes in one ZooKeeper multi transaction: observers never see a
+    half-deregistered host.  Default stays off — the sequential walk is the
+    reference's observable behavior.
     """
     if not isinstance(znodes, Sequence) or isinstance(znodes, (str, bytes)):
         raise ValueError("znodes must be a sequence of paths")
+    if atomic and znodes:
+        log.debug("unregister: atomic delete of %s", list(znodes))
+        await zk.multi([Op.delete(n) for n in znodes])
+        log.debug("unregister: done")
+        return
     for node in znodes:
         log.debug("unregister: deleting %s", node)
         await zk.unlink(node)

@@ -105,6 +105,7 @@ class _Connection:
         try:
             self.writer.write(proto.frame(payload))
             await self.writer.drain()
+            self.server.packets_sent += 1
         except (ConnectionError, OSError):
             await self.close()
 
@@ -135,6 +136,15 @@ class _Connection:
 _WATCH_DATA = "data"
 _WATCH_EXIST = "exist"
 _WATCH_CHILD = "child"
+
+#: admin "four letter word" commands answered on the client port, like real
+#: ZooKeeper (operator runbooks probe ensemble health with `ruok`/`srvr`/
+#: `mntr` — e.g. the checks the reference's README pairs with zkCli.sh).
+_FOUR_LETTER_WORDS = frozenset(
+    w.encode() for w in ("ruok", "srvr", "stat", "mntr", "cons", "dump", "wchs", "isro")
+)
+
+_SERVER_VERSION = "3.4.14-registrar-tpu-testing"
 
 
 class ZKServer:
@@ -188,6 +198,13 @@ class ZKServer:
         }
         #: number of sessions expired by the sweeper (test observability)
         self.expired_count = 0
+        #: request/reply counters surfaced via the 4lw admin commands
+        self.packets_received = 0
+        self.packets_sent = 0
+        # While a multi transaction applies, watch events queue here so the
+        # apply loop never awaits (no other connection's request can
+        # interleave with a half-applied transaction); flushed on commit.
+        self._deferred_events: Optional[List[tuple]] = None
         #: when True, requests are read but never answered (still counted as
         #: session liveness) — simulates a wedged-but-connected server for
         #: client watchdog tests
@@ -273,6 +290,115 @@ class ZKServer:
         walk(start, "" if path == "/" else path.rstrip("/"))
         return out
 
+    # -- 4-letter-word admin commands ---------------------------------------
+
+    def _count_nodes(self) -> Tuple[int, int]:
+        """(znode count, approximate data size) over the whole tree."""
+        count, size = 0, 0
+        stack = [self.root]
+        while stack:
+            node = stack.pop()
+            count += 1
+            size += len(node.data)
+            stack.extend(node.children.values())
+        return count, size
+
+    def _watch_stats(self) -> Tuple[int, int]:
+        """(total watch registrations, distinct watched paths)."""
+        total, paths = 0, set()
+        for kind in self._watches.values():
+            for path, conns in kind.items():
+                total += len(conns)
+                paths.add(path)
+        return total, len(paths)
+
+    def _four_letter(self, cmd: str) -> str:
+        """Answer an admin command with real-ZooKeeper-shaped text."""
+        if cmd == "ruok":
+            return "imok"
+        if cmd == "isro":
+            return "rw"
+        nodes, data_size = self._count_nodes()
+        watches, watched_paths = self._watch_stats()
+        if cmd == "srvr" or cmd == "stat":
+            lines = []
+            if cmd == "stat":
+                lines.append(f"Zookeeper version: {_SERVER_VERSION}")
+                lines.append("Clients:")
+                for conn in self._conns:
+                    peer = conn.writer.get_extra_info("peername") or ("?", 0)
+                    sid = conn.session.session_id if conn.session else 0
+                    lines.append(f" /{peer[0]}:{peer[1]}[1](sid=0x{sid:x})")
+                lines.append("")
+            else:
+                lines.append(f"Zookeeper version: {_SERVER_VERSION}")
+            lines += [
+                "Latency min/avg/max: 0/0/0",
+                f"Received: {self.packets_received}",
+                f"Sent: {self.packets_sent}",
+                f"Connections: {len(self._conns)}",
+                "Outstanding: 0",
+                f"Zxid: 0x{self.zxid:x}",
+                "Mode: standalone",
+                f"Node count: {nodes}",
+            ]
+            return "\n".join(lines) + "\n"
+        if cmd == "mntr":
+            ephemerals = sum(len(s.ephemerals) for s in self.sessions.values())
+            rows = [
+                ("zk_version", _SERVER_VERSION),
+                ("zk_avg_latency", 0),
+                ("zk_packets_received", self.packets_received),
+                ("zk_packets_sent", self.packets_sent),
+                ("zk_num_alive_connections", len(self._conns)),
+                ("zk_outstanding_requests", 0),
+                ("zk_server_state", "standalone"),
+                ("zk_znode_count", nodes),
+                ("zk_watch_count", watches),
+                ("zk_ephemerals_count", ephemerals),
+                ("zk_approximate_data_size", data_size),
+                ("zk_expired_sessions", self.expired_count),
+            ]
+            return "".join(f"{k}\t{v}\n" for k, v in rows)
+        if cmd == "cons":
+            lines = []
+            for conn in self._conns:
+                peer = conn.writer.get_extra_info("peername") or ("?", 0)
+                sid = conn.session.session_id if conn.session else 0
+                timeout = conn.session.timeout_ms if conn.session else 0
+                lines.append(
+                    f" /{peer[0]}:{peer[1]}[1]"
+                    f"(sid=0x{sid:x},to={timeout})"
+                )
+            return "\n".join(lines) + "\n"
+        if cmd == "dump":
+            lines = ["SessionTracker dump:", f"Session Sets ({len(self.sessions)}):"]
+            for sid, sess in sorted(self.sessions.items()):
+                lines.append(f"0x{sid:x}\t{sess.timeout_ms}ms")
+            lines.append("ephemeral nodes dump:")
+            with_eph = {
+                sid: s for sid, s in self.sessions.items() if s.ephemerals
+            }
+            lines.append(f"Sessions with Ephemerals ({len(with_eph)}):")
+            for sid, sess in sorted(with_eph.items()):
+                lines.append(f"0x{sid:x}:")
+                lines.extend(f"\t{p}" for p in sorted(sess.ephemerals))
+            return "\n".join(lines) + "\n"
+        if cmd == "wchs":
+            conns_watching = len(
+                {
+                    id(c)
+                    for kind in self._watches.values()
+                    for conns in kind.values()
+                    for c in conns
+                }
+            )
+            return (
+                f"{conns_watching} connections watching {watched_paths} paths\n"
+                f"Total watches:{watches}\n"
+            )
+        return ""  # unreachable: _FOUR_LETTER_WORDS gates entry
+
     # -- session sweeper ----------------------------------------------------
 
     async def _sweep_loop(self) -> None:
@@ -328,6 +454,12 @@ class ZKServer:
 
     async def _fire_watches(self, kind: str, path: str, ev_type: int) -> None:
         conns = self._watches[kind].pop(path, set())
+        if self._deferred_events is not None:
+            self._deferred_events.append((conns, ev_type, path))
+            return
+        await self._send_watch_events(conns, ev_type, path)
+
+    async def _send_watch_events(self, conns, ev_type: int, path: str) -> None:
         for conn in conns:
             if not conn.closed:
                 await conn.send_event(ev_type, path)
@@ -408,11 +540,195 @@ class ZKServer:
         )
         await self._fire_watches(_WATCH_CHILD, path, EventType.NODE_DELETED)
 
+    async def _set_data_node(
+        self, path: str, data: Optional[bytes], version: int
+    ) -> Stat:
+        try:
+            node = self._resolve(path)
+        except KeyError:
+            raise proto.ZKError(Err.NO_NODE, path)
+        if version != -1 and node.version != version:
+            raise proto.ZKError(Err.BAD_VERSION, path)
+        node.data = data or b""
+        node.version += 1
+        node.mzxid = self._next_zxid()
+        node.mtime = _now_ms()
+        await self._fire_watches(_WATCH_DATA, path, EventType.NODE_DATA_CHANGED)
+        return node.stat()
+
+    # -- multi (atomic transactions) ----------------------------------------
+
+    def _validate_multi(self, ops: List[tuple]) -> None:
+        """Dry-run a transaction against an overlay of the tree.
+
+        Raises the first op's ZKError without touching state, so the apply
+        phase only ever runs transactions that fully succeed (real ZK's
+        PrepRequestProcessor plays the same role).  The overlay tracks
+        existence, version, ephemeral-ness, and child counts per path —
+        enough for create/delete/setData/check semantics, including ops that
+        observe earlier ops in the same transaction.
+        """
+        overlay: Dict[str, Dict[str, object]] = {}
+
+        def lookup(path: str) -> Dict[str, object]:
+            ent = overlay.get(path)
+            if ent is None:
+                try:
+                    node = self._resolve(path)
+                    ent = {
+                        "exists": True,
+                        "version": node.version,
+                        "ephemeral": bool(node.ephemeral_owner),
+                        "nchildren": len(node.children),
+                        "cversion": node.cversion,
+                    }
+                except KeyError:
+                    ent = {
+                        "exists": False, "version": 0,
+                        "ephemeral": False, "nchildren": 0, "cversion": 0,
+                    }
+                overlay[path] = ent
+            return ent
+
+        for index, (op_type, req) in enumerate(ops):
+            try:
+                self._validate_one(op_type, req, lookup)
+            except proto.ZKError as err:
+                err.op_index = index
+                raise
+
+    def _validate_one(self, op_type: int, req, lookup) -> None:
+        try:
+            proto.check_path(req.path)
+        except ValueError:
+            raise proto.ZKError(Err.BAD_ARGUMENTS, req.path)
+        if op_type == OpCode.CREATE:
+            parent_path, _ = self._split(req.path)
+            parent = lookup(parent_path)
+            if not parent["exists"]:
+                raise proto.ZKError(Err.NO_NODE, parent_path)
+            if parent["ephemeral"]:
+                raise proto.ZKError(Err.NO_CHILDREN_FOR_EPHEMERALS, parent_path)
+            sequential = req.flags in (
+                proto.CreateFlag.PERSISTENT_SEQUENTIAL,
+                proto.CreateFlag.EPHEMERAL_SEQUENTIAL,
+            )
+            # Resolve the effective path the apply phase will use —
+            # sequential names derive from the parent's cversion, which the
+            # overlay tracks, so collisions with pre-existing nodes are
+            # caught here instead of aborting mid-apply.
+            path = req.path
+            if sequential:
+                _, name = self._split(req.path)
+                path = (
+                    f"{parent_path.rstrip('/')}/"
+                    f"{name}{parent['cversion']:010d}"
+                )
+            ent = lookup(path)
+            if ent["exists"]:
+                raise proto.ZKError(Err.NODE_EXISTS, path)
+            ent.update(
+                exists=True,
+                version=0,
+                ephemeral=req.flags in (
+                    proto.CreateFlag.EPHEMERAL,
+                    proto.CreateFlag.EPHEMERAL_SEQUENTIAL,
+                ),
+                nchildren=0,
+                cversion=0,  # fresh node — a delete+recreate in the same
+                # txn must not inherit the old node's child counter, or
+                # sequential-name prediction diverges from the apply phase
+            )
+            parent["nchildren"] += 1
+            parent["cversion"] = int(parent["cversion"]) + 1
+        elif op_type == OpCode.DELETE:
+            ent = lookup(req.path)
+            if not ent["exists"]:
+                raise proto.ZKError(Err.NO_NODE, req.path)
+            if req.version != -1 and ent["version"] != req.version:
+                raise proto.ZKError(Err.BAD_VERSION, req.path)
+            if ent["nchildren"]:
+                raise proto.ZKError(Err.NOT_EMPTY, req.path)
+            ent["exists"] = False
+            parent = lookup(self._split(req.path)[0])
+            parent["nchildren"] -= 1
+            parent["cversion"] = int(parent["cversion"]) + 1
+        elif op_type in (OpCode.SET_DATA, OpCode.CHECK):
+            ent = lookup(req.path)
+            if not ent["exists"]:
+                raise proto.ZKError(Err.NO_NODE, req.path)
+            if req.version != -1 and ent["version"] != req.version:
+                raise proto.ZKError(Err.BAD_VERSION, req.path)
+            if op_type == OpCode.SET_DATA:
+                ent["version"] = int(ent["version"]) + 1
+        else:
+            raise proto.ZKError(Err.UNIMPLEMENTED, req.path)
+
+    async def _multi(
+        self, req: proto.MultiRequest, sess: Session
+    ) -> proto.MultiResponse:
+        """Atomically apply a transaction (validate first, then apply).
+
+        On failure nothing is applied and the per-op results carry the
+        failing op's code with RUNTIME_INCONSISTENCY for the rest — the
+        documented ZooKeeper multi abort contract.
+        """
+        try:
+            self._validate_multi(req.ops)
+        except proto.ZKError as err:
+            failed_at = getattr(err, "op_index", 0)
+            return proto.MultiResponse(
+                results=[
+                    proto.ErrorResult(
+                        err=err.code if i == failed_at
+                        else Err.RUNTIME_INCONSISTENCY
+                    )
+                    for i in range(len(req.ops))
+                ]
+            )
+
+        # Apply with watch delivery deferred: the tree mutations below never
+        # await, so the whole transaction commits within one event-loop step
+        # (no other client's request — nor another multi — can observe or
+        # create a half-applied state).  Validation above guarantees every
+        # op succeeds, including sequential-name collisions.
+        results = []
+        self._deferred_events = []
+        try:
+            for op_type, op_req in req.ops:
+                if op_type == OpCode.CREATE:
+                    path = await self._create_node(
+                        op_req.path, op_req.data, op_req.flags, sess
+                    )
+                    results.append(proto.CreateResponse(path=path))
+                elif op_type == OpCode.DELETE:
+                    try:
+                        await self._delete_node(op_req.path, op_req.version)
+                    except KeyError:
+                        raise proto.ZKError(
+                            Err.RUNTIME_INCONSISTENCY, op_req.path
+                        )
+                    results.append(proto._DeleteResult())
+                elif op_type == OpCode.SET_DATA:
+                    stat = await self._set_data_node(
+                        op_req.path, op_req.data, op_req.version
+                    )
+                    results.append(proto.SetDataResponse(stat=stat))
+                else:  # OpCode.CHECK — validated above, nothing to apply
+                    results.append(proto._CheckResult())
+        finally:
+            deferred, self._deferred_events = self._deferred_events, None
+        for conns, ev_type, path in deferred:
+            await self._send_watch_events(conns, ev_type, path)
+        return proto.MultiResponse(results=results)
+
     # -- connection handling ------------------------------------------------
 
-    async def _read_frame(self, reader) -> Optional[bytes]:
+    async def _read_frame(
+        self, reader, header: Optional[bytes] = None
+    ) -> Optional[bytes]:
         try:
-            hdr = await reader.readexactly(4)
+            hdr = header if header is not None else await reader.readexactly(4)
         except (asyncio.IncompleteReadError, ConnectionError, OSError):
             return None
         length = int.from_bytes(hdr, "big", signed=True)
@@ -438,8 +754,25 @@ class ZKServer:
             await conn.close()
 
     async def _serve(self, conn: _Connection) -> None:
-        # --- handshake ---
-        payload = await self._read_frame(conn.reader)
+        # --- handshake (or a 4-letter-word admin command) ---
+        # Real ZooKeeper multiplexes admin "four letter words" (ruok, srvr,
+        # stat, mntr, ...) onto the client port: 4 ASCII bytes instead of a
+        # length-prefixed frame.  A genuine frame header is a small
+        # big-endian length (<16 MiB), so its first byte is 0x00 — ASCII
+        # command bytes are unambiguous.
+        try:
+            first4 = await conn.reader.readexactly(4)
+        except (asyncio.IncompleteReadError, ConnectionError, OSError):
+            return
+        if first4 in _FOUR_LETTER_WORDS:
+            text = self._four_letter(first4.decode("ascii"))
+            try:
+                conn.writer.write(text.encode())
+                await conn.writer.drain()
+            except (ConnectionError, OSError):
+                pass
+            return
+        payload = await self._read_frame(conn.reader, header=first4)
         if payload is None:
             return
         req = proto.ConnectRequest.read(Reader(payload))
@@ -469,6 +802,7 @@ class ZKServer:
             payload = await self._read_frame(conn.reader)
             if payload is None:
                 return
+            self.packets_received += 1
             sess.last_heard = time.monotonic()
             r = Reader(payload)
             hdr = proto.RequestHeader.read(r)
@@ -559,21 +893,9 @@ class ZKServer:
             if op == OpCode.SET_DATA:
                 req = proto.SetDataRequest.read(r)
                 proto.check_path(req.path)
-                try:
-                    node = self._resolve(req.path)
-                except KeyError:
-                    raise proto.ZKError(Err.NO_NODE, req.path)
-                if req.version != -1 and node.version != req.version:
-                    raise proto.ZKError(Err.BAD_VERSION, req.path)
-                node.data = req.data or b""
-                node.version += 1
-                node.mzxid = self._next_zxid()
-                node.mtime = _now_ms()
-                await self._fire_watches(
-                    _WATCH_DATA, req.path, EventType.NODE_DATA_CHANGED
-                )
+                stat = await self._set_data_node(req.path, req.data, req.version)
                 return self._reply(
-                    hdr.xid, Err.OK, proto.SetDataResponse(stat=node.stat())
+                    hdr.xid, Err.OK, proto.SetDataResponse(stat=stat)
                 )
             if op in (OpCode.GET_CHILDREN, OpCode.GET_CHILDREN2):
                 req = proto.GetChildrenRequest.read(r)
@@ -626,11 +948,26 @@ class ZKServer:
                         self._add_watch(_WATCH_CHILD, p, conn)
                 return self._reply(hdr.xid, Err.OK)
             if op == OpCode.SYNC:
-                path = r.read_ustring()
-                w = Writer()
-                proto.ReplyHeader(hdr.xid, self.zxid, Err.OK).write(w)
-                w.write_ustring(path)
-                return w.to_bytes()
+                req = proto.SyncRequest.read(r)
+                # Single-node server: everything is already committed, so
+                # sync degenerates to an ordering barrier through the
+                # request pipeline (real ZK flushes the leader pipeline).
+                return self._reply(
+                    hdr.xid, Err.OK, proto.SyncResponse(path=req.path)
+                )
+            if op == OpCode.MULTI:
+                req = proto.MultiRequest.read(r)
+                return self._reply(hdr.xid, Err.OK, await self._multi(req, sess))
+            if op == OpCode.CHECK:
+                req = proto.CheckVersionRequest.read(r)
+                proto.check_path(req.path)
+                try:
+                    node = self._resolve(req.path)
+                except KeyError:
+                    raise proto.ZKError(Err.NO_NODE, req.path)
+                if req.version != -1 and node.version != req.version:
+                    raise proto.ZKError(Err.BAD_VERSION, req.path)
+                return self._reply(hdr.xid, Err.OK)
             log.warning("unimplemented opcode %d", op)
             return self._reply(hdr.xid, Err.UNIMPLEMENTED)
         except proto.ZKError as e:

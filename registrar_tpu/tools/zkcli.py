@@ -13,6 +13,7 @@ one command instead of manual tree-walking::
     python -m registrar_tpu.tools.zkcli -s 127.0.0.1:2181 rm /us/joyent/emy-10/stale
     python -m registrar_tpu.tools.zkcli -s 127.0.0.1:2181 resolve authcache.emy-10.joyent.us
     python -m registrar_tpu.tools.zkcli -s 127.0.0.1:2181 resolve -t SRV _http._tcp.example.joyent.us
+    python -m registrar_tpu.tools.zkcli -s 127.0.0.1:2181 admin ruok
 
 Exit status: 0 on success, 1 on ZK errors (e.g. no such node), 2 on usage.
 """
@@ -153,6 +154,42 @@ async def _cmd_watch(zk: ZKClient, args) -> int:
         await arm()  # watches are one-shot; re-arm
 
 
+async def _cmd_admin(args) -> int:
+    """Send a 4-letter-word admin command to every server, raw TCP.
+
+    These are connection-less health probes (no ZK session), answered by
+    real ZooKeeper and by the in-process test server alike — `ruok` is the
+    standard "is this ensemble member alive" check in operator runbooks.
+    """
+    failures = 0
+    for host, port in args.servers:
+        if len(args.servers) > 1:
+            print(f";; {host}:{port}")
+        writer = None
+        try:
+            reader, writer = await asyncio.wait_for(
+                asyncio.open_connection(host, port), timeout=5
+            )
+            writer.write(args.word.encode("ascii"))
+            await writer.drain()
+            # The server closes the connection after answering; read to EOF
+            # (a single read() can return one TCP segment of a longer
+            # mntr/dump response).
+            out = await asyncio.wait_for(reader.read(), timeout=5)
+            print(out.decode(errors="replace").rstrip("\n"))
+        except (OSError, asyncio.TimeoutError) as e:
+            print(f"zkcli: {host}:{port}: {e!r}", file=sys.stderr)
+            failures += 1
+        finally:
+            if writer is not None:
+                writer.close()
+                try:
+                    await writer.wait_closed()
+                except (OSError, asyncio.TimeoutError):
+                    pass
+    return 1 if failures else 0
+
+
 async def _cmd_resolve(zk: ZKClient, args) -> int:
     res = await binderview.resolve(zk, args.name, args.qtype)
     if res.empty:
@@ -209,6 +246,16 @@ def build_parser() -> argparse.ArgumentParser:
     p.set_defaults(fn=_cmd_watch)
 
     p = sub.add_parser(
+        "admin",
+        help="send a 4-letter-word admin command (ruok/srvr/stat/mntr/...)",
+    )
+    p.add_argument(
+        "word",
+        choices=["ruok", "srvr", "stat", "mntr", "cons", "dump", "wchs", "isro"],
+    )
+    p.set_defaults(fn=_cmd_admin, raw=True)
+
+    p = sub.add_parser(
         "resolve", help="answer a DNS query the way Binder would"
     )
     p.add_argument("name")
@@ -221,6 +268,9 @@ def build_parser() -> argparse.ArgumentParser:
 
 async def _amain(argv=None) -> int:
     args = build_parser().parse_args(argv)
+    if getattr(args, "raw", False):
+        # Admin probes speak raw TCP per server; no ZK session involved.
+        return await args.fn(args)
     try:
         zk = await asyncio.wait_for(
             ZKClient(args.servers, reconnect=False).connect(), timeout=10

@@ -549,6 +549,48 @@ class ZKClient(EventEmitter):
         """Register a listener for one-shot watch events on ``path``."""
         self._watch_emitter.on(path, listener)
 
+    # -- transactions / sync (full ZooKeeper 3.4 surface) --------------------
+
+    async def sync(self, path: str) -> str:
+        """Flush the server's commit pipeline for ``path`` (read barrier).
+
+        A follower answers reads from possibly-stale local state; sync
+        forces it to catch up with the leader first.  Beyond the reference's
+        surface (zkplus never exposed it) — useful before read-backs in
+        multi-server deployments.
+        """
+        check_path(path)
+        r = await self._call(OpCode.SYNC, proto.SyncRequest(path=path))
+        return proto.SyncResponse.read(r).path
+
+    async def multi(self, ops: Sequence[Tuple[int, object]]) -> List[object]:
+        """Atomically apply a transaction of :class:`Op` operations.
+
+        Returns per-op results (created path str, :class:`Stat`, or None for
+        delete/check).  On abort nothing is applied and :class:`MultiError`
+        is raised carrying per-op error codes.  Beyond the reference's
+        surface; enables e.g. atomic unregistration
+        (:func:`registrar_tpu.registration.unregister` ``atomic=True``).
+        """
+        ops = list(ops)
+        if not ops:
+            return []
+        for _, record in ops:
+            check_path(record.path)
+        r = await self._call(OpCode.MULTI, proto.MultiRequest(ops=ops))
+        resp = proto.MultiResponse.read(r)
+        if any(isinstance(res, proto.ErrorResult) for res in resp.results):
+            raise MultiError([res.err for res in resp.results])
+        out: List[object] = []
+        for res in resp.results:
+            if isinstance(res, proto.CreateResponse):
+                out.append(res.path)
+            elif isinstance(res, proto.SetDataResponse):
+                out.append(res.stat)
+            else:
+                out.append(None)
+        return out
+
     # -- application heartbeat (reference lib/zk.js:21-59) -------------------
 
     async def heartbeat(
@@ -573,6 +615,63 @@ class ZKClient(EventEmitter):
                     raise res
 
         await call_with_backoff(check, retry or HEARTBEAT_RETRY)
+
+
+class Op:
+    """Operation constructors for :meth:`ZKClient.multi`."""
+
+    @staticmethod
+    def create(
+        path: str,
+        data: bytes = b"",
+        flags: int = CreateFlag.PERSISTENT,
+        acls=None,
+    ) -> Tuple[int, proto.CreateRequest]:
+        return (
+            OpCode.CREATE,
+            proto.CreateRequest(
+                path=path,
+                data=data,
+                acls=list(acls) if acls is not None else list(OPEN_ACL_UNSAFE),
+                flags=flags,
+            ),
+        )
+
+    @staticmethod
+    def delete(path: str, version: int = -1) -> Tuple[int, proto.DeleteRequest]:
+        return (OpCode.DELETE, proto.DeleteRequest(path=path, version=version))
+
+    @staticmethod
+    def set_data(
+        path: str, data: bytes, version: int = -1
+    ) -> Tuple[int, proto.SetDataRequest]:
+        return (
+            OpCode.SET_DATA,
+            proto.SetDataRequest(path=path, data=data, version=version),
+        )
+
+    @staticmethod
+    def check(path: str, version: int) -> Tuple[int, proto.CheckVersionRequest]:
+        return (
+            OpCode.CHECK,
+            proto.CheckVersionRequest(path=path, version=version),
+        )
+
+
+class MultiError(ZKError):
+    """An aborted transaction: ``results`` holds each op's error code
+    (the failing op's real code; RUNTIME_INCONSISTENCY for the rest)."""
+
+    def __init__(self, results: List[int]):
+        self.results = results
+        first = next(
+            (
+                code for code in results
+                if code not in (Err.OK, Err.RUNTIME_INCONSISTENCY)
+            ),
+            results[0] if results else Err.SYSTEM_ERROR,
+        )
+        super().__init__(first)
 
 
 class SessionExpiredError(ZKError):

@@ -491,6 +491,182 @@ class WatcherEvent:
         return cls(type=r.read_int(), state=r.read_int(), path=r.read_ustring())
 
 
+# --- sync ------------------------------------------------------------------
+
+@dataclass
+class SyncRequest:
+    path: str
+
+    def write(self, w: Writer) -> None:
+        w.write_ustring(self.path)
+
+    @classmethod
+    def read(cls, r: Reader) -> "SyncRequest":
+        return cls(path=r.read_ustring())
+
+
+@dataclass
+class SyncResponse:
+    path: str
+
+    def write(self, w: Writer) -> None:
+        w.write_ustring(self.path)
+
+    @classmethod
+    def read(cls, r: Reader) -> "SyncResponse":
+        return cls(path=r.read_ustring())
+
+
+# --- multi (transactions) ---------------------------------------------------
+#
+# A multi is an atomic batch of {create, delete, setData, check} ops.  On the
+# wire each op is prefixed by a MultiHeader; a header with done=True (type -1)
+# terminates the sequence.  Responses mirror the structure: per-op result
+# records, or ErrorResult entries when the transaction aborted (the failing
+# op carries its error code, the others RUNTIME_INCONSISTENCY).  The
+# reference never batches (zkplus predates multi) — this exists so the
+# rebuild's transport exposes the full modern ZooKeeper 3.4 surface, e.g.
+# for atomic unregistration.
+
+@dataclass
+class CheckVersionRequest:
+    path: str
+    version: int
+
+    def write(self, w: Writer) -> None:
+        w.write_ustring(self.path)
+        w.write_int(self.version)
+
+    @classmethod
+    def read(cls, r: Reader) -> "CheckVersionRequest":
+        return cls(path=r.read_ustring(), version=r.read_int())
+
+
+@dataclass
+class MultiHeader:
+    type: int
+    done: bool
+    err: int
+
+    def write(self, w: Writer) -> None:
+        w.write_int(self.type)
+        w.write_bool(self.done)
+        w.write_int(self.err)
+
+    @classmethod
+    def read(cls, r: Reader) -> "MultiHeader":
+        return cls(type=r.read_int(), done=r.read_bool(), err=r.read_int())
+
+
+#: op type -> request record class admissible inside a multi
+MULTI_REQUESTS = {
+    OpCode.CREATE: CreateRequest,
+    OpCode.DELETE: DeleteRequest,
+    OpCode.SET_DATA: SetDataRequest,
+    OpCode.CHECK: CheckVersionRequest,
+}
+
+_MULTI_DONE = MultiHeader(type=-1, done=True, err=-1)
+
+
+@dataclass
+class ErrorResult:
+    """Per-op failure marker inside an aborted multi response."""
+
+    err: int
+
+    def write(self, w: Writer) -> None:
+        w.write_int(self.err)
+
+    @classmethod
+    def read(cls, r: Reader) -> "ErrorResult":
+        return cls(err=r.read_int())
+
+
+@dataclass
+class MultiRequest:
+    """Ordered (op_type, request_record) pairs forming one transaction."""
+
+    ops: List[tuple]
+
+    def write(self, w: Writer) -> None:
+        for op_type, record in self.ops:
+            MultiHeader(type=op_type, done=False, err=-1).write(w)
+            record.write(w)
+        _MULTI_DONE.write(w)
+
+    @classmethod
+    def read(cls, r: Reader) -> "MultiRequest":
+        ops: List[tuple] = []
+        while True:
+            hdr = MultiHeader.read(r)
+            if hdr.done:
+                return cls(ops=ops)
+            req_cls = MULTI_REQUESTS.get(hdr.type)
+            if req_cls is None:
+                raise ValueError(f"op type {hdr.type} not allowed in multi")
+            ops.append((hdr.type, req_cls.read(r)))
+
+
+@dataclass
+class MultiResponse:
+    """Per-op results: CreateResponse | SetDataResponse | None (delete/check
+    ok) | ErrorResult."""
+
+    results: List[object]
+
+    def write(self, w: Writer) -> None:
+        for result in self.results:
+            if isinstance(result, ErrorResult):
+                MultiHeader(type=OpCode.ERROR, done=False, err=result.err).write(w)
+                result.write(w)
+                continue
+            if isinstance(result, CreateResponse):
+                op_type = OpCode.CREATE
+            elif isinstance(result, SetDataResponse):
+                op_type = OpCode.SET_DATA
+            elif isinstance(result, _DeleteResult):
+                op_type = OpCode.DELETE
+            elif isinstance(result, _CheckResult):
+                op_type = OpCode.CHECK
+            else:
+                raise ValueError(f"bad multi result {result!r}")
+            MultiHeader(type=op_type, done=False, err=0).write(w)
+            if not isinstance(result, (_DeleteResult, _CheckResult)):
+                result.write(w)
+        _MULTI_DONE.write(w)
+
+    @classmethod
+    def read(cls, r: Reader) -> "MultiResponse":
+        results: List[object] = []
+        while True:
+            hdr = MultiHeader.read(r)
+            if hdr.done:
+                return cls(results=results)
+            if hdr.type == OpCode.ERROR:
+                results.append(ErrorResult.read(r))
+            elif hdr.type == OpCode.CREATE:
+                results.append(CreateResponse.read(r))
+            elif hdr.type == OpCode.SET_DATA:
+                results.append(SetDataResponse.read(r))
+            elif hdr.type == OpCode.DELETE:
+                results.append(_DeleteResult())
+            elif hdr.type == OpCode.CHECK:
+                results.append(_CheckResult())
+            else:
+                raise ValueError(f"bad multi result type {hdr.type}")
+
+
+@dataclass
+class _DeleteResult:
+    """Successful delete inside a multi (no payload on the wire)."""
+
+
+@dataclass
+class _CheckResult:
+    """Successful version check inside a multi (no payload on the wire)."""
+
+
 # --- framing helpers -------------------------------------------------------
 
 def frame(payload: bytes) -> bytes:

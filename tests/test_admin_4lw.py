@@ -1,0 +1,155 @@
+"""Four-letter-word admin interface: server responder + zkcli admin command.
+
+Real ZooKeeper answers connection-less admin probes (ruok, srvr, stat,
+mntr, cons, dump, wchs, isro) on the client port; operator runbooks use
+them as the standard ensemble-health checks alongside zkCli.sh (the
+workflow the reference's README "Debugging Notes" documents).  The test
+server mirrors that, so ops tooling can be exercised hermetically.
+"""
+
+import asyncio
+import os
+import subprocess
+import sys
+
+from registrar_tpu.registration import register
+from registrar_tpu.testing.server import ZKServer
+from registrar_tpu.zk.client import ZKClient
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+async def _probe(server, word: str) -> str:
+    reader, writer = await asyncio.open_connection(server.host, server.port)
+    writer.write(word.encode())
+    await writer.drain()
+    out = await asyncio.wait_for(reader.read(1 << 20), timeout=5)
+    writer.close()
+    return out.decode()
+
+
+class TestFourLetterWords:
+    async def test_ruok_imok(self):
+        async with ZKServer() as server:
+            assert await _probe(server, "ruok") == "imok"
+
+    async def test_isro_rw(self):
+        async with ZKServer() as server:
+            assert await _probe(server, "isro") == "rw"
+
+    async def test_srvr_fields(self):
+        async with ZKServer() as server:
+            client = await ZKClient([server.address]).connect()
+            try:
+                await client.create("/x", b"abc")
+                out = await _probe(server, "srvr")
+            finally:
+                await client.close()
+            assert "Zookeeper version:" in out
+            assert "Mode: standalone" in out
+            # root + /x
+            assert "Node count: 2" in out
+            assert "Zxid: 0x1" in out
+
+    async def test_stat_lists_clients(self):
+        async with ZKServer() as server:
+            client = await ZKClient([server.address]).connect()
+            try:
+                out = await _probe(server, "stat")
+                assert "Clients:" in out
+                assert f"sid=0x{client.session_id:x}" in out
+            finally:
+                await client.close()
+
+    async def test_mntr_counts_ephemerals_and_watches(self):
+        async with ZKServer() as server:
+            client = await ZKClient([server.address]).connect()
+            try:
+                await register(
+                    client,
+                    {"domain": "mntr.test.us", "type": "host"},
+                    admin_ip="10.0.0.9",
+                    hostname="mhost",
+                    settle_delay=0,
+                )
+                await client.get("/us/test/mntr/mhost", watch=True)
+                out = await _probe(server, "mntr")
+                fields = dict(
+                    line.split("\t", 1) for line in out.splitlines() if line
+                )
+                assert fields["zk_server_state"] == "standalone"
+                assert fields["zk_ephemerals_count"] == "1"
+                assert fields["zk_watch_count"] == "1"
+                assert int(fields["zk_znode_count"]) >= 4
+                assert int(fields["zk_packets_received"]) > 0
+            finally:
+                await client.close()
+
+    async def test_dump_lists_sessions_with_ephemerals(self):
+        async with ZKServer() as server:
+            client = await ZKClient([server.address]).connect()
+            try:
+                await client.mkdirp("/d")
+                from registrar_tpu.zk.protocol import CreateFlag
+
+                await client.create("/d/e", b"", CreateFlag.EPHEMERAL)
+                out = await _probe(server, "dump")
+                assert f"0x{client.session_id:x}" in out
+                assert "\t/d/e" in out
+            finally:
+                await client.close()
+
+    async def test_wchs_summarizes_watches(self):
+        async with ZKServer() as server:
+            client = await ZKClient([server.address]).connect()
+            try:
+                await client.create("/w", b"")
+                await client.get("/w", watch=True)
+                await client.get_children("/", watch=True)
+                out = await _probe(server, "wchs")
+                assert "connections watching 2 paths" in out
+                assert "Total watches:2" in out
+            finally:
+                await client.close()
+
+    async def test_admin_probe_does_not_disturb_sessions(self):
+        # A 4lw probe is a throwaway connection: existing ZK sessions and
+        # the protocol path must be unaffected.
+        async with ZKServer() as server:
+            client = await ZKClient([server.address]).connect()
+            try:
+                await client.create("/alive", b"")
+                await _probe(server, "ruok")
+                await _probe(server, "mntr")
+                assert await client.exists("/alive") is not None
+                assert client.connected
+            finally:
+                await client.close()
+
+
+class TestZkCliAdmin:
+    async def test_zkcli_admin_ruok(self):
+        async with ZKServer() as server:
+            out = await asyncio.to_thread(
+                subprocess.run,
+                [
+                    sys.executable, "-m", "registrar_tpu.tools.zkcli",
+                    "-s", f"{server.host}:{server.port}", "admin", "ruok",
+                ],
+                cwd=REPO, capture_output=True, text=True, timeout=30,
+                env={**os.environ, "PYTHONPATH": REPO},
+            )
+            assert out.returncode == 0
+            assert out.stdout.strip() == "imok"
+
+    async def test_zkcli_admin_unreachable_server_fails(self):
+        out = await asyncio.to_thread(
+            subprocess.run,
+            [
+                sys.executable, "-m", "registrar_tpu.tools.zkcli",
+                "-s", "127.0.0.1:1", "admin", "ruok",
+            ],
+            cwd=REPO, capture_output=True, text=True, timeout=30,
+            env={**os.environ, "PYTHONPATH": REPO},
+        )
+        assert out.returncode == 1

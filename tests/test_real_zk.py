@@ -85,6 +85,47 @@ class TestRealZooKeeper:
         finally:
             await client.close()
 
+    async def test_multi_against_real_zk(self):
+        from registrar_tpu.zk.client import Op
+
+        client = await ZKClient(_servers()).connect()
+        try:
+            base = f"/registrar-interop-multi-{uuid.uuid4().hex[:8]}"
+            results = await client.multi(
+                [
+                    Op.create(base, b""),
+                    Op.create(f"{base}/a", b"one"),
+                    Op.set_data(f"{base}/a", b"two"),
+                ]
+            )
+            assert results[0] == base and results[1] == f"{base}/a"
+            assert (await client.get(f"{base}/a"))[0] == b"two"
+            # aborted txn applies nothing (real ZK may report per-op codes
+            # in the body — MultiError — or just the header error; both
+            # surface as ZKError)
+            from registrar_tpu.zk.protocol import ZKError
+
+            with pytest.raises(ZKError):
+                await client.multi(
+                    [
+                        Op.delete(f"{base}/a"),
+                        Op.create(f"{base}/a", b""),  # recreate: fine
+                        Op.check(f"{base}/a", 99),  # BAD_VERSION -> abort
+                    ]
+                )
+            assert (await client.get(f"{base}/a"))[0] == b"two"
+            await client.multi([Op.delete(f"{base}/a"), Op.delete(base)])
+            assert await client.exists(base) is None
+        finally:
+            await client.close()
+
+    async def test_sync_against_real_zk(self):
+        client = await ZKClient(_servers()).connect()
+        try:
+            assert await client.sync("/") == "/"
+        finally:
+            await client.close()
+
     async def test_watch_fires_on_real_zk(self):
         import asyncio
 
