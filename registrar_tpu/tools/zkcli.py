@@ -28,7 +28,7 @@ from typing import List, Tuple
 
 from registrar_tpu import binderview
 from registrar_tpu.zk.client import ZKClient
-from registrar_tpu.zk.protocol import Err, EventType, Stat, ZKError
+from registrar_tpu.zk.protocol import CreateFlag, Err, EventType, Stat, ZKError
 
 
 def _parse_servers(value: str) -> List[Tuple[str, int]]:
@@ -154,6 +154,78 @@ async def _cmd_watch(zk: ZKClient, args) -> int:
         await arm()  # watches are one-shot; re-arm
 
 
+async def _cmd_create(zk: ZKClient, args) -> int:
+    flags = CreateFlag.PERSISTENT
+    if args.ephemeral and args.sequential:
+        flags = CreateFlag.EPHEMERAL_SEQUENTIAL
+    elif args.ephemeral:
+        flags = CreateFlag.EPHEMERAL
+    elif args.sequential:
+        flags = CreateFlag.PERSISTENT_SEQUENTIAL
+    path = await zk.create(args.path, args.data.encode(), flags)
+    print(path)
+    if args.ephemeral:
+        # An ephemeral dies with this CLI's session the moment we exit —
+        # only useful for watching the effect from another session.
+        print(
+            "zkcli: note: ephemeral node is deleted when this command's "
+            "session closes (now)",
+            file=sys.stderr,
+        )
+    return 0
+
+
+async def _cmd_set(zk: ZKClient, args) -> int:
+    stat = await zk.put(args.path, args.data.encode())
+    print(f"version = {stat.version}")
+    return 0
+
+
+async def _cmd_mkdirp(zk: ZKClient, args) -> int:
+    await zk.mkdirp(args.path)
+    print(args.path)
+    return 0
+
+
+async def _cmd_rmr(zk: ZKClient, args) -> int:
+    """Recursive delete, children first (zkCli.sh ``rmr``/``deleteall``).
+
+    Not atomic: concurrent writers can race the walk.  A node that gained
+    a child between listing and delete is re-walked (bounded retries), and
+    nodes that vanished underneath us (ephemeral expiry) are fine.
+    """
+    deleted = 0
+
+    async def walk(path: str, retries: int = 5) -> None:
+        nonlocal deleted
+        try:
+            children = await zk.get_children(path)
+        except ZKError as e:
+            if e.code == Err.NO_NODE:
+                return
+            raise
+        for child in children:
+            await walk(f"{path}/{child}" if path != "/" else f"/{child}")
+        try:
+            await zk.unlink(path)
+            deleted += 1
+        except ZKError as e:
+            if e.code == Err.NO_NODE:  # raced with an ephemeral expiry: fine
+                return
+            if e.code == Err.NOT_EMPTY and retries > 0:
+                # A writer added a child after we listed; re-walk.
+                await walk(path, retries - 1)
+                return
+            raise
+
+    if args.path == "/":
+        print("zkcli: refusing to delete /", file=sys.stderr)
+        return 1
+    await walk(args.path)
+    print(f"deleted {deleted} node(s)")
+    return 0
+
+
 async def _cmd_admin(args) -> int:
     """Send a 4-letter-word admin command to every server, raw TCP.
 
@@ -237,6 +309,26 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("path")
     p.set_defaults(fn=_cmd_rm)
 
+    p = sub.add_parser("rmr", help="delete a znode subtree, children first")
+    p.add_argument("path")
+    p.set_defaults(fn=_cmd_rmr)
+
+    p = sub.add_parser("create", help="create a znode")
+    p.add_argument("path")
+    p.add_argument("data", nargs="?", default="")
+    p.add_argument("-e", "--ephemeral", action="store_true")
+    p.add_argument("-s", "--sequential", action="store_true")
+    p.set_defaults(fn=_cmd_create)
+
+    p = sub.add_parser("set", help="set a znode's data (creates if missing)")
+    p.add_argument("path")
+    p.add_argument("data")
+    p.set_defaults(fn=_cmd_set)
+
+    p = sub.add_parser("mkdirp", help="create a path and missing ancestors")
+    p.add_argument("path")
+    p.set_defaults(fn=_cmd_mkdirp)
+
     p = sub.add_parser("watch", help="stream change events for a znode")
     p.add_argument("path")
     p.add_argument(
@@ -281,6 +373,11 @@ async def _amain(argv=None) -> int:
     try:
         return await args.fn(zk, args)
     except ZKError as e:
+        print(f"zkcli: {e}", file=sys.stderr)
+        return 1
+    except ValueError as e:
+        # e.g. check_path rejecting a malformed znode path — a one-line
+        # error, not a traceback.
         print(f"zkcli: {e}", file=sys.stderr)
         return 1
     finally:

@@ -85,6 +85,75 @@ class TestRecordProperties:
             except (JuteError, UnicodeDecodeError):
                 pass
 
+    _paths = st.text(
+        alphabet=st.characters(min_codepoint=ord("a"), max_codepoint=ord("z")),
+        min_size=1, max_size=12,
+    ).map(lambda s: "/" + s)
+    _multi_ops = st.lists(
+        st.one_of(
+            st.tuples(_paths, st.binary(max_size=64), st.integers(0, 3)).map(
+                lambda t: (
+                    proto.OpCode.CREATE,
+                    proto.CreateRequest(path=t[0], data=t[1], flags=t[2]),
+                )
+            ),
+            st.tuples(_paths, ints).map(
+                lambda t: (
+                    proto.OpCode.DELETE,
+                    proto.DeleteRequest(path=t[0], version=t[1]),
+                )
+            ),
+            st.tuples(_paths, st.binary(max_size=64), ints).map(
+                lambda t: (
+                    proto.OpCode.SET_DATA,
+                    proto.SetDataRequest(path=t[0], data=t[1], version=t[2]),
+                )
+            ),
+            st.tuples(_paths, ints).map(
+                lambda t: (
+                    proto.OpCode.CHECK,
+                    proto.CheckVersionRequest(path=t[0], version=t[1]),
+                )
+            ),
+        ),
+        max_size=16,
+    )
+
+    @given(_multi_ops)
+    def test_multi_request_roundtrip(self, ops):
+        w = Writer()
+        proto.MultiRequest(ops=ops).write(w)
+        assert proto.MultiRequest.read(Reader(w.to_bytes())).ops == ops
+
+    @given(
+        st.lists(
+            st.one_of(
+                _paths.map(lambda p: proto.CreateResponse(path=p)),
+                ints.map(lambda e: proto.ErrorResult(err=e)),
+                st.just(proto._DeleteResult()),
+                st.just(proto._CheckResult()),
+                ints.map(
+                    lambda v: proto.SetDataResponse(stat=proto.Stat(version=v))
+                ),
+            ),
+            max_size=16,
+        )
+    )
+    def test_multi_response_roundtrip(self, results):
+        w = Writer()
+        proto.MultiResponse(results=results).write(w)
+        assert proto.MultiResponse.read(Reader(w.to_bytes())).results == results
+
+    @given(st.binary(max_size=256))
+    @settings(max_examples=200)
+    def test_arbitrary_bytes_never_crash_multi_readers(self, data):
+        for record in (proto.MultiRequest, proto.MultiResponse,
+                       proto.MultiHeader, proto.CheckVersionRequest):
+            try:
+                record.read(Reader(data))
+            except (JuteError, UnicodeDecodeError, ValueError):
+                pass
+
     @given(
         st.text(
             alphabet=st.characters(

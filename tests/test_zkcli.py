@@ -90,6 +90,60 @@ class TestZkCli:
             await client.close()
             await server.stop()
 
+    async def test_write_commands(self):
+        server = await ZKServer().start()
+        client = await ZKClient([server.address]).connect()
+        try:
+            out = await asyncio.to_thread(
+                _run_cli, server, "mkdirp", "/ops/deep/dir"
+            )
+            assert out.returncode == 0
+            assert await client.exists("/ops/deep/dir") is not None
+
+            out = await asyncio.to_thread(
+                _run_cli, server, "create", "/ops/deep/dir/node", '{"a":1}'
+            )
+            assert out.returncode == 0
+            assert out.stdout.strip() == "/ops/deep/dir/node"
+            assert (await client.get("/ops/deep/dir/node"))[0] == b'{"a":1}'
+
+            out = await asyncio.to_thread(
+                _run_cli, server, "create", "-s", "/ops/deep/dir/seq-"
+            )
+            assert out.returncode == 0
+            assert out.stdout.strip().startswith("/ops/deep/dir/seq-")
+
+            out = await asyncio.to_thread(
+                _run_cli, server, "set", "/ops/deep/dir/node", '{"a":2}'
+            )
+            assert out.returncode == 0
+            assert "version = 1" in out.stdout
+            assert (await client.get("/ops/deep/dir/node"))[0] == b'{"a":2}'
+
+            out = await asyncio.to_thread(
+                _run_cli, server, "create", "/ops/deep/dir/node", "dup"
+            )
+            assert out.returncode == 1
+            assert "NODE_EXISTS" in out.stderr
+
+            out = await asyncio.to_thread(_run_cli, server, "rmr", "/ops")
+            assert out.returncode == 0
+            assert "deleted 5 node(s)" in out.stdout  # 3 dirs + node + seq-
+            assert await client.exists("/ops") is None
+
+            out = await asyncio.to_thread(_run_cli, server, "rmr", "/")
+            assert out.returncode == 1
+            assert "refusing" in out.stderr
+
+            # malformed path -> one-line error, not a traceback
+            out = await asyncio.to_thread(_run_cli, server, "mkdirp", "/bad/")
+            assert out.returncode == 1
+            assert "zkcli:" in out.stderr
+            assert "Traceback" not in out.stderr
+        finally:
+            await client.close()
+            await server.stop()
+
     async def test_watch_streams_events(self):
         server = await ZKServer().start()
         client = await ZKClient([server.address]).connect()
