@@ -57,6 +57,10 @@ class ZNode:
     mtime: int = 0
     version: int = 0
     cversion: int = 0
+    aversion: int = 0
+    acls: List[proto.ACL] = field(
+        default_factory=lambda: list(proto.OPEN_ACL_UNSAFE)
+    )
 
     def stat(self) -> Stat:
         return Stat(
@@ -66,7 +70,7 @@ class ZNode:
             mtime=self.mtime,
             version=self.version,
             cversion=self.cversion,
-            aversion=0,
+            aversion=self.aversion,
             ephemeral_owner=self.ephemeral_owner,
             data_length=len(self.data),
             num_children=len(self.children),
@@ -83,6 +87,10 @@ class Session:
     ephemerals: Set[str] = field(default_factory=set)
     conn: Optional["_Connection"] = None
     closed: bool = False
+    # (scheme, id) identities granted via addauth on the *current*
+    # connection — real ZK scopes auth to the connection, not the session,
+    # so these are cleared when the carrying connection goes away.
+    auth_ids: Set[Tuple[str, str]] = field(default_factory=set)
 
     @property
     def connected(self) -> bool:
@@ -98,6 +106,8 @@ class _Connection:
         self.writer = writer
         self.session: Optional[Session] = None
         self.closed = False
+        peer = writer.get_extra_info("peername")
+        self.peer_ip: Optional[str] = peer[0] if peer else None
 
     async def send(self, payload: bytes) -> None:
         if self.closed:
@@ -124,8 +134,11 @@ class _Connection:
             return
         self.closed = True
         if self.session is not None and self.session.conn is self:
-            # Connection gone; the session lingers until its timeout.
+            # Connection gone; the session lingers until its timeout, but
+            # auth is per-connection (real ZK keeps authInfo on the cnxn) —
+            # a reattaching client must replay addauth.
             self.session.conn = None
+            self.session.auth_ids.clear()
         try:
             self.writer.close()
         except Exception:
@@ -467,15 +480,131 @@ class ZKServer:
     def _add_watch(self, kind: str, path: str, conn: _Connection) -> None:
         self._watches[kind].setdefault(path, set()).add(conn)
 
+    # -- ACLs (ZooKeeper 3.4 semantics) --------------------------------------
+    #
+    # Enforcement points match real ZK's PrepRequestProcessor/FinalRP:
+    # create -> CREATE on the parent, delete -> DELETE on the parent,
+    # setData -> WRITE, getData/getChildren -> READ, setACL -> ADMIN;
+    # exists and getACL are deliberately unchecked (3.4 behavior).  The
+    # reference never sets ACLs (zkplus creates everything world:anyone,
+    # SURVEY.md §2.4), so none of this triggers for registrar traffic.
+
+    @staticmethod
+    def _ip_matches(acl_id: str, peer_ip: Optional[str]) -> bool:
+        if peer_ip is None:
+            return False
+        import ipaddress
+
+        try:
+            addr = ipaddress.ip_address(peer_ip)
+            if "/" in acl_id:
+                return addr in ipaddress.ip_network(acl_id, strict=False)
+            return addr == ipaddress.ip_address(acl_id)
+        except ValueError:
+            return False
+
+    def _fix_acls(
+        self, acls: List[proto.ACL], sess: Session
+    ) -> List[proto.ACL]:
+        """Validate a client-supplied ACL list, expanding the ``auth``
+        scheme into the session's digest identities (real ZK's fixupACL)."""
+        if not acls:
+            raise proto.ZKError(Err.INVALID_ACL)
+        out: List[proto.ACL] = []
+        for acl in acls:
+            if not isinstance(acl.perms, int) or not (
+                0 < acl.perms <= proto.Perms.ALL
+            ):
+                raise proto.ZKError(Err.INVALID_ACL)
+            if acl.scheme == "world":
+                if acl.id != "anyone":
+                    raise proto.ZKError(Err.INVALID_ACL)
+                out.append(acl)
+            elif acl.scheme == "auth":
+                ids = sorted(
+                    i for s, i in sess.auth_ids if s == "digest"
+                )
+                if not ids:
+                    raise proto.ZKError(Err.INVALID_ACL)
+                out.extend(proto.ACL(acl.perms, "digest", i) for i in ids)
+            elif acl.scheme == "digest":
+                if ":" not in acl.id:
+                    raise proto.ZKError(Err.INVALID_ACL)
+                out.append(acl)
+            elif acl.scheme == "ip":
+                import ipaddress
+
+                try:
+                    if "/" in acl.id:
+                        ipaddress.ip_network(acl.id, strict=False)
+                    else:
+                        ipaddress.ip_address(acl.id)
+                except ValueError:
+                    raise proto.ZKError(Err.INVALID_ACL)
+                out.append(acl)
+            else:
+                raise proto.ZKError(Err.INVALID_ACL)
+        return out
+
+    def _check_acl(
+        self, acls: List[proto.ACL], perm: int, sess: Optional[Session]
+    ) -> None:
+        """Raise NO_AUTH unless some ACL entry grants ``perm`` to ``sess``."""
+        for acl in acls:
+            if not (acl.perms & perm):
+                continue
+            if acl.scheme == "world" and acl.id == "anyone":
+                return
+            if sess is None:
+                continue
+            if acl.scheme == "digest" and ("digest", acl.id) in sess.auth_ids:
+                return
+            if acl.scheme == "ip" and sess.conn is not None:
+                if self._ip_matches(acl.id, sess.conn.peer_ip):
+                    return
+        raise proto.ZKError(Err.NO_AUTH)
+
+    def _handle_auth(self, req: proto.AuthPacket, sess: Session) -> bool:
+        """Apply an addauth packet; False means AUTH_FAILED (drop conn)."""
+        if req.scheme == "digest":
+            try:
+                cred = (req.auth or b"").decode("utf-8")
+                user, password = cred.split(":", 1)
+            except (UnicodeDecodeError, ValueError):
+                return False
+            if not user:
+                return False
+            sess.auth_ids.add(
+                ("digest", proto.digest_auth_id(user, password))
+            )
+            return True
+        if req.scheme == "ip":
+            # Real ZK's IPAuthenticationProvider just records the
+            # connection's actual address, which _check_acl already matches
+            # directly — accept and do nothing.
+            return True
+        return False
+
     async def _create_node(
-        self, path: str, data: bytes, flags: int, session: Session
+        self,
+        path: str,
+        data: bytes,
+        flags: int,
+        session: Session,
+        acls: Optional[List[proto.ACL]] = None,
     ) -> str:
         proto.check_path(path)
+        acls = (
+            self._fix_acls(acls, session)
+            if acls is not None
+            else list(proto.OPEN_ACL_UNSAFE)
+        )
         parent_path, name = self._split(path)
         try:
             parent = self._resolve(parent_path)
         except KeyError:
             raise proto.ZKError(Err.NO_NODE, parent_path)
+        self._check_acl(parent.acls, proto.Perms.CREATE, session)
         if parent.ephemeral_owner:
             raise proto.ZKError(Err.NO_CHILDREN_FOR_EPHEMERALS, parent_path)
 
@@ -503,6 +632,7 @@ class ZKServer:
             pzxid=zxid,
             ctime=now,
             mtime=now,
+            acls=acls,
         )
         parent.children[name] = node
         parent.cversion += 1
@@ -516,12 +646,18 @@ class ZKServer:
         )
         return path
 
-    async def _delete_node(self, path: str, version: int = -1) -> None:
+    async def _delete_node(
+        self, path: str, version: int = -1, sess: Optional[Session] = None
+    ) -> None:
+        # ``sess=None`` marks internal calls (ephemeral cleanup on session
+        # close/expiry), which bypass ACL checks like real ZK's does.
         parent_path, name = self._split(path)
         parent = self._resolve(parent_path)  # KeyError propagates
         node = parent.children.get(name)
         if node is None:
             raise KeyError(path)
+        if sess is not None:
+            self._check_acl(parent.acls, proto.Perms.DELETE, sess)
         if version != -1 and node.version != version:
             raise proto.ZKError(Err.BAD_VERSION, path)
         if node.children:
@@ -541,12 +677,18 @@ class ZKServer:
         await self._fire_watches(_WATCH_CHILD, path, EventType.NODE_DELETED)
 
     async def _set_data_node(
-        self, path: str, data: Optional[bytes], version: int
+        self,
+        path: str,
+        data: Optional[bytes],
+        version: int,
+        sess: Optional[Session] = None,
     ) -> Stat:
         try:
             node = self._resolve(path)
         except KeyError:
             raise proto.ZKError(Err.NO_NODE, path)
+        if sess is not None:
+            self._check_acl(node.acls, proto.Perms.WRITE, sess)
         if version != -1 and node.version != version:
             raise proto.ZKError(Err.BAD_VERSION, path)
         node.data = data or b""
@@ -558,7 +700,7 @@ class ZKServer:
 
     # -- multi (atomic transactions) ----------------------------------------
 
-    def _validate_multi(self, ops: List[tuple]) -> None:
+    def _validate_multi(self, ops: List[tuple], sess: Session) -> None:
         """Dry-run a transaction against an overlay of the tree.
 
         Raises the first op's ZKError without touching state, so the apply
@@ -581,32 +723,36 @@ class ZKServer:
                         "ephemeral": bool(node.ephemeral_owner),
                         "nchildren": len(node.children),
                         "cversion": node.cversion,
+                        "acls": node.acls,
                     }
                 except KeyError:
                     ent = {
                         "exists": False, "version": 0,
                         "ephemeral": False, "nchildren": 0, "cversion": 0,
+                        "acls": [],
                     }
                 overlay[path] = ent
             return ent
 
         for index, (op_type, req) in enumerate(ops):
             try:
-                self._validate_one(op_type, req, lookup)
+                self._validate_one(op_type, req, lookup, sess)
             except proto.ZKError as err:
                 err.op_index = index
                 raise
 
-    def _validate_one(self, op_type: int, req, lookup) -> None:
+    def _validate_one(self, op_type: int, req, lookup, sess: Session) -> None:
         try:
             proto.check_path(req.path)
         except ValueError:
             raise proto.ZKError(Err.BAD_ARGUMENTS, req.path)
         if op_type == OpCode.CREATE:
+            acls = self._fix_acls(req.acls, sess)  # raises INVALID_ACL
             parent_path, _ = self._split(req.path)
             parent = lookup(parent_path)
             if not parent["exists"]:
                 raise proto.ZKError(Err.NO_NODE, parent_path)
+            self._check_acl(parent["acls"], proto.Perms.CREATE, sess)
             if parent["ephemeral"]:
                 raise proto.ZKError(Err.NO_CHILDREN_FOR_EPHEMERALS, parent_path)
             sequential = req.flags in (
@@ -638,6 +784,7 @@ class ZKServer:
                 cversion=0,  # fresh node — a delete+recreate in the same
                 # txn must not inherit the old node's child counter, or
                 # sequential-name prediction diverges from the apply phase
+                acls=acls,
             )
             parent["nchildren"] += 1
             parent["cversion"] = int(parent["cversion"]) + 1
@@ -645,18 +792,25 @@ class ZKServer:
             ent = lookup(req.path)
             if not ent["exists"]:
                 raise proto.ZKError(Err.NO_NODE, req.path)
+            parent = lookup(self._split(req.path)[0])
+            self._check_acl(parent["acls"], proto.Perms.DELETE, sess)
             if req.version != -1 and ent["version"] != req.version:
                 raise proto.ZKError(Err.BAD_VERSION, req.path)
             if ent["nchildren"]:
                 raise proto.ZKError(Err.NOT_EMPTY, req.path)
             ent["exists"] = False
-            parent = lookup(self._split(req.path)[0])
             parent["nchildren"] -= 1
             parent["cversion"] = int(parent["cversion"]) + 1
         elif op_type in (OpCode.SET_DATA, OpCode.CHECK):
             ent = lookup(req.path)
             if not ent["exists"]:
                 raise proto.ZKError(Err.NO_NODE, req.path)
+            self._check_acl(
+                ent["acls"],
+                proto.Perms.WRITE if op_type == OpCode.SET_DATA
+                else proto.Perms.READ,
+                sess,
+            )
             if req.version != -1 and ent["version"] != req.version:
                 raise proto.ZKError(Err.BAD_VERSION, req.path)
             if op_type == OpCode.SET_DATA:
@@ -674,7 +828,7 @@ class ZKServer:
         documented ZooKeeper multi abort contract.
         """
         try:
-            self._validate_multi(req.ops)
+            self._validate_multi(req.ops, sess)
         except proto.ZKError as err:
             failed_at = getattr(err, "op_index", 0)
             return proto.MultiResponse(
@@ -698,12 +852,15 @@ class ZKServer:
             for op_type, op_req in req.ops:
                 if op_type == OpCode.CREATE:
                     path = await self._create_node(
-                        op_req.path, op_req.data, op_req.flags, sess
+                        op_req.path, op_req.data, op_req.flags, sess,
+                        op_req.acls,
                     )
                     results.append(proto.CreateResponse(path=path))
                 elif op_type == OpCode.DELETE:
                     try:
-                        await self._delete_node(op_req.path, op_req.version)
+                        await self._delete_node(
+                            op_req.path, op_req.version, sess
+                        )
                     except KeyError:
                         raise proto.ZKError(
                             Err.RUNTIME_INCONSISTENCY, op_req.path
@@ -711,7 +868,7 @@ class ZKServer:
                     results.append(proto._DeleteResult())
                 elif op_type == OpCode.SET_DATA:
                     stat = await self._set_data_node(
-                        op_req.path, op_req.data, op_req.version
+                        op_req.path, op_req.data, op_req.version, sess
                     )
                     results.append(proto.SetDataResponse(stat=stat))
                 else:  # OpCode.CHECK — validated above, nothing to apply
@@ -750,6 +907,7 @@ class ZKServer:
             self._conns.discard(conn)
             if conn.session is not None and conn.session.conn is conn:
                 conn.session.conn = None
+                conn.session.auth_ids.clear()
                 conn.session.last_heard = time.monotonic()
             await conn.close()
 
@@ -786,6 +944,15 @@ class ZKServer:
             ).write(w)
             await conn.send(w.to_bytes())
             return
+        if sess.conn is not None and sess.conn is not conn:
+            # Session moved: real ZK closes the superseded connection when
+            # a client reattaches the session from a new one.
+            old, sess.conn = sess.conn, None
+            await old.close()
+        # Auth is per-connection (real ZK's authInfo lives on the cnxn):
+        # whatever the previous connection added must not leak to this one
+        # — the client replays addauth itself after reconnecting.
+        sess.auth_ids.clear()
         conn.session = sess
         sess.conn = conn
         sess.last_heard = time.monotonic()
@@ -814,6 +981,16 @@ class ZKServer:
                 return
             if self.freeze:
                 continue  # swallow the request: wedged-server simulation
+            if hdr.type == OpCode.AUTH:
+                req = proto.AuthPacket.read(r)
+                ok = self._handle_auth(req, sess)
+                await conn.send(
+                    self._reply(hdr.xid, Err.OK if ok else Err.AUTH_FAILED)
+                )
+                if not ok:
+                    # Real ZK answers AUTH_FAILED then drops the connection.
+                    return
+                continue
             reply = await self._dispatch(conn, sess, hdr, r)
             if reply is not None:
                 await conn.send(reply)
@@ -852,13 +1029,15 @@ class ZKServer:
                 return self._reply(proto.XID_PING, Err.OK)
             if op == OpCode.CREATE:
                 req = proto.CreateRequest.read(r)
-                path = await self._create_node(req.path, req.data, req.flags, sess)
+                path = await self._create_node(
+                    req.path, req.data, req.flags, sess, req.acls
+                )
                 return self._reply(hdr.xid, Err.OK, proto.CreateResponse(path=path))
             if op == OpCode.DELETE:
                 req = proto.DeleteRequest.read(r)
                 proto.check_path(req.path)
                 try:
-                    await self._delete_node(req.path, req.version)
+                    await self._delete_node(req.path, req.version, sess)
                 except KeyError:
                     raise proto.ZKError(Err.NO_NODE, req.path)
                 return self._reply(hdr.xid, Err.OK)
@@ -883,6 +1062,7 @@ class ZKServer:
                     node = self._resolve(req.path)
                 except KeyError:
                     raise proto.ZKError(Err.NO_NODE, req.path)
+                self._check_acl(node.acls, proto.Perms.READ, sess)
                 if req.watch:
                     self._add_watch(_WATCH_DATA, req.path, conn)
                 return self._reply(
@@ -893,9 +1073,43 @@ class ZKServer:
             if op == OpCode.SET_DATA:
                 req = proto.SetDataRequest.read(r)
                 proto.check_path(req.path)
-                stat = await self._set_data_node(req.path, req.data, req.version)
+                stat = await self._set_data_node(
+                    req.path, req.data, req.version, sess
+                )
                 return self._reply(
                     hdr.xid, Err.OK, proto.SetDataResponse(stat=stat)
+                )
+            if op == OpCode.GET_ACL:
+                req = proto.GetACLRequest.read(r)
+                proto.check_path(req.path)
+                try:
+                    node = self._resolve(req.path)
+                except KeyError:
+                    raise proto.ZKError(Err.NO_NODE, req.path)
+                # Unchecked in 3.4 (ADMIN-gating arrived with 3.5's
+                # checkGetACL flag) — anyone may inspect ACLs.
+                return self._reply(
+                    hdr.xid,
+                    Err.OK,
+                    proto.GetACLResponse(
+                        acls=list(node.acls), stat=node.stat()
+                    ),
+                )
+            if op == OpCode.SET_ACL:
+                req = proto.SetACLRequest.read(r)
+                proto.check_path(req.path)
+                try:
+                    node = self._resolve(req.path)
+                except KeyError:
+                    raise proto.ZKError(Err.NO_NODE, req.path)
+                self._check_acl(node.acls, proto.Perms.ADMIN, sess)
+                if req.version != -1 and node.aversion != req.version:
+                    raise proto.ZKError(Err.BAD_VERSION, req.path)
+                node.acls = self._fix_acls(req.acls, sess)
+                node.aversion += 1
+                self._next_zxid()  # a write transaction, but mzxid untouched
+                return self._reply(
+                    hdr.xid, Err.OK, proto.SetACLResponse(stat=node.stat())
                 )
             if op in (OpCode.GET_CHILDREN, OpCode.GET_CHILDREN2):
                 req = proto.GetChildrenRequest.read(r)
@@ -904,6 +1118,7 @@ class ZKServer:
                     node = self._resolve(req.path)
                 except KeyError:
                     raise proto.ZKError(Err.NO_NODE, req.path)
+                self._check_acl(node.acls, proto.Perms.READ, sess)
                 if req.watch:
                     self._add_watch(_WATCH_CHILD, req.path, conn)
                 children = sorted(node.children)

@@ -14,6 +14,9 @@ one command instead of manual tree-walking::
     python -m registrar_tpu.tools.zkcli -s 127.0.0.1:2181 resolve authcache.emy-10.joyent.us
     python -m registrar_tpu.tools.zkcli -s 127.0.0.1:2181 resolve -t SRV _http._tcp.example.joyent.us
     python -m registrar_tpu.tools.zkcli -s 127.0.0.1:2181 admin ruok
+    python -m registrar_tpu.tools.zkcli -s 127.0.0.1:2181 getacl /us/joyent
+    python -m registrar_tpu.tools.zkcli -s 127.0.0.1:2181 --auth digest:ops:pw \
+        setacl /us/joyent/locked digest:ops:HASH:cdrwa world:anyone:r
 
 Exit status: 0 on success, 1 on ZK errors (e.g. no such node), 2 on usage.
 """
@@ -28,7 +31,59 @@ from typing import List, Tuple
 
 from registrar_tpu import binderview
 from registrar_tpu.zk.client import ZKClient
-from registrar_tpu.zk.protocol import CreateFlag, Err, EventType, Stat, ZKError
+from registrar_tpu.zk.protocol import (
+    ACL,
+    CreateFlag,
+    Err,
+    EventType,
+    Perms,
+    Stat,
+    ZKError,
+)
+
+#: perm letter <-> bit, in zkCli.sh's display order (cdrwa)
+_PERM_LETTERS = [
+    ("c", Perms.CREATE),
+    ("d", Perms.DELETE),
+    ("r", Perms.READ),
+    ("w", Perms.WRITE),
+    ("a", Perms.ADMIN),
+]
+
+
+def _fmt_perms(perms: int) -> str:
+    return "".join(ch for ch, bit in _PERM_LETTERS if perms & bit)
+
+
+def _parse_acl(spec: str) -> ACL:
+    """Parse ``scheme:id:perms`` (id may itself contain colons, e.g. a
+    digest ``user:hash`` — the *last* segment is always the perm letters)."""
+    scheme, _, rest = spec.partition(":")
+    ident, _, perm_str = rest.rpartition(":")
+    if not scheme or not perm_str:
+        raise argparse.ArgumentTypeError(
+            f"expected scheme:id:perms (e.g. world:anyone:cdrwa), got {spec!r}"
+        )
+    perms = 0
+    for ch in perm_str:
+        for letter, bit in _PERM_LETTERS:
+            if ch == letter:
+                perms |= bit
+                break
+        else:
+            raise argparse.ArgumentTypeError(
+                f"bad perm letter {ch!r} in {spec!r} (use [cdrwa])"
+            )
+    return ACL(perms=perms, scheme=scheme, id=ident)
+
+
+def _parse_auth(value: str) -> Tuple[str, bytes]:
+    scheme, sep, cred = value.partition(":")
+    if not scheme or not sep:
+        raise argparse.ArgumentTypeError(
+            f"expected scheme:credential (e.g. digest:user:pass), got {value!r}"
+        )
+    return (scheme, cred.encode())
 
 
 def _parse_servers(value: str) -> List[Tuple[str, int]]:
@@ -262,6 +317,22 @@ async def _cmd_admin(args) -> int:
     return 1 if failures else 0
 
 
+async def _cmd_getacl(zk: ZKClient, args) -> int:
+    """Print a node's ACL list in zkCli.sh's getAcl format."""
+    acls, stat = await zk.get_acl(args.path)
+    for acl in acls:
+        print(f"'{acl.scheme},'{acl.id}")
+        print(f": {_fmt_perms(acl.perms)}")
+    print(f"aversion = {stat.aversion}")
+    return 0
+
+
+async def _cmd_setacl(zk: ZKClient, args) -> int:
+    stat = await zk.set_acl(args.path, args.acl, version=args.version)
+    print(f"aversion = {stat.aversion}")
+    return 0
+
+
 async def _cmd_resolve(zk: ZKClient, args) -> int:
     res = await binderview.resolve(zk, args.name, args.qtype)
     if res.empty:
@@ -285,6 +356,12 @@ def build_parser() -> argparse.ArgumentParser:
         "-s", "--servers", type=_parse_servers,
         default=[("127.0.0.1", 2181)], metavar="HOST:PORT[,...]",
         help="ZooKeeper servers (default 127.0.0.1:2181)",
+    )
+    parser.add_argument(
+        "--auth", type=_parse_auth, action="append", default=[],
+        metavar="SCHEME:CRED",
+        help="authenticate after connecting (repeatable), e.g. "
+        "digest:user:password — the zkCli.sh `addauth` equivalent",
     )
     sub = parser.add_subparsers(dest="command", required=True)
 
@@ -347,6 +424,25 @@ def build_parser() -> argparse.ArgumentParser:
     )
     p.set_defaults(fn=_cmd_admin, raw=True)
 
+    p = sub.add_parser("getacl", help="print a znode's ACL list")
+    p.add_argument("path")
+    p.set_defaults(fn=_cmd_getacl)
+
+    p = sub.add_parser(
+        "setacl", help="replace a znode's ACL list (requires ADMIN)"
+    )
+    p.add_argument("path")
+    p.add_argument(
+        "acl", type=_parse_acl, nargs="+", metavar="SCHEME:ID:PERMS",
+        help="e.g. world:anyone:cdrwa, digest:user:HASH:rw, ip:10.0.0.1:r, "
+        "auth::cdrwa (expands to your authenticated identities)",
+    )
+    p.add_argument(
+        "--version", type=int, default=-1,
+        help="expected aversion (default: unconditional)",
+    )
+    p.set_defaults(fn=_cmd_setacl)
+
     p = sub.add_parser(
         "resolve", help="answer a DNS query the way Binder would"
     )
@@ -371,6 +467,8 @@ async def _amain(argv=None) -> int:
         print(f"zkcli: cannot connect to {args.servers}: {e}", file=sys.stderr)
         return 1
     try:
+        for scheme, cred in args.auth:
+            await zk.add_auth(scheme, cred)
         return await args.fn(zk, args)
     except ZKError as e:
         print(f"zkcli: {e}", file=sys.stderr)

@@ -106,6 +106,9 @@ class ZKClient(EventEmitter):
         # one-shot watches to re-arm after reconnect: kind -> set of paths
         self._watch_paths = {"data": set(), "exist": set(), "child": set()}
         self._watch_emitter = EventEmitter()
+        # credentials added via add_auth, replayed on every (re)connect the
+        # way the Apache client replays its authInfo list
+        self._auths: List[Tuple[str, bytes]] = []
 
     # -- state --------------------------------------------------------------
 
@@ -189,6 +192,7 @@ class ZKClient(EventEmitter):
         self._last_response = time.monotonic()
         self._read_task = asyncio.create_task(self._read_loop())
         self._ping_task = asyncio.create_task(self._ping_loop())
+        await self._replay_auths()
         if reattached:
             await self._rearm_watches()
         log.debug(
@@ -197,6 +201,24 @@ class ZKClient(EventEmitter):
         )
         self.emit("state", "connected")
         self.emit("connect")
+
+    async def _replay_auths(self) -> None:
+        """Re-send stored credentials on a fresh connection.
+
+        Auth state is per-connection server-side, so every (re)connect must
+        replay it before any ACL-guarded operation runs (the Apache client
+        does the same with its authInfo list in primeConnection)."""
+        for scheme, auth in self._auths:
+            try:
+                await self._submit(
+                    proto.XID_AUTH,
+                    OpCode.AUTH,
+                    proto.AuthPacket(type=0, scheme=scheme, auth=auth),
+                )
+            except ZKError as err:
+                log.warning("replaying %s auth failed: %s", scheme, err)
+                if err.code == Err.AUTH_FAILED:
+                    self.emit("auth_failed", scheme)
 
     async def _rearm_watches(self) -> None:
         if not any(self._watch_paths.values()):
@@ -590,6 +612,53 @@ class ZKClient(EventEmitter):
             else:
                 out.append(None)
         return out
+
+    # -- auth / ACLs (full ZooKeeper 3.4 surface) ----------------------------
+
+    async def add_auth(self, scheme: str, auth: bytes) -> None:
+        """Authenticate this session's connection (``addauth`` in zkCli.sh).
+
+        For the digest scheme ``auth`` is ``b"user:password"`` — the server
+        hashes it and matches ACL ids of the form
+        :func:`registrar_tpu.zk.protocol.digest_auth_id`.  The credential is
+        remembered and replayed automatically after every reconnect.  Raises
+        ``ZKError(AUTH_FAILED)`` (and the server drops the connection) for an
+        unknown scheme or malformed credential.  Beyond the reference's
+        surface: zkplus never exposed auth, and the reference creates every
+        node world-writable (lib/register.js never passes ACLs).
+        """
+        if not isinstance(scheme, str) or not scheme:
+            raise ValueError("scheme must be a non-empty string")
+        await self._submit(
+            proto.XID_AUTH,
+            OpCode.AUTH,
+            proto.AuthPacket(type=0, scheme=scheme, auth=auth),
+        )
+        if (scheme, auth) not in self._auths:
+            self._auths.append((scheme, auth))
+
+    async def get_acl(self, path: str) -> Tuple[List[proto.ACL], Stat]:
+        """Read a node's ACL list and stat (aversion lives in the stat)."""
+        check_path(path)
+        r = await self._call(OpCode.GET_ACL, proto.GetACLRequest(path=path))
+        resp = proto.GetACLResponse.read(r)
+        return (resp.acls, resp.stat)
+
+    async def set_acl(
+        self, path: str, acls: Sequence[proto.ACL], version: int = -1
+    ) -> Stat:
+        """Replace a node's ACL list.
+
+        ``version`` is compared against the node's **aversion** (not the data
+        version); pass -1 to skip the check.  Requires ADMIN permission on
+        the node.
+        """
+        check_path(path)
+        r = await self._call(
+            OpCode.SET_ACL,
+            proto.SetACLRequest(path=path, acls=list(acls), version=version),
+        )
+        return proto.SetACLResponse.read(r).stat
 
     # -- application heartbeat (reference lib/zk.js:21-59) -------------------
 

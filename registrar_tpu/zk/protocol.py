@@ -124,6 +124,27 @@ class ACL:
 #: uses for every node it creates.
 OPEN_ACL_UNSAFE = [ACL(Perms.ALL, "world", "anyone")]
 
+#: read-only for everyone (ZooKeeper's ZooDefs.Ids.READ_ACL_UNSAFE).
+READ_ACL_UNSAFE = [ACL(Perms.READ, "world", "anyone")]
+
+
+def digest_auth_id(user: str, password: str) -> str:
+    """``user:base64(sha1(user:password))`` — the id stored in digest ACLs.
+
+    Matches ZooKeeper's DigestAuthenticationProvider.generateDigest, so
+    ACLs minted here are interchangeable with ones from zkCli.sh.
+    """
+    import base64
+    import hashlib
+
+    digest = hashlib.sha1(f"{user}:{password}".encode()).digest()
+    return f"{user}:{base64.b64encode(digest).decode('ascii')}"
+
+
+def creator_all_acl(user: str, password: str) -> List[ACL]:
+    """ALL perms for one digest identity (ZooDefs.Ids.CREATOR_ALL_ACL shape)."""
+    return [ACL(Perms.ALL, "digest", digest_auth_id(user, password))]
+
 
 # --- watch events ----------------------------------------------------------
 
@@ -515,6 +536,89 @@ class SyncResponse:
     @classmethod
     def read(cls, r: Reader) -> "SyncResponse":
         return cls(path=r.read_ustring())
+
+
+# --- auth / ACL ops ---------------------------------------------------------
+
+@dataclass
+class AuthPacket:
+    """Body of an OpCode.AUTH request (always sent with xid -4).
+
+    ``type`` is unused by ZooKeeper (always 0); ``scheme`` names the
+    authentication provider ("digest", "ip", ...); ``auth`` is the raw
+    credential — for digest, ``b"user:password"`` (the *server* hashes it).
+    """
+
+    type: int
+    scheme: str
+    auth: Optional[bytes]
+
+    def write(self, w: Writer) -> None:
+        w.write_int(self.type)
+        w.write_ustring(self.scheme)
+        w.write_buffer(self.auth)
+
+    @classmethod
+    def read(cls, r: Reader) -> "AuthPacket":
+        return cls(type=r.read_int(), scheme=r.read_ustring(), auth=r.read_buffer())
+
+
+@dataclass
+class GetACLRequest:
+    path: str
+
+    def write(self, w: Writer) -> None:
+        w.write_ustring(self.path)
+
+    @classmethod
+    def read(cls, r: Reader) -> "GetACLRequest":
+        return cls(path=r.read_ustring())
+
+
+@dataclass
+class GetACLResponse:
+    acls: List[ACL]
+    stat: Stat
+
+    def write(self, w: Writer) -> None:
+        w.write_vector(self.acls, lambda ww, a: a.write(ww))
+        self.stat.write(w)
+
+    @classmethod
+    def read(cls, r: Reader) -> "GetACLResponse":
+        return cls(acls=r.read_vector(ACL.read) or [], stat=Stat.read(r))
+
+
+@dataclass
+class SetACLRequest:
+    path: str
+    acls: List[ACL]
+    version: int = -1  # compared against the node's aversion
+
+    def write(self, w: Writer) -> None:
+        w.write_ustring(self.path)
+        w.write_vector(self.acls, lambda ww, a: a.write(ww))
+        w.write_int(self.version)
+
+    @classmethod
+    def read(cls, r: Reader) -> "SetACLRequest":
+        return cls(
+            path=r.read_ustring(),
+            acls=r.read_vector(ACL.read) or [],
+            version=r.read_int(),
+        )
+
+
+@dataclass
+class SetACLResponse:
+    stat: Stat
+
+    def write(self, w: Writer) -> None:
+        self.stat.write(w)
+
+    @classmethod
+    def read(cls, r: Reader) -> "SetACLResponse":
+        return cls(stat=Stat.read(r))
 
 
 # --- multi (transactions) ---------------------------------------------------

@@ -1,0 +1,433 @@
+"""ACL and authentication support: protocol records, client ops, server
+enforcement.
+
+The reference never touches ACLs — zkplus creates every node
+world:anyone (SURVEY.md §2.4), and `addauth`/`getAcl`/`setAcl` are beyond
+its surface.  The rebuild's transport covers the full ZooKeeper 3.4
+client protocol, so these tests pin:
+
+  * jute round-trips for AuthPacket / GetACL / SetACL records,
+  * the digest id formula (sha1 + base64, matching ZooKeeper's
+    DigestAuthenticationProvider so ACLs interoperate with zkCli.sh),
+  * server-side enforcement at the 3.4 checkpoints (create -> CREATE on
+    parent, delete -> DELETE on parent, setData -> WRITE, getData /
+    getChildren -> READ, setACL -> ADMIN; exists and getACL unchecked),
+  * scheme semantics: world / digest / ip (with CIDR) / auth-expansion,
+  * aversion versioning of setACL,
+  * credential replay after reconnect, and AUTH_FAILED connection drop,
+  * ACL checks inside multi transactions (validated before apply),
+  * ephemeral cleanup bypassing ACLs on session close (internal delete).
+"""
+
+import base64
+import hashlib
+
+import pytest
+
+from registrar_tpu.testing.server import ZKServer
+from registrar_tpu.zk.client import MultiError, Op, ZKClient
+from registrar_tpu.zk.jute import Reader, Writer
+from registrar_tpu.zk import protocol as proto
+from registrar_tpu.zk.protocol import (
+    ACL,
+    CreateFlag,
+    Err,
+    OPEN_ACL_UNSAFE,
+    Perms,
+    Stat,
+    ZKError,
+    creator_all_acl,
+    digest_auth_id,
+)
+
+
+async def _pair(**kw):
+    server = await ZKServer().start()
+    client = await ZKClient([server.address], **kw).connect()
+    return server, client
+
+
+class TestWire:
+    def test_auth_packet_roundtrip(self):
+        pkt = proto.AuthPacket(type=0, scheme="digest", auth=b"user:pw")
+        w = Writer()
+        pkt.write(w)
+        assert proto.AuthPacket.read(Reader(w.to_bytes())) == pkt
+
+    def test_get_acl_records_roundtrip(self):
+        w = Writer()
+        proto.GetACLRequest(path="/a").write(w)
+        assert proto.GetACLRequest.read(Reader(w.to_bytes())).path == "/a"
+
+        resp = proto.GetACLResponse(
+            acls=[ACL(Perms.READ | Perms.WRITE, "digest", "u:h")],
+            stat=Stat(*([0] * 11)),
+        )
+        w = Writer()
+        resp.write(w)
+        assert proto.GetACLResponse.read(Reader(w.to_bytes())) == resp
+
+    def test_set_acl_records_roundtrip(self):
+        req = proto.SetACLRequest(
+            path="/a", acls=list(OPEN_ACL_UNSAFE), version=4
+        )
+        w = Writer()
+        req.write(w)
+        assert proto.SetACLRequest.read(Reader(w.to_bytes())) == req
+
+    def test_digest_auth_id_formula(self):
+        # Pin the exact DigestAuthenticationProvider.generateDigest formula
+        # (user:base64(sha1(user:password))) independently of the helper.
+        expected = "alice:" + base64.b64encode(
+            hashlib.sha1(b"alice:secret").digest()
+        ).decode()
+        assert digest_auth_id("alice", "secret") == expected
+        assert creator_all_acl("alice", "secret") == [
+            ACL(Perms.ALL, "digest", expected)
+        ]
+
+
+class TestDefaultAcls:
+    async def test_created_nodes_are_world_anyone(self):
+        server, client = await _pair()
+        try:
+            await client.create("/plain", b"x")
+            acls, stat = await client.get_acl("/plain")
+            assert acls == list(OPEN_ACL_UNSAFE)
+            assert stat.aversion == 0
+        finally:
+            await client.close()
+            await server.stop()
+
+    async def test_get_acl_missing_node(self):
+        server, client = await _pair()
+        try:
+            with pytest.raises(ZKError) as exc:
+                await client.get_acl("/nope")
+            assert exc.value.code == Err.NO_NODE
+        finally:
+            await client.close()
+            await server.stop()
+
+
+class TestSetAcl:
+    async def test_set_acl_bumps_aversion_only(self):
+        server, client = await _pair()
+        try:
+            await client.create("/n", b"d")
+            before = await client.stat("/n")
+            stat = await client.set_acl(
+                "/n", [ACL(Perms.READ, "world", "anyone")]
+            )
+            assert stat.aversion == 1
+            assert stat.version == before.version  # data version untouched
+            assert stat.mzxid == before.mzxid  # not a data change
+            acls, _ = await client.get_acl("/n")
+            assert acls == [ACL(Perms.READ, "world", "anyone")]
+        finally:
+            await client.close()
+            await server.stop()
+
+    async def test_set_acl_version_check(self):
+        server, client = await _pair()
+        try:
+            await client.create("/n", b"")
+            with pytest.raises(ZKError) as exc:
+                await client.set_acl("/n", list(OPEN_ACL_UNSAFE), version=5)
+            assert exc.value.code == Err.BAD_VERSION
+            await client.set_acl("/n", list(OPEN_ACL_UNSAFE), version=0)
+            with pytest.raises(ZKError) as exc:
+                await client.set_acl("/n", list(OPEN_ACL_UNSAFE), version=0)
+            assert exc.value.code == Err.BAD_VERSION  # aversion is now 1
+        finally:
+            await client.close()
+            await server.stop()
+
+    async def test_invalid_acls_rejected(self):
+        server, client = await _pair()
+        try:
+            await client.create("/n", b"")
+            for bad in (
+                [],  # empty list
+                [ACL(0, "world", "anyone")],  # no perms
+                [ACL(Perms.ALL, "world", "somebody")],  # bad world id
+                [ACL(Perms.ALL, "kerberos", "x")],  # unknown scheme
+                [ACL(Perms.ALL, "digest", "nohash")],  # digest id w/o ':'
+                [ACL(Perms.ALL, "ip", "not-an-ip")],
+            ):
+                with pytest.raises(ZKError) as exc:
+                    await client.set_acl("/n", bad)
+                assert exc.value.code == Err.INVALID_ACL, bad
+        finally:
+            await client.close()
+            await server.stop()
+
+
+class TestDigestEnforcement:
+    ACL_OWNER = creator_all_acl("alice", "secret")
+
+    async def _protected(self):
+        """Server + authenticated owner client + a /sec node only alice
+        can touch (plus a child for read/delete probes)."""
+        server, owner = await _pair()
+        await owner.add_auth("digest", b"alice:secret")
+        await owner.create("/sec", b"top", acls=self.ACL_OWNER)
+        await owner.create("/sec/child", b"c", acls=self.ACL_OWNER)
+        return server, owner
+
+    async def test_stranger_denied_owner_allowed(self):
+        server, owner = await self._protected()
+        stranger = await ZKClient([server.address]).connect()
+        try:
+            # READ gate: getData and getChildren.
+            with pytest.raises(ZKError) as exc:
+                await stranger.get("/sec")
+            assert exc.value.code == Err.NO_AUTH
+            with pytest.raises(ZKError) as exc:
+                await stranger.get_children("/sec")
+            assert exc.value.code == Err.NO_AUTH
+            # WRITE gate.
+            with pytest.raises(ZKError) as exc:
+                await stranger._call(
+                    proto.OpCode.SET_DATA,
+                    proto.SetDataRequest(path="/sec", data=b"x"),
+                )
+            assert exc.value.code == Err.NO_AUTH
+            # CREATE gate (on the parent).
+            with pytest.raises(ZKError) as exc:
+                await stranger.create("/sec/intruder", b"")
+            assert exc.value.code == Err.NO_AUTH
+            # DELETE gate (on the parent).
+            with pytest.raises(ZKError) as exc:
+                await stranger.unlink("/sec/child")
+            assert exc.value.code == Err.NO_AUTH
+            # setACL requires ADMIN.
+            with pytest.raises(ZKError) as exc:
+                await stranger.set_acl("/sec", list(OPEN_ACL_UNSAFE))
+            assert exc.value.code == Err.NO_AUTH
+            # exists and getACL are unchecked in 3.4.
+            assert (await stranger.stat("/sec")).data_length == 3
+            acls, _ = await stranger.get_acl("/sec")
+            assert acls == self.ACL_OWNER
+
+            # The owner session passes every gate.
+            assert (await owner.get("/sec"))[0] == b"top"
+            await owner.create("/sec/more", b"")
+            await owner.unlink("/sec/more")
+
+            # The stranger becomes alice: everything opens up.
+            await stranger.add_auth("digest", b"alice:secret")
+            assert (await stranger.get("/sec"))[0] == b"top"
+            await stranger.unlink("/sec/child")
+        finally:
+            await stranger.close()
+            await owner.close()
+            await server.stop()
+
+    async def test_wrong_password_is_not_alice(self):
+        server, owner = await self._protected()
+        stranger = await ZKClient([server.address]).connect()
+        try:
+            await stranger.add_auth("digest", b"alice:wrong")
+            with pytest.raises(ZKError) as exc:
+                await stranger.get("/sec")
+            assert exc.value.code == Err.NO_AUTH
+        finally:
+            await stranger.close()
+            await owner.close()
+            await server.stop()
+
+    async def test_auth_replayed_after_reconnect(self):
+        import asyncio
+
+        server, owner = await self._protected()
+        try:
+            await server.drop_connections()
+            # The client reconnects with the same session and must replay
+            # its digest credential (server-side auth is per-connection).
+            # CONNECTION_LOSS is retried (the drop may not have been
+            # observed client-side yet); a NO_AUTH would mean the replay
+            # didn't happen and fails the test immediately.
+            data = None
+            for _ in range(200):
+                try:
+                    data, _ = await owner.get("/sec")
+                    break
+                except ZKError as err:
+                    if err.code != Err.CONNECTION_LOSS:
+                        raise
+                    await asyncio.sleep(0.05)
+            assert data == b"top"
+        finally:
+            await owner.close()
+            await server.stop()
+
+    async def test_reattach_does_not_inherit_auth(self):
+        """A connection that reattaches the session (id + passwd) while the
+        old connection is still open must NOT inherit its digest
+        identities — auth is per-connection, and the new connection has to
+        replay addauth itself."""
+        server, owner = await self._protected()
+        hijacker = ZKClient([server.address], reconnect=False)
+        hijacker.session_id = owner.session_id
+        hijacker.session_passwd = owner.session_passwd
+        try:
+            await hijacker.connect()
+            with pytest.raises(ZKError) as exc:
+                await hijacker.get("/sec")
+            assert exc.value.code == Err.NO_AUTH
+        finally:
+            await hijacker.close()
+            await owner.close()
+            await server.stop()
+
+    async def test_ephemeral_cleanup_ignores_acls(self):
+        server, owner = await self._protected()
+        try:
+            await owner.create(
+                "/sec/eph", b"", CreateFlag.EPHEMERAL, acls=self.ACL_OWNER
+            )
+            await owner.close()  # session close: server deletes internally
+            assert server.get_node("/sec/eph") is None
+        finally:
+            await server.stop()
+
+
+class TestAuthScheme:
+    async def test_auth_expands_to_session_identities(self):
+        server, client = await _pair()
+        try:
+            await client.add_auth("digest", b"bob:pw")
+            await client.create(
+                "/mine", b"", acls=[ACL(Perms.ALL, "auth", "")]
+            )
+            acls, _ = await client.get_acl("/mine")
+            assert acls == [ACL(Perms.ALL, "digest", digest_auth_id("bob", "pw"))]
+        finally:
+            await client.close()
+            await server.stop()
+
+    async def test_auth_scheme_without_credentials_invalid(self):
+        server, client = await _pair()
+        try:
+            with pytest.raises(ZKError) as exc:
+                await client.create(
+                    "/mine", b"", acls=[ACL(Perms.ALL, "auth", "")]
+                )
+            assert exc.value.code == Err.INVALID_ACL
+        finally:
+            await client.close()
+            await server.stop()
+
+    async def test_unknown_scheme_auth_failed_drops_connection(self):
+        server, client = await _pair(reconnect=False)
+        try:
+            with pytest.raises(ZKError) as exc:
+                await client.add_auth("kerberos", b"whatever")
+            assert exc.value.code == Err.AUTH_FAILED
+            # Real ZK drops the connection after answering AUTH_FAILED.
+            import asyncio
+
+            for _ in range(100):
+                if not client.connected:
+                    break
+                await asyncio.sleep(0.02)
+            assert not client.connected
+        finally:
+            await client.close()
+            await server.stop()
+
+    async def test_ip_scheme_addauth_is_accepted_noop(self):
+        server, client = await _pair()
+        try:
+            await client.add_auth("ip", b"anything")
+            await client.create("/ok", b"")  # connection still usable
+        finally:
+            await client.close()
+            await server.stop()
+
+
+class TestIpScheme:
+    async def test_loopback_matches_exact_and_cidr(self):
+        server, client = await _pair()
+        try:
+            await client.create(
+                "/byip", b"d",
+                acls=[ACL(Perms.READ | Perms.ADMIN, "ip", "127.0.0.1")],
+            )
+            assert (await client.get("/byip"))[0] == b"d"  # peer is loopback
+            await client.set_acl(
+                "/byip", [ACL(Perms.READ | Perms.ADMIN, "ip", "127.0.0.0/8")]
+            )
+            assert (await client.get("/byip"))[0] == b"d"
+            # An ACL for some other network denies us (ADMIN kept on
+            # loopback so the node stays repairable).
+            await client.set_acl(
+                "/byip",
+                [
+                    ACL(Perms.READ, "ip", "10.9.8.0/24"),
+                    ACL(Perms.ADMIN, "ip", "127.0.0.1"),
+                ],
+            )
+            with pytest.raises(ZKError) as exc:
+                await client.get("/byip")
+            assert exc.value.code == Err.NO_AUTH
+        finally:
+            await client.close()
+            await server.stop()
+
+
+class TestMultiAcl:
+    async def test_multi_respects_acls_and_aborts(self):
+        server, owner = await _pair()
+        await owner.add_auth("digest", b"alice:secret")
+        await owner.create(
+            "/sec", b"", acls=creator_all_acl("alice", "secret")
+        )
+        stranger = await ZKClient([server.address]).connect()
+        try:
+            await stranger.create("/free", b"")
+            with pytest.raises(MultiError) as exc:
+                await stranger.multi(
+                    [
+                        Op.create("/free/a", b""),
+                        Op.create("/sec/b", b""),  # NO_AUTH here
+                    ]
+                )
+            assert Err.NO_AUTH in exc.value.results
+            # Atomicity: the permitted op must not have been applied.
+            assert server.get_node("/free/a") is None
+            assert server.get_node("/sec/b") is None
+
+            # The owner's identical transaction goes through.
+            await owner.multi(
+                [Op.create("/free/a", b""), Op.create("/sec/b", b"")]
+            )
+            assert server.get_node("/sec/b") is not None
+        finally:
+            await stranger.close()
+            await owner.close()
+            await server.stop()
+
+
+class TestRegistrationUnaffected:
+    async def test_pipeline_still_world_anyone(self):
+        """The registrar pipeline stays byte-identical: every node it
+        creates carries world:anyone (the reference's zkplus behavior)."""
+        from registrar_tpu.registration import register
+
+        server, client = await _pair()
+        try:
+            nodes = await register(
+                client,
+                {"domain": "acl.test.us", "type": "host"},
+                admin_ip="10.0.0.9",
+                hostname="box",
+                settle_delay=0,
+            )
+            for path in nodes:
+                acls, _ = await client.get_acl(path)
+                assert acls == list(OPEN_ACL_UNSAFE), path
+        finally:
+            await client.close()
+            await server.stop()

@@ -144,6 +144,58 @@ class TestZkCli:
             await client.close()
             await server.stop()
 
+    async def test_acl_commands(self):
+        from registrar_tpu.zk.protocol import digest_auth_id
+
+        server = await ZKServer().start()
+        client = await ZKClient([server.address]).connect()
+        try:
+            await client.create("/guarded", b"x")
+
+            out = await asyncio.to_thread(_run_cli, server, "getacl", "/guarded")
+            assert out.returncode == 0
+            assert "'world,'anyone" in out.stdout
+            assert ": cdrwa" in out.stdout
+            assert "aversion = 0" in out.stdout
+
+            # Lock the node down to a digest identity (keep world-read).
+            ident = digest_auth_id("ops", "hunter2")
+            out = await asyncio.to_thread(
+                _run_cli, server, "setacl", "/guarded",
+                f"digest:{ident}:cdrwa", "world:anyone:r",
+            )
+            assert out.returncode == 0
+            assert "aversion = 1" in out.stdout
+
+            # Unauthenticated writes are now denied...
+            out = await asyncio.to_thread(
+                _run_cli, server, "set", "/guarded", "y"
+            )
+            assert out.returncode == 1
+            assert "NO_AUTH" in out.stderr
+
+            # ...but --auth digest:user:pass opens them up.
+            out = await asyncio.to_thread(
+                _run_cli, server, "--auth", "digest:ops:hunter2",
+                "set", "/guarded", '{"b":1}'
+            )
+            assert out.returncode == 0
+            assert (await client.get("/guarded"))[0] == b'{"b":1}'
+
+            out = await asyncio.to_thread(
+                _run_cli, server, "getacl", "/guarded"
+            )
+            assert f"'digest,'{ident}" in out.stdout
+
+            # Bad ACL spec -> usage error from argparse (exit 2).
+            out = await asyncio.to_thread(
+                _run_cli, server, "setacl", "/guarded", "world:anyone:xyz"
+            )
+            assert out.returncode == 2
+        finally:
+            await client.close()
+            await server.stop()
+
     async def test_watch_streams_events(self):
         server = await ZKServer().start()
         client = await ZKClient([server.address]).connect()
