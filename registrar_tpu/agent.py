@@ -21,7 +21,13 @@ Loop behavior matches the reference exactly (BASELINE.md):
     ``max(heartbeat_interval, 60 s)`` (lib/index.js:146);
   * a heartbeat failure does NOT deregister or exit — recovery rides on ZK
     session expiry + supervisor restart, or a health-check ``ok``
-    re-registration (SURVEY.md §3.2 note);
+    re-registration (SURVEY.md §3.2 note).  SURVEY.md §3.2 flags re-creating
+    missing ephemerals on heartbeat NO_NODE as a worthwhile but
+    behavior-changing improvement: it is available here as the **opt-in**
+    ``repair_heartbeat_miss`` flag (config key ``repairHeartbeatMiss``),
+    default off for reference parity.  When enabled, a heartbeat that fails
+    with NO_NODE re-runs the registration pipeline — unless the health
+    checker has deliberately deregistered the host (``ee.down``);
   * on health ``fail`` with ``isDown`` the znodes are unregistered; on the
     next health ``ok`` the full registration pipeline runs again
     (lib/index.js:59-116).
@@ -44,6 +50,7 @@ from registrar_tpu.health import HealthCheck, create_health_check
 from registrar_tpu.registration import SETTLE_DELAY_S
 from registrar_tpu.retry import RetryPolicy
 from registrar_tpu.zk.client import ZKClient
+from registrar_tpu.zk.protocol import Err, ZKError
 
 log = logging.getLogger("registrar_tpu.agent")
 
@@ -60,6 +67,10 @@ class RegistrarEvents(EventEmitter):
     def __init__(self) -> None:
         super().__init__()
         self.znodes: list = []
+        #: True while the health checker holds the host deregistered —
+        #: gates heartbeat repair so it never races a deliberate
+        #: deregistration.
+        self.down = False
         self._tasks: list = []
         self._health: Optional[HealthCheck] = None
         self._stopped = False
@@ -90,6 +101,7 @@ def register_plus(
     hostname: Optional[str] = None,
     settle_delay: float = SETTLE_DELAY_S,
     heartbeat_retry: Optional[RetryPolicy] = None,
+    repair_heartbeat_miss: bool = False,
 ) -> RegistrarEvents:
     """Register, then keep the registration alive; returns the event surface.
 
@@ -98,13 +110,17 @@ def register_plus(
     (seconds-based keys, see :mod:`registrar_tpu.config` for translation).
     ``heartbeat_retry`` overrides the per-probe retry policy (configured
     from the sample config's ``maxAttempts``, see config.py).
+    ``repair_heartbeat_miss`` opts into re-registering when a heartbeat
+    finds the znodes gone (module docstring; default off = reference
+    behavior).
     """
     ee = RegistrarEvents()
     loop = asyncio.get_running_loop()
     ee._tasks.append(loop.create_task(_run(ee, zk, registration, admin_ip,
                                            health_check, heartbeat_interval,
                                            hostname, settle_delay,
-                                           heartbeat_retry)))
+                                           heartbeat_retry,
+                                           repair_heartbeat_miss)))
     return ee
 
 
@@ -118,12 +134,17 @@ async def _run(
     hostname: Optional[str],
     settle_delay: float,
     heartbeat_retry: Optional[RetryPolicy] = None,
+    repair_heartbeat_miss: bool = False,
 ) -> None:
-    try:
-        znodes = await register_mod.register(
+    async def do_register() -> list:
+        """The one registration pipeline call every path shares."""
+        return await register_mod.register(
             zk, registration, admin_ip=admin_ip, hostname=hostname,
             settle_delay=settle_delay,
         )
+
+    try:
+        znodes = await do_register()
     except asyncio.CancelledError:
         raise
     except Exception as err:  # noqa: BLE001
@@ -137,12 +158,13 @@ async def _run(
 
     loop = asyncio.get_running_loop()
     ee._tasks.append(loop.create_task(
-        _heartbeat_loop(ee, zk, heartbeat_interval, heartbeat_retry)
+        _heartbeat_loop(
+            ee, zk, heartbeat_interval, heartbeat_retry,
+            do_register if repair_heartbeat_miss else None,
+        )
     ))
     if health_check:
-        _start_health_consumer(
-            ee, zk, registration, admin_ip, hostname, settle_delay, health_check
-        )
+        _start_health_consumer(ee, zk, do_register, health_check)
     ee.emit("register", znodes)
 
 
@@ -151,8 +173,16 @@ async def _heartbeat_loop(
     zk: ZKClient,
     interval: float,
     retry: Optional[RetryPolicy] = None,
+    repair=None,
 ) -> None:
-    """Hot loop #1 (SURVEY.md §3.2): self-rescheduling znode liveness probe."""
+    """Hot loop #1 (SURVEY.md §3.2): self-rescheduling znode liveness probe.
+
+    ``repair``: optional coroutine factory re-running the registration
+    pipeline; invoked when a probe fails with NO_NODE (znodes vanished
+    without our session expiring — e.g. an operator deleted them, or a
+    reattach raced a cleanup) unless the health checker holds the host
+    down.  None = reference behavior: failures only back off.
+    """
     while not ee.stopped:
         try:
             await zk.heartbeat(ee.znodes, retry=retry)
@@ -161,6 +191,42 @@ async def _heartbeat_loop(
         except Exception as err:  # noqa: BLE001
             log.debug("zk.heartbeat(%s) failed: %r", ee.znodes, err)
             ee.emit("heartbeatFailure", err)
+            if (
+                repair is not None
+                and not ee.down
+                and not ee.stopped
+                and isinstance(err, ZKError)
+                and err.code == Err.NO_NODE
+            ):
+                try:
+                    new_znodes = await repair()
+                except asyncio.CancelledError:
+                    raise
+                except Exception as r_err:  # noqa: BLE001
+                    log.debug("heartbeat repair failed: %r", r_err)
+                    ee.emit("error", r_err)
+                else:
+                    if ee.down or ee.stopped:
+                        # The health checker crossed its threshold while the
+                        # repair's pipeline (1 s settle + RPCs) was in
+                        # flight: honor the deregistration — roll the fresh
+                        # znodes back out rather than resurrecting a host
+                        # health just declared down.
+                        log.debug(
+                            "heartbeat repair rolled back (health down)"
+                        )
+                        try:
+                            await register_mod.unregister(zk, new_znodes)
+                        except Exception as u_err:  # noqa: BLE001
+                            ee.emit("error", u_err)
+                    else:
+                        ee.znodes = new_znodes
+                        log.debug(
+                            "heartbeat repair re-registered %s", ee.znodes
+                        )
+                        ee.emit("register", ee.znodes)
+                        await asyncio.sleep(interval)
+                        continue
             await asyncio.sleep(max(interval, HEARTBEAT_FAILURE_BACKOFF_S))
             continue
         log.debug("zk.heartbeat(%s): ok", ee.znodes)
@@ -171,21 +237,17 @@ async def _heartbeat_loop(
 def _start_health_consumer(
     ee: RegistrarEvents,
     zk: ZKClient,
-    registration: Mapping[str, Any],
-    admin_ip: Optional[str],
-    hostname: Optional[str],
-    settle_delay: float,
+    do_register,
     health_check: Mapping[str, Any],
 ) -> None:
     """Hot loop #2 (SURVEY.md §3.3): health stream -> deregister/re-register."""
     check = create_health_check(**health_check)
     ee._health = check
-    down = False
     transitioning = False
 
     async def on_fail(err: Exception) -> None:
-        nonlocal down, transitioning
-        down = True
+        nonlocal transitioning
+        ee.down = True
         transitioning = True
         try:
             log.debug("healthcheck failed, deregistering (znodes=%s)", ee.znodes)
@@ -201,21 +263,18 @@ def _start_health_consumer(
             transitioning = False
 
     async def on_recover() -> None:
-        nonlocal down, transitioning
+        nonlocal transitioning
         transitioning = True
         try:
             ee.emit("ok")
             try:
-                znodes = await register_mod.register(
-                    zk, registration, admin_ip=admin_ip, hostname=hostname,
-                    settle_delay=settle_delay,
-                )
+                znodes = await do_register()
             except Exception as r_err:  # noqa: BLE001
                 log.debug("register: reregister failed: %r", r_err)
                 ee.emit("error", r_err)
             else:
                 ee.znodes = znodes
-                down = False
+                ee.down = False
                 ee.emit("register", znodes)
         finally:
             transitioning = False
@@ -227,12 +286,16 @@ def _start_health_consumer(
             return
         rtype = record.get("type")
         if rtype == "ok":
-            if down:
+            if ee.down:
                 ee._tasks.append(
                     asyncio.get_running_loop().create_task(on_recover())
                 )
         elif rtype == "fail":
-            if record.get("err") is not None and record.get("isDown") and not down:
+            if (
+                record.get("err") is not None
+                and record.get("isDown")
+                and not ee.down
+            ):
                 ee._tasks.append(
                     asyncio.get_running_loop().create_task(on_fail(record["err"]))
                 )

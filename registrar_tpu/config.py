@@ -25,8 +25,16 @@ etc/config.coal.json)::
         "stdoutMatch": {"pattern": "...", "flags": "...", "invert": false}
       },
       "logLevel": "info",                      # optional
-      "maxAttempts": 5                         # heartbeat retry attempts
-    }
+      "maxAttempts": 5,                        # heartbeat retry attempts
+      "repairHeartbeatMiss": false,            # opt-in extension (no
+                                               #  reference analog): re-run
+                                               #  registration when a
+                                               #  heartbeat finds the znodes
+                                               #  gone (SURVEY.md §3.2 note)
+      "metrics": {"port": 9090,                # opt-in extension: Prometheus
+                  "host": "127.0.0.1"}         #  /metrics endpoint (the
+    }                                          #  node-artedi analog,
+                                               #  SURVEY.md §5)
 
 All reference keys are camelCase and all durations are milliseconds; this
 module translates them into the seconds-based snake_case surface of the
@@ -58,6 +66,12 @@ class ZookeeperConfig:
 
 
 @dataclass
+class MetricsConfig:
+    port: int
+    host: str = "127.0.0.1"
+
+
+@dataclass
 class Config:
     zookeeper: ZookeeperConfig
     registration: Dict[str, Any]
@@ -66,6 +80,8 @@ class Config:
     log_level: Optional[str] = None
     heartbeat_interval_s: float = 3.0
     heartbeat_retry: RetryPolicy = field(default_factory=lambda: HEARTBEAT_RETRY)
+    repair_heartbeat_miss: bool = False
+    metrics: Optional[MetricsConfig] = None
 
 
 def parse_config(raw: Mapping[str, Any]) -> Config:
@@ -152,6 +168,27 @@ def parse_config(raw: Mapping[str, Any]) -> Config:
         else HEARTBEAT_RETRY
     )
 
+    repair = raw.get("repairHeartbeatMiss", False)
+    if not isinstance(repair, bool):
+        raise ConfigError("config.repairHeartbeatMiss must be a boolean")
+
+    metrics = None
+    metrics_raw = raw.get("metrics")
+    if metrics_raw is not None:
+        if not isinstance(metrics_raw, Mapping):
+            raise ConfigError("config.metrics must be an object")
+        port = metrics_raw.get("port")
+        if (
+            not isinstance(port, int)
+            or isinstance(port, bool)
+            or not 0 < port < 65536
+        ):
+            raise ConfigError("config.metrics.port must be a port number")
+        host = metrics_raw.get("host", "127.0.0.1")
+        if not isinstance(host, str) or not host:
+            raise ConfigError("config.metrics.host must be a string")
+        metrics = MetricsConfig(port=port, host=host)
+
     return Config(
         zookeeper=zookeeper,
         registration=registration,
@@ -160,6 +197,8 @@ def parse_config(raw: Mapping[str, Any]) -> Config:
         log_level=log_level,
         heartbeat_interval_s=heartbeat_interval_s,
         heartbeat_retry=heartbeat_retry,
+        repair_heartbeat_miss=repair,
+        metrics=metrics,
     )
 
 

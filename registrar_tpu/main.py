@@ -125,6 +125,7 @@ async def run(cfg: Config, *, _exit=sys.exit) -> None:
         health_check=cfg.health_check,
         heartbeat_interval=cfg.heartbeat_interval_s,
         heartbeat_retry=cfg.heartbeat_retry,
+        repair_heartbeat_miss=cfg.repair_heartbeat_miss,
     )
 
     ee.on("fail", lambda err: log.error(
@@ -166,6 +167,27 @@ async def run(cfg: Config, *, _exit=sys.exit) -> None:
     ee.on("heartbeatFailure", on_heartbeat_failure)
     ee.on("heartbeat", on_heartbeat)
 
+    metrics_server = None
+    if cfg.metrics is not None:
+        from registrar_tpu.metrics import MetricsServer, instrument
+
+        try:
+            metrics_server = await MetricsServer(
+                instrument(ee, zk),
+                host=cfg.metrics.host,
+                port=cfg.metrics.port,
+            ).start()
+        except OSError as err:
+            # A busy/forbidden port must not take down registration —
+            # metrics are an observability add-on, not the product.
+            log.error("metrics: cannot listen on %s:%d",
+                      cfg.metrics.host, cfg.metrics.port,
+                      extra={"zdata": {"err": err}})
+        else:
+            log.info("metrics: listening",
+                     extra={"zdata": {"host": cfg.metrics.host,
+                                      "port": metrics_server.port}})
+
     loop = asyncio.get_running_loop()
     for sig in (signal.SIGTERM, signal.SIGINT):
         try:
@@ -176,6 +198,8 @@ async def run(cfg: Config, *, _exit=sys.exit) -> None:
     await stopping.wait()
     log.info("registrar: shutting down")
     ee.stop()
+    if metrics_server is not None:
+        await metrics_server.stop()
     await zk.close()  # deletes our ephemerals immediately (see docstring)
     if exit_code:
         _exit(exit_code)

@@ -1,0 +1,308 @@
+"""Opt-in Prometheus-text metrics endpoint.
+
+The reference has **no** metrics surface — bunyan logs only; SURVEY.md §5
+notes its Triton/Manta contemporaries exposed counters via node-artedi on
+an HTTP port.  This module is that analog for the rebuild: a tiny
+dependency-free registry rendering Prometheus text exposition format
+0.0.4, served by an asyncio HTTP listener, fed from the
+:func:`registrar_tpu.agent.register_plus` event surface and the ZK
+client's connection state.
+
+Everything is opt-in via the ``metrics`` config block (docs/CONFIG.md);
+without it the daemon behaves exactly like the reference.
+
+    GET /metrics   -> text/plain; version=0.0.4 exposition
+    anything else  -> 404
+
+Exported metrics (all prefixed ``registrar_``):
+
+    registrar_registrations_total       registrations completed (incl.
+                                        health recovery + heartbeat repair)
+    registrar_unregistrations_total     health-driven deregistrations
+    registrar_heartbeats_total{status}  znode probes, status="ok"|"failure"
+    registrar_health_transitions_total{to}  threshold crossings, to="down"|"up"
+    registrar_errors_total              'error' events from any subsystem
+    registrar_health_down               1 while deregistered by health, else 0
+    registrar_znodes_owned              znodes this instance maintains
+    registrar_zk_connected              1 while the ZK session is connected
+    registrar_uptime_seconds            seconds since instrumentation started
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import time
+from typing import Callable, Dict, List, Optional, Tuple
+
+log = logging.getLogger("registrar_tpu.metrics")
+
+_LabelKey = Tuple[Tuple[str, str], ...]
+
+
+def _escape_help(text: str) -> str:
+    return text.replace("\\", "\\\\").replace("\n", "\\n")
+
+
+def _escape_label(value: str) -> str:
+    return (
+        value.replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+    )
+
+
+class _Metric:
+    """One metric family: name, help text, per-labelset values."""
+
+    kind = "untyped"
+
+    def __init__(self, name: str, help_text: str):
+        self.name = name
+        self.help = help_text
+        self._values: Dict[_LabelKey, float] = {}
+
+    def _key(self, labels: Optional[Dict[str, str]]) -> _LabelKey:
+        return tuple(sorted((labels or {}).items()))
+
+    def value(self, labels: Optional[Dict[str, str]] = None) -> float:
+        return self._values.get(self._key(labels), 0.0)
+
+    def render(self) -> List[str]:
+        lines = [
+            f"# HELP {self.name} {_escape_help(self.help)}",
+            f"# TYPE {self.name} {self.kind}",
+        ]
+        # Deterministic output: label sets in sorted order.
+        for key in sorted(self._values):
+            value = self._values[key]
+            if key:
+                labels = ",".join(
+                    f'{k}="{_escape_label(v)}"' for k, v in key
+                )
+                lines.append(f"{self.name}{{{labels}}} {_format(value)}")
+            else:
+                lines.append(f"{self.name} {_format(value)}")
+        if len(lines) == 2:  # no samples yet: expose an explicit zero
+            lines.append(f"{self.name} 0")
+        return lines
+
+
+def _format(value: float) -> str:
+    return str(int(value)) if float(value).is_integer() else repr(value)
+
+
+class Counter(_Metric):
+    kind = "counter"
+
+    def inc(
+        self, amount: float = 1.0, labels: Optional[Dict[str, str]] = None
+    ) -> None:
+        if amount < 0:
+            raise ValueError("counters only go up")
+        key = self._key(labels)
+        self._values[key] = self._values.get(key, 0.0) + amount
+
+
+class Gauge(_Metric):
+    kind = "gauge"
+
+    def set(
+        self, value: float, labels: Optional[Dict[str, str]] = None
+    ) -> None:
+        self._values[self._key(labels)] = float(value)
+
+    #: gauges with a callback are computed at scrape time
+    fn: Optional[Callable[[], float]] = None
+
+    def set_function(self, fn: Callable[[], float]) -> None:
+        self.fn = fn
+
+    def render(self) -> List[str]:
+        if self.fn is not None:
+            self.set(self.fn())
+        return super().render()
+
+
+class MetricsRegistry:
+    """Ordered collection of metric families; renders the exposition."""
+
+    def __init__(self) -> None:
+        self._metrics: List[_Metric] = []
+        self._by_name: Dict[str, _Metric] = {}
+
+    def counter(self, name: str, help_text: str) -> Counter:
+        return self._add(Counter(name, help_text))
+
+    def gauge(self, name: str, help_text: str) -> Gauge:
+        return self._add(Gauge(name, help_text))
+
+    def _add(self, metric):
+        if metric.name in self._by_name:
+            raise ValueError(f"duplicate metric {metric.name}")
+        self._metrics.append(metric)
+        self._by_name[metric.name] = metric
+        return metric
+
+    def get(self, name: str) -> Optional[_Metric]:
+        return self._by_name.get(name)
+
+    def render(self) -> str:
+        out: List[str] = []
+        for metric in self._metrics:
+            out.extend(metric.render())
+        return "\n".join(out) + "\n"
+
+
+class MetricsServer:
+    """Minimal asyncio HTTP/1.0 server exposing ``GET /metrics``.
+
+    Deliberately tiny: one request per connection, no keep-alive, no TLS —
+    the same operational footprint as an artedi/kang listener, meant for a
+    loopback or management network (bind 127.0.0.1 by default).
+    """
+
+    def __init__(
+        self,
+        registry: MetricsRegistry,
+        host: str = "127.0.0.1",
+        port: int = 0,
+    ):
+        self.registry = registry
+        self.host = host
+        self._requested_port = port
+        self.port: Optional[int] = None
+        self._server: Optional[asyncio.AbstractServer] = None
+
+    async def start(self) -> "MetricsServer":
+        self._server = await asyncio.start_server(
+            self._handle, self.host, self._requested_port
+        )
+        self.port = self._server.sockets[0].getsockname()[1]
+        log.debug("metrics listening on %s:%d", self.host, self.port)
+        return self
+
+    async def stop(self) -> None:
+        if self._server is not None:
+            self._server.close()
+            await self._server.wait_closed()
+            self._server = None
+
+    async def _handle(self, reader, writer) -> None:
+        try:
+            try:
+                request = await asyncio.wait_for(
+                    reader.readline(), timeout=5.0
+                )
+            except (asyncio.TimeoutError, ValueError):
+                # ValueError: line exceeded the StreamReader limit (a
+                # hostile/garbage request) — drop it, no response owed.
+                return
+            parts = request.decode("latin-1", "replace").split()
+            # Drain headers (bounded) so well-behaved clients see a clean
+            # close instead of a reset.
+            for _ in range(100):
+                try:
+                    line = await asyncio.wait_for(
+                        reader.readline(), timeout=5.0
+                    )
+                except ValueError:  # oversized header line
+                    return
+                if line in (b"\r\n", b"\n", b""):
+                    break
+            if len(parts) >= 2 and parts[0] == "GET" and (
+                parts[1] == "/metrics" or parts[1].startswith("/metrics?")
+            ):
+                body = self.registry.render().encode()
+                status = "200 OK"
+                ctype = "text/plain; version=0.0.4; charset=utf-8"
+            else:
+                body = b"try GET /metrics\n"
+                status = "404 Not Found"
+                ctype = "text/plain; charset=utf-8"
+            writer.write(
+                (
+                    f"HTTP/1.0 {status}\r\n"
+                    f"Content-Type: {ctype}\r\n"
+                    f"Content-Length: {len(body)}\r\n"
+                    "Connection: close\r\n\r\n"
+                ).encode()
+                + body
+            )
+            await writer.drain()
+        except (ConnectionError, OSError, asyncio.TimeoutError):
+            pass
+        finally:
+            try:
+                writer.close()
+            except Exception:  # noqa: BLE001
+                pass
+
+
+def instrument(ee, zk, registry: Optional[MetricsRegistry] = None) -> MetricsRegistry:
+    """Wire a :class:`MetricsRegistry` to the register_plus event surface.
+
+    ``ee`` is the :class:`registrar_tpu.agent.RegistrarEvents` emitter,
+    ``zk`` the :class:`registrar_tpu.zk.client.ZKClient`.  Returns the
+    registry (creating one when not given).  Call once, before or after
+    the initial 'register' event — gauges read live state at scrape time.
+    """
+    reg = registry if registry is not None else MetricsRegistry()
+
+    registrations = reg.counter(
+        "registrar_registrations_total",
+        "Registrations completed (initial, health recovery, heartbeat repair)",
+    )
+    unregistrations = reg.counter(
+        "registrar_unregistrations_total",
+        "Health-driven deregistrations completed",
+    )
+    heartbeats = reg.counter(
+        "registrar_heartbeats_total",
+        "Znode liveness probes by status (ok|failure)",
+    )
+    transitions = reg.counter(
+        "registrar_health_transitions_total",
+        "Health-check threshold crossings (to=down|up)",
+    )
+    errors = reg.counter(
+        "registrar_errors_total", "Unexpected errors from any subsystem"
+    )
+    down = reg.gauge(
+        "registrar_health_down",
+        "1 while the health checker holds this host deregistered",
+    )
+    znodes = reg.gauge(
+        "registrar_znodes_owned", "Znodes this instance maintains"
+    )
+    connected = reg.gauge(
+        "registrar_zk_connected", "1 while the ZooKeeper session is connected"
+    )
+    uptime = reg.gauge(
+        "registrar_uptime_seconds", "Seconds since instrumentation started"
+    )
+
+    start = time.monotonic()
+    uptime.set_function(lambda: time.monotonic() - start)
+    down.set_function(lambda: 1.0 if ee.down else 0.0)
+    znodes.set_function(lambda: float(len(ee.znodes)))
+    connected.set_function(lambda: 1.0 if zk.connected else 0.0)
+
+    # Pre-seed every documented label set at 0 so each series exists from
+    # the first scrape — a counter appearing only on its first increment
+    # breaks rate()/absent() queries, and the unlabeled zero placeholder
+    # (render fallback) would otherwise vanish once a labeled sample lands.
+    for status in ("ok", "failure"):
+        heartbeats.inc(0, labels={"status": status})
+    for to in ("down", "up"):
+        transitions.inc(0, labels={"to": to})
+
+    ee.on("register", lambda *_a: registrations.inc())
+    ee.on("unregister", lambda *_a: unregistrations.inc())
+    ee.on("heartbeat", lambda *_a: heartbeats.inc(labels={"status": "ok"}))
+    ee.on(
+        "heartbeatFailure",
+        lambda *_a: heartbeats.inc(labels={"status": "failure"}),
+    )
+    ee.on("fail", lambda *_a: transitions.inc(labels={"to": "down"}))
+    ee.on("ok", lambda *_a: transitions.inc(labels={"to": "up"}))
+    ee.on("error", lambda *_a: errors.inc())
+    return reg

@@ -138,6 +138,111 @@ class TestHeartbeatFailure:
             await server.stop()
 
 
+class TestHeartbeatRepair:
+    """Opt-in repair_heartbeat_miss (SURVEY.md §3.2's flagged improvement —
+    off by default; TestHeartbeatFailure above pins the default)."""
+
+    _FAST_RETRY = None  # set in _fast_ee
+
+    def _fast_ee(self, client, **kw):
+        from registrar_tpu.retry import RetryPolicy
+
+        return _plus(
+            client,
+            heartbeat_interval=0.03,
+            heartbeat_retry=RetryPolicy(
+                max_attempts=1, initial_delay=0.01, max_delay=0.01
+            ),
+            **kw,
+        )
+
+    async def test_repair_recreates_missing_znodes(self):
+        server, client = await _pair()
+        try:
+            ee = self._fast_ee(client, repair_heartbeat_miss=True)
+            (znodes,) = await ee.wait_for("register", timeout=10)
+            failures = []
+            ee.on("heartbeatFailure", failures.append)
+
+            await client.unlink(znodes[0])  # vanish without session expiry
+            (renodes,) = await ee.wait_for("register", timeout=10)
+            assert renodes == znodes
+            assert failures  # the miss was still surfaced to operators
+            data, st = await client.get(znodes[0])
+            assert st.ephemeral_owner == client.session_id  # ephemeral again
+            assert parse_payload(data)["type"] == "load_balancer"
+            # and the loop settles back into healthy heartbeats
+            await ee.wait_for("heartbeat", timeout=10)
+            ee.stop()
+        finally:
+            await client.close()
+            await server.stop()
+
+    async def test_repair_rolls_back_when_health_drops_mid_repair(
+        self, monkeypatch
+    ):
+        # The race: a NO_NODE probe starts the repair pipeline (settle
+        # delay + RPCs), and the health checker crosses its threshold
+        # while it is in flight.  The repair must not resurrect the host —
+        # it rolls its fresh znodes back out.
+        import registrar_tpu.agent as agent_mod
+        from registrar_tpu.retry import RetryPolicy
+
+        monkeypatch.setattr(agent_mod, "HEARTBEAT_FAILURE_BACKOFF_S", 0.05)
+        server, client = await _pair()
+        try:
+            ee = _plus(
+                client,
+                heartbeat_interval=0.03,
+                heartbeat_retry=RetryPolicy(
+                    max_attempts=1, initial_delay=0.01, max_delay=0.01
+                ),
+                repair_heartbeat_miss=True,
+                settle_delay=0.3,  # wide window to land the down flip in
+            )
+            (znodes,) = await ee.wait_for("register", timeout=10)
+            registers = []
+            ee.on("register", registers.append)
+            await client.unlink(znodes[0])
+            await ee.wait_for("heartbeatFailure", timeout=10)
+            # Repair is now inside its 0.3 s settle; health goes down.
+            ee.down = True
+            await asyncio.sleep(1.0)
+            assert registers == []
+            assert await client.exists(znodes[0]) is None
+            ee.stop()
+        finally:
+            await client.close()
+            await server.stop()
+
+    async def test_repair_respects_health_down(self, monkeypatch):
+        # While the health checker holds the host deregistered, a NO_NODE
+        # heartbeat must NOT resurrect the znodes.
+        import registrar_tpu.agent as agent_mod
+
+        monkeypatch.setattr(agent_mod, "HEARTBEAT_FAILURE_BACKOFF_S", 0.05)
+        server, client = await _pair()
+        try:
+            ee = self._fast_ee(client, repair_heartbeat_miss=True)
+            (znodes,) = await ee.wait_for("register", timeout=10)
+            ee.down = True  # what on_fail sets before unregistering
+            await client.unlink(znodes[0])
+            registers = []
+            ee.on("register", registers.append)
+            await ee.wait_for("heartbeatFailure", timeout=10)
+            await ee.wait_for("heartbeatFailure", timeout=10)
+            assert registers == []
+            assert await client.exists(znodes[0]) is None
+            # health recovery clears the latch; the next miss repairs
+            ee.down = False
+            await ee.wait_for("register", timeout=10)
+            assert await client.exists(znodes[0]) is not None
+            ee.stop()
+        finally:
+            await client.close()
+            await server.stop()
+
+
 class TestHealthIntegration:
     async def test_fail_deregisters_then_ok_reregisters(self):
         # SURVEY.md §3.3 end to end, with a command whose behavior we flip

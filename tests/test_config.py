@@ -47,6 +47,17 @@ class TestParse:
         assert cfg.heartbeat_retry.max_attempts == 5
         assert cfg.health_check is None
         assert cfg.admin_ip is None
+        assert cfg.repair_heartbeat_miss is False  # parity default
+
+    def test_repair_heartbeat_miss_opt_in(self):
+        cfg = parse_config(
+            {
+                "registration": {"domain": "a.b", "type": "host"},
+                "zookeeper": {"servers": [{"host": "h", "port": 1}]},
+                "repairHeartbeatMiss": True,
+            }
+        )
+        assert cfg.repair_heartbeat_miss is True
 
     def test_top_level_admin_ip_shim(self):
         # reference main.js:146-147
@@ -122,6 +133,7 @@ class TestParse:
             lambda c: c.update(healthCheck={"command": ""}),
             lambda c: c.update(logLevel=3),
             lambda c: c.update(maxAttempts=0),
+            lambda c: c.update(repairHeartbeatMiss="yes"),
         ],
     )
     def test_invalid(self, mutate):

@@ -1,0 +1,220 @@
+"""Metrics endpoint tests: registry rendering, HTTP server, event wiring.
+
+The reference has no metrics surface (SURVEY.md §5: bunyan logs only;
+contemporaries used node-artedi).  The rebuild's opt-in `metrics` config
+block exposes Prometheus text format 0.0.4 — these tests pin the format,
+the HTTP behavior, and that the counters actually track the
+register_plus event surface end to end.
+"""
+
+import asyncio
+
+import pytest
+
+from registrar_tpu.agent import register_plus
+from registrar_tpu.metrics import (
+    Counter,
+    Gauge,
+    MetricsRegistry,
+    MetricsServer,
+    instrument,
+)
+from registrar_tpu.testing.server import ZKServer
+from registrar_tpu.zk.client import ZKClient
+
+
+async def _http_get(host, port, path):
+    reader, writer = await asyncio.open_connection(host, port)
+    writer.write(
+        f"GET {path} HTTP/1.0\r\nHost: {host}\r\n\r\n".encode()
+    )
+    await writer.drain()
+    raw = await asyncio.wait_for(reader.read(), timeout=5)
+    writer.close()
+    head, _, body = raw.partition(b"\r\n\r\n")
+    status = int(head.split()[1])
+    return status, head.decode(), body.decode()
+
+
+class TestRegistry:
+    def test_counter_rendering_and_labels(self):
+        reg = MetricsRegistry()
+        c = reg.counter("x_total", "things that happened")
+        c.inc()
+        c.inc(2, labels={"status": "ok"})
+        c.inc(labels={"status": "fail"})
+        text = reg.render()
+        assert "# HELP x_total things that happened" in text
+        assert "# TYPE x_total counter" in text
+        assert "\nx_total 1" in text
+        assert 'x_total{status="fail"} 1' in text
+        assert 'x_total{status="ok"} 2' in text
+
+    def test_counter_never_decrements(self):
+        c = Counter("c_total", "h")
+        with pytest.raises(ValueError):
+            c.inc(-1)
+
+    def test_gauge_set_and_function(self):
+        g = Gauge("g", "h")
+        g.set(2.5)
+        assert "g 2.5" in "\n".join(g.render())
+        g.set_function(lambda: 7)
+        assert "g 7" in "\n".join(g.render())
+
+    def test_unsampled_metric_renders_zero(self):
+        reg = MetricsRegistry()
+        reg.counter("quiet_total", "never incremented")
+        assert "quiet_total 0" in reg.render()
+
+    def test_duplicate_name_rejected(self):
+        reg = MetricsRegistry()
+        reg.counter("a", "h")
+        with pytest.raises(ValueError):
+            reg.gauge("a", "h")
+
+    def test_label_escaping(self):
+        c = Counter("e_total", "h")
+        c.inc(labels={"cmd": 'say "hi"\nplease'})
+        out = "\n".join(c.render())
+        assert '{cmd="say \\"hi\\"\\nplease"}' in out
+
+
+class TestHttp:
+    async def test_metrics_endpoint_and_404(self):
+        reg = MetricsRegistry()
+        reg.counter("t_total", "h").inc(3)
+        server = await MetricsServer(reg).start()
+        try:
+            status, head, body = await _http_get(
+                server.host, server.port, "/metrics"
+            )
+            assert status == 200
+            assert "text/plain; version=0.0.4" in head
+            assert "t_total 3" in body
+
+            status, _, _ = await _http_get(server.host, server.port, "/else")
+            assert status == 404
+        finally:
+            await server.stop()
+
+    async def test_oversized_request_line_dropped_cleanly(self):
+        # A request line beyond the StreamReader limit raises ValueError
+        # inside readline; the handler must drop the connection without an
+        # unhandled-task exception and keep serving.
+        reg = MetricsRegistry()
+        reg.counter("t_total", "h").inc(1)
+        server = await MetricsServer(reg).start()
+        try:
+            reader, writer = await asyncio.open_connection(
+                server.host, server.port
+            )
+            writer.write(b"GET /" + b"A" * (128 * 1024))  # no newline
+            await writer.drain()
+            try:
+                raw = await asyncio.wait_for(reader.read(), timeout=5)
+            except ConnectionResetError:
+                raw = b""  # server closed with unread bytes pending -> RST
+            assert raw == b""  # dropped, no response owed
+            writer.close()
+
+            status, _, body = await _http_get(
+                server.host, server.port, "/metrics"
+            )
+            assert status == 200 and "t_total 1" in body  # still alive
+        finally:
+            await server.stop()
+
+
+class TestInstrumentation:
+    async def test_counters_track_agent_events(self):
+        zk_server = await ZKServer().start()
+        client = await ZKClient([zk_server.address]).connect()
+        try:
+            ee = register_plus(
+                client,
+                {"domain": "metrics.test.us", "type": "host"},
+                admin_ip="10.0.0.1",
+                hostname="mbox",
+                heartbeat_interval=0.03,
+                settle_delay=0.01,
+            )
+            reg = instrument(ee, client)
+            await ee.wait_for("register", timeout=10)
+            await ee.wait_for("heartbeat", timeout=10)
+            text = reg.render()
+            assert "registrar_registrations_total 1" in text
+            assert 'registrar_heartbeats_total{status="ok"}' in text
+            # Documented label sets exist from the first scrape, so
+            # rate()/absent() alerts work before the first failure.
+            assert 'registrar_heartbeats_total{status="failure"} 0' in text
+            assert 'registrar_health_transitions_total{to="down"} 0' in text
+            assert "registrar_znodes_owned 1" in text
+            assert "registrar_zk_connected 1" in text
+            assert "registrar_health_down 0" in text
+            ee.stop()
+        finally:
+            await client.close()
+            await zk_server.stop()
+
+    async def test_daemon_serves_metrics(self):
+        """End to end through main.run(): config block -> live /metrics."""
+        import socket
+
+        from registrar_tpu.config import parse_config
+        from registrar_tpu.main import run
+
+        # Grab a free port for the metrics listener (bind(0), read, close).
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            port = s.getsockname()[1]
+
+        zk_server = await ZKServer().start()
+        cfg = parse_config(
+            {
+                "registration": {
+                    "domain": "daemon.metrics.us",
+                    "type": "host",
+                    "heartbeatInterval": 50,
+                },
+                "adminIp": "10.1.1.1",
+                "zookeeper": {
+                    "servers": [
+                        {"host": zk_server.host, "port": zk_server.port}
+                    ],
+                    "timeout": 5000,
+                },
+                "metrics": {"port": port},
+            }
+        )
+        task = asyncio.create_task(run(cfg, _exit=lambda code: None))
+        try:
+            # The pipeline includes the reference's fixed 1 s settle delay;
+            # poll until registration lands, then scrape.
+            deadline = asyncio.get_running_loop().time() + 20
+            text = None
+            while asyncio.get_running_loop().time() < deadline:
+                try:
+                    status, _, text = await _http_get(
+                        "127.0.0.1", port, "/metrics"
+                    )
+                    if (
+                        status == 200
+                        and "registrar_registrations_total 1" in text
+                    ):
+                        break
+                except OSError:
+                    pass
+                await asyncio.sleep(0.1)
+            assert text is not None
+            assert "registrar_registrations_total 1" in text
+            assert "registrar_zk_connected 1" in text
+            assert "registrar_znodes_owned 1" in text
+            assert "registrar_uptime_seconds" in text
+        finally:
+            task.cancel()
+            try:
+                await task
+            except asyncio.CancelledError:
+                pass
+            await zk_server.stop()
