@@ -58,6 +58,11 @@ def parse_args(argv=None) -> argparse.Namespace:
         help="verbose output; use multiple times for more verbosity",
     )
     parser.add_argument(
+        "-n", "--check-config", action="store_true",
+        help="validate the configuration file and exit (0 = valid); "
+        "no ZooKeeper connection is made",
+    )
+    parser.add_argument(
         "--version", action="version", version=f"registrar {__version__}"
     )
     return parser.parse_args(argv)
@@ -81,6 +86,11 @@ def configure(argv=None) -> Config:
         logging.getLogger().setLevel(level)
     if args.verbose:
         jlog.escalate(args.verbose)
+    if args.check_config:
+        # nginx -t style pre-flight for config-agent/CI pipelines: the same
+        # validation the daemon would apply, without touching ZooKeeper.
+        log.info("configuration OK", extra={"zdata": {"file": args.file}})
+        sys.exit(0)
     log.info("configuration loaded from %s", args.file,
              extra={"zdata": {"file": args.file}})
     return cfg

@@ -154,7 +154,11 @@ _WATCH_CHILD = "child"
 #: ZooKeeper (operator runbooks probe ensemble health with `ruok`/`srvr`/
 #: `mntr` — e.g. the checks the reference's README pairs with zkCli.sh).
 _FOUR_LETTER_WORDS = frozenset(
-    w.encode() for w in ("ruok", "srvr", "stat", "mntr", "cons", "dump", "wchs", "isro")
+    w.encode()
+    for w in (
+        "ruok", "srvr", "stat", "mntr", "cons", "dump", "wchs", "isro",
+        "wchc", "wchp", "envi", "conf",
+    )
 )
 
 _SERVER_VERSION = "3.4.14-registrar-tpu-testing"
@@ -397,6 +401,54 @@ class ZKServer:
                 lines.append(f"0x{sid:x}:")
                 lines.extend(f"\t{p}" for p in sorted(sess.ephemerals))
             return "\n".join(lines) + "\n"
+        if cmd in ("wchc", "wchp"):
+            # One traversal of the watch tables yields (sid, path) pairs;
+            # wchc groups by session, wchp by path (like real ZK).
+            pairs = {
+                (c.session.session_id if c.session else 0, path)
+                for kind in self._watches.values()
+                for path, conns in kind.items()
+                for c in conns
+            }
+            grouped: Dict[object, Set[object]] = {}
+            for sid, path in pairs:
+                key, member = (sid, path) if cmd == "wchc" else (path, sid)
+                grouped.setdefault(key, set()).add(member)
+
+            def show(v: object) -> str:
+                return f"0x{v:x}" if isinstance(v, int) else str(v)
+
+            lines = []
+            for key in sorted(grouped, key=show):
+                lines.append(show(key))
+                lines.extend(
+                    f"\t{show(m)}" for m in sorted(grouped[key], key=show)
+                )
+            return "\n".join(lines) + "\n"
+        if cmd == "envi":
+            import platform
+            import sys as _sys
+
+            rows = [
+                ("zookeeper.version", _SERVER_VERSION),
+                ("host.name", platform.node()),
+                ("os.name", platform.system()),
+                ("os.arch", platform.machine()),
+                ("python.version", platform.python_version()),
+                ("python.executable", _sys.executable),
+            ]
+            return "Environment:\n" + "".join(
+                f"{k}={v}\n" for k, v in rows
+            )
+        if cmd == "conf":
+            rows = [
+                ("clientPort", self.port),
+                ("minSessionTimeout", self.min_session_timeout_ms),
+                ("maxSessionTimeout", self.max_session_timeout_ms),
+                ("tickTime", self.tick_ms),
+                ("serverId", 0),
+            ]
+            return "".join(f"{k}={v}\n" for k, v in rows)
         if cmd == "wchs":
             conns_watching = len(
                 {

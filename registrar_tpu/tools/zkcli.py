@@ -293,6 +293,7 @@ async def _cmd_admin(args) -> int:
         if len(args.servers) > 1:
             print(f";; {host}:{port}")
         writer = None
+        text = None
         try:
             reader, writer = await asyncio.wait_for(
                 asyncio.open_connection(host, port), timeout=5
@@ -303,8 +304,9 @@ async def _cmd_admin(args) -> int:
             # (a single read() can return one TCP segment of a longer
             # mntr/dump response).
             out = await asyncio.wait_for(reader.read(), timeout=5)
-            print(out.decode(errors="replace").rstrip("\n"))
+            text = out.decode(errors="replace").rstrip("\n")
         except (OSError, asyncio.TimeoutError) as e:
+            # Includes server-socket EPIPE/reset: a failed probe, counted.
             print(f"zkcli: {host}:{port}: {e!r}", file=sys.stderr)
             failures += 1
         finally:
@@ -314,6 +316,11 @@ async def _cmd_admin(args) -> int:
                     await writer.wait_closed()
                 except (OSError, asyncio.TimeoutError):
                     pass
+        if text is not None:
+            # Outside the network try: a BrokenPipeError here is *stdout*
+            # going away (piped into head/grep that exited), which main()
+            # treats as a clean exit — not a probe failure.
+            print(text)
     return 1 if failures else 0
 
 
@@ -420,7 +427,8 @@ def build_parser() -> argparse.ArgumentParser:
     )
     p.add_argument(
         "word",
-        choices=["ruok", "srvr", "stat", "mntr", "cons", "dump", "wchs", "isro"],
+        choices=["ruok", "srvr", "stat", "mntr", "cons", "dump", "wchs",
+                 "isro", "wchc", "wchp", "envi", "conf"],
     )
     p.set_defaults(fn=_cmd_admin, raw=True)
 

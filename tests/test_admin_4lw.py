@@ -112,6 +112,36 @@ class TestFourLetterWords:
             finally:
                 await client.close()
 
+    async def test_wchc_and_wchp_group_watches(self):
+        async with ZKServer() as server:
+            client = await ZKClient([server.address]).connect()
+            try:
+                await client.create("/w", b"")
+                await client.get("/w", watch=True)
+                await client.get_children("/", watch=True)
+
+                by_conn = await _probe(server, "wchc")
+                assert f"0x{client.session_id:x}" in by_conn
+                assert "\t/w" in by_conn
+
+                by_path = await _probe(server, "wchp")
+                assert "/w" in by_path.splitlines()
+                assert f"\t0x{client.session_id:x}" in by_path
+            finally:
+                await client.close()
+
+    async def test_envi_and_conf(self):
+        async with ZKServer() as server:
+            envi = await _probe(server, "envi")
+            assert envi.startswith("Environment:")
+            assert "zookeeper.version=" in envi
+            assert "os.name=" in envi
+
+            conf = await _probe(server, "conf")
+            assert f"clientPort={server.port}" in conf
+            assert "maxSessionTimeout=" in conf
+            assert "tickTime=" in conf
+
     async def test_admin_probe_does_not_disturb_sessions(self):
         # A 4lw probe is a throwaway connection: existing ZK sessions and
         # the protocol path must be unaffected.

@@ -79,11 +79,47 @@ class TestRecordProperties:
     def test_arbitrary_payload_never_crashes_record_readers(self, data):
         for record in (proto.ConnectRequest, proto.ConnectResponse,
                        proto.CreateRequest, proto.ReplyHeader,
-                       proto.WatcherEvent, proto.SetWatches):
+                       proto.WatcherEvent, proto.SetWatches,
+                       proto.AuthPacket, proto.GetACLResponse,
+                       proto.SetACLRequest):
             try:
                 record.read(Reader(data))
             except (JuteError, UnicodeDecodeError):
                 pass
+
+    _acls = st.lists(
+        st.tuples(
+            st.integers(1, 31),
+            st.sampled_from(["world", "digest", "ip", "auth"]),
+            st.text(max_size=32),
+        ).map(lambda t: proto.ACL(perms=t[0], scheme=t[1], id=t[2])),
+        min_size=1,
+        max_size=8,
+    )
+
+    @given(_acls, ints)
+    def test_set_acl_request_roundtrip(self, acls, version):
+        req = proto.SetACLRequest(path="/p", acls=acls, version=version)
+        w = Writer()
+        req.write(w)
+        assert proto.SetACLRequest.read(Reader(w.to_bytes())) == req
+
+    @given(_acls)
+    def test_get_acl_response_roundtrip(self, acls):
+        resp = proto.GetACLResponse(acls=acls, stat=proto.Stat())
+        w = Writer()
+        resp.write(w)
+        assert proto.GetACLResponse.read(Reader(w.to_bytes())) == resp
+
+    @given(
+        st.sampled_from(["digest", "ip", "x"]),
+        st.one_of(st.none(), st.binary(max_size=64)),
+    )
+    def test_auth_packet_roundtrip(self, scheme, auth):
+        pkt = proto.AuthPacket(type=0, scheme=scheme, auth=auth)
+        w = Writer()
+        pkt.write(w)
+        assert proto.AuthPacket.read(Reader(w.to_bytes())) == pkt
 
     _paths = st.text(
         alphabet=st.characters(min_codepoint=ord("a"), max_codepoint=ord("z")),

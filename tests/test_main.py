@@ -40,6 +40,36 @@ class TestArgs:
         assert ei.value.code == 0
 
 
+class TestCheckConfig:
+    """-n/--check-config: validate-and-exit, no ZooKeeper involved."""
+
+    def _run(self, tmp_path, payload):
+        path = tmp_path / "cfg.json"
+        path.write_text(payload)
+        return subprocess.run(
+            [sys.executable, "-m", "registrar_tpu", "-f", str(path), "-n"],
+            cwd=REPO, capture_output=True, text=True, timeout=30,
+            env={**os.environ, "PYTHONPATH": REPO},
+        )
+
+    def test_valid_config_exits_zero(self, tmp_path):
+        out = self._run(tmp_path, json.dumps({
+            "registration": {"domain": "a.b", "type": "host"},
+            # unreachable ensemble: -n must not try to connect
+            "zookeeper": {"servers": [{"host": "192.0.2.123", "port": 9}]},
+        }))
+        assert out.returncode == 0
+        assert "configuration OK" in out.stdout
+
+    def test_invalid_config_exits_one(self, tmp_path):
+        out = self._run(tmp_path, json.dumps({
+            "registration": {"domain": "a.b", "type": "host"},
+            "zookeeper": {"servers": []},
+        }))
+        assert out.returncode == 1
+        assert "servers" in out.stdout  # the validation error is logged
+
+
 class TestEndToEnd:
     async def test_daemon_lifecycle(self, tmp_path):
         server = await ZKServer(max_session_timeout_ms=1000).start()

@@ -126,6 +126,50 @@ class TestRealZooKeeper:
         finally:
             await client.close()
 
+    async def test_acl_auth_against_real_zk(self):
+        """The digest formula and ACL records must interoperate with real
+        ZooKeeper's DigestAuthenticationProvider and fixupACL."""
+        from registrar_tpu.zk.protocol import (
+            ACL,
+            Err,
+            OPEN_ACL_UNSAFE,
+            Perms,
+            ZKError,
+            creator_all_acl,
+        )
+
+        owner = await ZKClient(_servers()).connect()
+        stranger = await ZKClient(_servers()).connect()
+        try:
+            path = f"/registrar-interop-acl-{uuid.uuid4().hex[:8]}"
+            await owner.add_auth("digest", b"interop:pw")
+            await owner.create(
+                path, b"locked", acls=creator_all_acl("interop", "pw")
+            )
+            acls, stat = await owner.get_acl(path)
+            assert acls == creator_all_acl("interop", "pw")
+            assert stat.aversion == 0
+
+            with pytest.raises(ZKError) as exc:
+                await stranger.get(path)
+            assert exc.value.code == Err.NO_AUTH
+
+            await stranger.add_auth("digest", b"interop:pw")
+            assert (await stranger.get(path))[0] == b"locked"
+
+            stat = await owner.set_acl(
+                path, list(OPEN_ACL_UNSAFE), version=0
+            )
+            assert stat.aversion == 1
+            with pytest.raises(ZKError) as exc:
+                await owner.set_acl(path, [ACL(Perms.READ, "world", "anyone")],
+                                    version=0)
+            assert exc.value.code == Err.BAD_VERSION
+            await owner.unlink(path)
+        finally:
+            await stranger.close()
+            await owner.close()
+
     async def test_watch_fires_on_real_zk(self):
         import asyncio
 
