@@ -88,7 +88,18 @@ def configure(argv=None) -> Config:
         jlog.escalate(args.verbose)
     if args.check_config:
         # nginx -t style pre-flight for config-agent/CI pipelines: the same
-        # validation the daemon would apply, without touching ZooKeeper.
+        # validation the daemon would apply, without touching ZooKeeper —
+        # including the registration schema check register_plus runs at
+        # startup (reference lib/register.js:174-201), which load_config
+        # alone does not cover.
+        from registrar_tpu.registration import _validate_registration
+
+        try:
+            _validate_registration(cfg.registration)
+        except ValueError as e:
+            log.critical("invalid registration in %s", args.file,
+                         exc_info=(type(e), e, e.__traceback__))
+            sys.exit(1)
         log.info("configuration OK", extra={"zdata": {"file": args.file}})
         sys.exit(0)
     log.info("configuration loaded from %s", args.file,

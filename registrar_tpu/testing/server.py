@@ -307,6 +307,124 @@ class ZKServer:
         walk(start, "" if path == "/" else path.rstrip("/"))
         return out
 
+    # -- disk snapshots ------------------------------------------------------
+    #
+    # Real ZooKeeper persists its tree in snapshot + txlog files so a
+    # restarted member comes back with the same data, zxid, and session
+    # table (sessions then expire normally unless their clients reattach).
+    # The standalone dev server models that with a single JSON snapshot:
+    # save on shutdown, load on start.  Like the in-memory ``snapshot=``
+    # donor, loaded sessions resume disconnected with their expiry
+    # countdown restarted.
+
+    def save_snapshot(self, path: str) -> None:
+        """Atomically write the tree + session table + zxid to ``path``."""
+        import base64
+        import json
+        import os as _os
+
+        nodes = []
+
+        def walk(node: ZNode, prefix: str) -> None:
+            nodes.append(
+                {
+                    "path": prefix or "/",
+                    "data": base64.b64encode(node.data).decode(),
+                    "ephemeral_owner": node.ephemeral_owner,
+                    "czxid": node.czxid,
+                    "mzxid": node.mzxid,
+                    "pzxid": node.pzxid,
+                    "ctime": node.ctime,
+                    "mtime": node.mtime,
+                    "version": node.version,
+                    "cversion": node.cversion,
+                    "aversion": node.aversion,
+                    "acls": [
+                        {"perms": a.perms, "scheme": a.scheme, "id": a.id}
+                        for a in node.acls
+                    ],
+                }
+            )
+            for name, child in sorted(node.children.items()):
+                walk(child, f"{prefix}/{name}")
+
+        walk(self.root, "")
+        payload = {
+            "format": 1,
+            "zxid": self.zxid,
+            "next_session": self._next_session,
+            "sessions": [
+                {
+                    "session_id": s.session_id,
+                    "passwd": base64.b64encode(s.passwd).decode(),
+                    "timeout_ms": s.timeout_ms,
+                    "ephemerals": sorted(s.ephemerals),
+                }
+                for s in self.sessions.values()
+                if not s.closed
+            ],
+            "nodes": nodes,
+        }
+        tmp = f"{path}.tmp.{_os.getpid()}"
+        with open(tmp, "w", encoding="utf-8") as f:
+            json.dump(payload, f)
+            f.flush()
+            _os.fsync(f.fileno())
+        _os.replace(tmp, path)
+
+    def load_snapshot(self, path: str) -> None:
+        """Replace this (not-yet-started) server's state from a snapshot."""
+        import base64
+        import json
+
+        if self._server is not None:
+            raise RuntimeError("load_snapshot before start()")
+        with open(path, "r", encoding="utf-8") as f:
+            payload = json.load(f)
+        if payload.get("format") != 1:
+            raise ValueError(f"unknown snapshot format {payload.get('format')!r}")
+
+        self.zxid = int(payload["zxid"])
+        self._next_session = int(payload["next_session"])
+        self.root = ZNode()
+        for entry in payload["nodes"]:
+            node = ZNode(
+                data=base64.b64decode(entry["data"]),
+                ephemeral_owner=int(entry["ephemeral_owner"]),
+                czxid=int(entry["czxid"]),
+                mzxid=int(entry["mzxid"]),
+                pzxid=int(entry["pzxid"]),
+                ctime=int(entry["ctime"]),
+                mtime=int(entry["mtime"]),
+                version=int(entry["version"]),
+                cversion=int(entry["cversion"]),
+                aversion=int(entry["aversion"]),
+                acls=[
+                    proto.ACL(a["perms"], a["scheme"], a["id"])
+                    for a in entry["acls"]
+                ],
+            )
+            p = entry["path"]
+            if p == "/":
+                node.children = self.root.children
+                self.root = node
+                continue
+            parent_path, name = self._split(p)
+            self._resolve(parent_path).children[name] = node  # parents first
+        self.sessions = {}
+        for s in payload["sessions"]:
+            sess = Session(
+                session_id=int(s["session_id"]),
+                passwd=base64.b64decode(s["passwd"]),
+                timeout_ms=int(s["timeout_ms"]),
+                last_heard=time.monotonic(),
+                ephemerals=set(s["ephemerals"]),
+            )
+            self.sessions[sess.session_id] = sess
+        # Countdowns restart when service resumes (same as the in-memory
+        # snapshot donor path in start()).
+        self._adopted_sessions = True
+
     # -- 4-letter-word admin commands ---------------------------------------
 
     def _count_nodes(self) -> Tuple[int, int]:
@@ -418,12 +536,13 @@ class ZKServer:
             def show(v: object) -> str:
                 return f"0x{v:x}" if isinstance(v, int) else str(v)
 
+            # Keys are homogeneous per command (ints for wchc, paths for
+            # wchp), so plain sorted() orders sessions numerically; show()
+            # is formatting only.
             lines = []
-            for key in sorted(grouped, key=show):
+            for key in sorted(grouped):
                 lines.append(show(key))
-                lines.extend(
-                    f"\t{show(m)}" for m in sorted(grouped[key], key=show)
-                )
+                lines.extend(f"\t{show(m)}" for m in sorted(grouped[key]))
             return "\n".join(lines) + "\n"
         if cmd == "envi":
             import platform
@@ -1255,6 +1374,11 @@ async def _amain(argv=None) -> None:
     parser.add_argument(
         "--max-session-timeout", type=int, default=60_000, metavar="MS"
     )
+    parser.add_argument(
+        "--snapshot-file", metavar="PATH", default=None,
+        help="persist the tree/sessions/zxid here on shutdown and load it "
+        "on startup when present (real ZooKeeper's snapshot analog)",
+    )
     args = parser.parse_args(argv)
     logging.basicConfig(level=logging.DEBUG)
     server = ZKServer(
@@ -1262,12 +1386,27 @@ async def _amain(argv=None) -> None:
         port=args.port,
         max_session_timeout_ms=args.max_session_timeout,
     )
+    if args.snapshot_file and os.path.exists(args.snapshot_file):
+        server.load_snapshot(args.snapshot_file)
+        print(f"loaded snapshot from {args.snapshot_file}", flush=True)
     await server.start()
     print(f"zk test server listening on {args.host}:{server.port}", flush=True)
+    stopping = asyncio.Event()
+    loop = asyncio.get_running_loop()
+    import signal as _signal
+
+    for sig in (_signal.SIGTERM, _signal.SIGINT):
+        try:
+            loop.add_signal_handler(sig, stopping.set)
+        except NotImplementedError:
+            pass
     try:
-        await asyncio.Event().wait()
+        await stopping.wait()
     finally:
         await server.stop()
+        if args.snapshot_file:
+            server.save_snapshot(args.snapshot_file)
+            print(f"saved snapshot to {args.snapshot_file}", flush=True)
 
 
 if __name__ == "__main__":

@@ -69,6 +69,16 @@ class TestCheckConfig:
         assert out.returncode == 1
         assert "servers" in out.stdout  # the validation error is logged
 
+    def test_invalid_registration_schema_exits_one(self, tmp_path):
+        # -n must apply the registration schema check register_plus runs
+        # at startup, not just the config-file shape check.
+        out = self._run(tmp_path, json.dumps({
+            "registration": {"domain": "a.b"},  # missing required type
+            "zookeeper": {"servers": [{"host": "h", "port": 1}]},
+        }))
+        assert out.returncode == 1
+        assert "registration" in out.stdout
+
 
 class TestEndToEnd:
     async def test_daemon_lifecycle(self, tmp_path):
