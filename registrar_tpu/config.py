@@ -63,6 +63,7 @@ class ZookeeperConfig:
     servers: List[Tuple[str, int]]
     timeout_ms: int = 30000
     connect_timeout_ms: int = 4000
+    chroot: Optional[str] = None
 
 
 @dataclass
@@ -106,10 +107,28 @@ def parse_config(raw: Mapping[str, Any]) -> Config:
                 f"config.zookeeper.servers[{i}] must be {{host, port}}"
             )
         servers.append((s["host"], s["port"]))
+    chroot = zk_raw.get("chroot")
+    if chroot is not None:
+        # Same validation ZKClient applies at startup (zk.protocol
+        # check_path), so the -n pre-flight and the daemon agree on what
+        # is acceptable.
+        from registrar_tpu.zk.protocol import check_path
+
+        if not isinstance(chroot, str):
+            raise ConfigError(
+                "config.zookeeper.chroot must be an absolute znode path"
+            )
+        try:
+            check_path(chroot)
+        except ValueError as e:
+            raise ConfigError(f"config.zookeeper.chroot: {e}") from e
+        if chroot == "/":
+            chroot = None
     zookeeper = ZookeeperConfig(
         servers=servers,
         timeout_ms=_ms(zk_raw, "timeout", 30000),
         connect_timeout_ms=_ms(zk_raw, "connectTimeout", 4000),
+        chroot=chroot,
     )
 
     registration = raw.get("registration")

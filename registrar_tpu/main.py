@@ -115,6 +115,7 @@ async def run(cfg: Config, *, _exit=sys.exit) -> None:
         cfg.zookeeper.servers,
         timeout_ms=cfg.zookeeper.timeout_ms,
         connect_timeout_ms=cfg.zookeeper.connect_timeout_ms,
+        chroot=cfg.zookeeper.chroot,
     )
 
     zk.on("close", lambda *a: log.warning("zookeeper: disconnected"))

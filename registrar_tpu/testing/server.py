@@ -30,6 +30,7 @@ import argparse
 import asyncio
 import logging
 import os
+import signal
 import time
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Set, Tuple
@@ -321,7 +322,6 @@ class ZKServer:
         """Atomically write the tree + session table + zxid to ``path``."""
         import base64
         import json
-        import os as _os
 
         nodes = []
 
@@ -365,12 +365,12 @@ class ZKServer:
             ],
             "nodes": nodes,
         }
-        tmp = f"{path}.tmp.{_os.getpid()}"
+        tmp = f"{path}.tmp.{os.getpid()}"
         with open(tmp, "w", encoding="utf-8") as f:
             json.dump(payload, f)
             f.flush()
-            _os.fsync(f.fileno())
-        _os.replace(tmp, path)
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
 
     def load_snapshot(self, path: str) -> None:
         """Replace this (not-yet-started) server's state from a snapshot."""
@@ -1393,9 +1393,7 @@ async def _amain(argv=None) -> None:
     print(f"zk test server listening on {args.host}:{server.port}", flush=True)
     stopping = asyncio.Event()
     loop = asyncio.get_running_loop()
-    import signal as _signal
-
-    for sig in (_signal.SIGTERM, _signal.SIGINT):
+    for sig in (signal.SIGTERM, signal.SIGINT):
         try:
             loop.add_signal_handler(sig, stopping.set)
         except NotImplementedError:

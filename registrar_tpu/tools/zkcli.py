@@ -370,6 +370,11 @@ def build_parser() -> argparse.ArgumentParser:
         help="authenticate after connecting (repeatable), e.g. "
         "digest:user:password — the zkCli.sh `addauth` equivalent",
     )
+    parser.add_argument(
+        "--chroot", metavar="/PATH", default=None,
+        help="prefix every path with this znode (the connect-string "
+        "\"host:port/app\" suffix of standard ZooKeeper clients)",
+    )
     sub = parser.add_subparsers(dest="command", required=True)
 
     p = sub.add_parser("ls", help="list children of a znode")
@@ -469,7 +474,10 @@ async def _amain(argv=None) -> int:
         return await args.fn(args)
     try:
         zk = await asyncio.wait_for(
-            ZKClient(args.servers, reconnect=False).connect(), timeout=10
+            ZKClient(
+                args.servers, reconnect=False, chroot=args.chroot
+            ).connect(),
+            timeout=10,
         )
     except Exception as e:  # noqa: BLE001
         print(f"zkcli: cannot connect to {args.servers}: {e}", file=sys.stderr)

@@ -74,6 +74,7 @@ class ZKClient(EventEmitter):
         connect_timeout_ms: int = 4000,
         reconnect: bool = True,
         reconnect_policy: Optional[RetryPolicy] = None,
+        chroot: Optional[str] = None,
     ):
         super().__init__()
         servers = list(servers)
@@ -83,6 +84,16 @@ class ZKClient(EventEmitter):
             if not isinstance(host, str) or not isinstance(port, int):
                 raise ValueError("servers must be (host, port) pairs")
         self.servers = servers
+        # Chroot: every path this client sends is prefixed with it and
+        # every path the server returns (created paths, watch events) has
+        # it stripped — the standard "host:port/app" suffix semantics of
+        # the Apache client.  The chroot node itself must already exist
+        # (like real clients, nothing is auto-created).
+        if chroot in (None, "", "/"):
+            self.chroot = ""
+        else:
+            check_path(chroot)
+            self.chroot = chroot
         self.requested_timeout_ms = timeout_ms
         self.connect_timeout_ms = connect_timeout_ms
         self.reconnect = reconnect
@@ -225,9 +236,9 @@ class ZKClient(EventEmitter):
             return
         body = proto.SetWatches(
             relative_zxid=self.last_zxid,
-            data_watches=sorted(self._watch_paths["data"]),
-            exist_watches=sorted(self._watch_paths["exist"]),
-            child_watches=sorted(self._watch_paths["child"]),
+            data_watches=sorted(map(self._abs, self._watch_paths["data"])),
+            exist_watches=sorted(map(self._abs, self._watch_paths["exist"])),
+            child_watches=sorted(map(self._abs, self._watch_paths["child"])),
         )
         try:
             await self._submit(
@@ -304,6 +315,24 @@ class ZKClient(EventEmitter):
         self.emit("state", "session_expired")
         self.emit("session_expired")
 
+    # -- chroot mapping -------------------------------------------------------
+
+    def _abs(self, path: str) -> str:
+        """Client path -> server path (prefix the chroot)."""
+        if not self.chroot:
+            return path
+        return self.chroot if path == "/" else self.chroot + path
+
+    def _rel(self, path: str) -> str:
+        """Server path -> client path (strip the chroot)."""
+        if not self.chroot:
+            return path
+        if path == self.chroot:
+            return "/"
+        if path.startswith(self.chroot + "/"):
+            return path[len(self.chroot):]
+        return path  # outside the chroot (shouldn't happen)
+
     # -- wire I/O -----------------------------------------------------------
 
     def _next_xid(self) -> int:
@@ -379,6 +408,12 @@ class ZKClient(EventEmitter):
         if event.type == proto.EventType.NONE:
             # Server-side session event (e.g. expiry notification).
             return
+        if self.chroot:
+            # Server notifications carry absolute paths; listeners (and the
+            # re-arm bookkeeping) live in client coordinates.
+            event = proto.WatcherEvent(
+                type=event.type, state=event.state, path=self._rel(event.path)
+            )
         for kind in self._EVENT_CLEARS.get(event.type, ()):
             self._watch_paths[kind].discard(event.path)
         self.emit("watch", event)
@@ -451,13 +486,13 @@ class ZKClient(EventEmitter):
         r = await self._call(
             OpCode.CREATE,
             proto.CreateRequest(
-                path=path,
+                path=self._abs(path),
                 data=data,
                 acls=list(acls) if acls is not None else list(OPEN_ACL_UNSAFE),
                 flags=flags,
             ),
         )
-        return proto.CreateResponse.read(r).path
+        return self._rel(proto.CreateResponse.read(r).path)
 
     async def create_ephemeral_plus(self, path: str, data: bytes = b"") -> str:
         """Ephemeral create that transparently creates missing parents.
@@ -485,7 +520,8 @@ class ZKClient(EventEmitter):
         check_path(path)
         try:
             r = await self._call(
-                OpCode.SET_DATA, proto.SetDataRequest(path=path, data=data)
+                OpCode.SET_DATA,
+                proto.SetDataRequest(path=self._abs(path), data=data),
             )
             return proto.SetDataResponse.read(r).stat
         except ZKError as err:
@@ -499,7 +535,8 @@ class ZKClient(EventEmitter):
             if err.code != Err.NODE_EXISTS:
                 raise
             r = await self._call(
-                OpCode.SET_DATA, proto.SetDataRequest(path=path, data=data)
+                OpCode.SET_DATA,
+                proto.SetDataRequest(path=self._abs(path), data=data),
             )
             return proto.SetDataResponse.read(r).stat
         return (await self.stat(path))
@@ -507,14 +544,18 @@ class ZKClient(EventEmitter):
     async def unlink(self, path: str, version: int = -1) -> None:
         """Delete a znode (zkplus name, reference lib/register.js:87)."""
         check_path(path)
-        await self._call(OpCode.DELETE, proto.DeleteRequest(path=path, version=version))
+        await self._call(
+            OpCode.DELETE,
+            proto.DeleteRequest(path=self._abs(path), version=version),
+        )
 
     async def stat(self, path: str, watch: bool = False) -> Stat:
         """Stat a znode; raises NO_NODE when absent (heartbeat primitive)."""
         check_path(path)
         try:
             r = await self._call(
-                OpCode.EXISTS, proto.ExistsRequest(path=path, watch=watch)
+                OpCode.EXISTS,
+                proto.ExistsRequest(path=self._abs(path), watch=watch),
             )
         except ZKError as err:
             if watch and err.code == Err.NO_NODE:
@@ -536,7 +577,8 @@ class ZKClient(EventEmitter):
     async def get(self, path: str, watch: bool = False) -> Tuple[bytes, Stat]:
         check_path(path)
         r = await self._call(
-            OpCode.GET_DATA, proto.GetDataRequest(path=path, watch=watch)
+            OpCode.GET_DATA,
+            proto.GetDataRequest(path=self._abs(path), watch=watch),
         )
         if watch:
             self._watch_paths["data"].add(path)
@@ -546,7 +588,8 @@ class ZKClient(EventEmitter):
     async def get_children(self, path: str, watch: bool = False) -> List[str]:
         check_path(path)
         r = await self._call(
-            OpCode.GET_CHILDREN2, proto.GetChildrenRequest(path=path, watch=watch)
+            OpCode.GET_CHILDREN2,
+            proto.GetChildrenRequest(path=self._abs(path), watch=watch),
         )
         if watch:
             self._watch_paths["child"].add(path)
@@ -582,8 +625,10 @@ class ZKClient(EventEmitter):
         multi-server deployments.
         """
         check_path(path)
-        r = await self._call(OpCode.SYNC, proto.SyncRequest(path=path))
-        return proto.SyncResponse.read(r).path
+        r = await self._call(
+            OpCode.SYNC, proto.SyncRequest(path=self._abs(path))
+        )
+        return self._rel(proto.SyncResponse.read(r).path)
 
     async def multi(self, ops: Sequence[Tuple[int, object]]) -> List[object]:
         """Atomically apply a transaction of :class:`Op` operations.
@@ -594,11 +639,18 @@ class ZKClient(EventEmitter):
         surface; enables e.g. atomic unregistration
         (:func:`registrar_tpu.registration.unregister` ``atomic=True``).
         """
+        import dataclasses
+
         ops = list(ops)
         if not ops:
             return []
         for _, record in ops:
             check_path(record.path)
+        if self.chroot:
+            ops = [
+                (t, dataclasses.replace(rec, path=self._abs(rec.path)))
+                for t, rec in ops
+            ]
         r = await self._call(OpCode.MULTI, proto.MultiRequest(ops=ops))
         resp = proto.MultiResponse.read(r)
         if any(isinstance(res, proto.ErrorResult) for res in resp.results):
@@ -606,7 +658,7 @@ class ZKClient(EventEmitter):
         out: List[object] = []
         for res in resp.results:
             if isinstance(res, proto.CreateResponse):
-                out.append(res.path)
+                out.append(self._rel(res.path))
             elif isinstance(res, proto.SetDataResponse):
                 out.append(res.stat)
             else:
@@ -640,7 +692,9 @@ class ZKClient(EventEmitter):
     async def get_acl(self, path: str) -> Tuple[List[proto.ACL], Stat]:
         """Read a node's ACL list and stat (aversion lives in the stat)."""
         check_path(path)
-        r = await self._call(OpCode.GET_ACL, proto.GetACLRequest(path=path))
+        r = await self._call(
+            OpCode.GET_ACL, proto.GetACLRequest(path=self._abs(path))
+        )
         resp = proto.GetACLResponse.read(r)
         return (resp.acls, resp.stat)
 
@@ -656,7 +710,9 @@ class ZKClient(EventEmitter):
         check_path(path)
         r = await self._call(
             OpCode.SET_ACL,
-            proto.SetACLRequest(path=path, acls=list(acls), version=version),
+            proto.SetACLRequest(
+                path=self._abs(path), acls=list(acls), version=version
+            ),
         )
         return proto.SetACLResponse.read(r).stat
 
@@ -754,6 +810,7 @@ async def create_zk_client(
     connect_timeout_ms: int = 4000,
     on_attempt=None,
     retry_policy: Optional[RetryPolicy] = None,
+    chroot: Optional[str] = None,
 ) -> ZKClient:
     """Create and connect a client, retrying forever (reference lib/zk.js:62-127).
 
@@ -768,6 +825,7 @@ async def create_zk_client(
         timeout_ms=timeout_ms,
         connect_timeout_ms=connect_timeout_ms,
         reconnect_policy=retry_policy,  # reconnects follow the same policy
+        chroot=chroot,
     )
 
     def backoff_log(number: int, delay: float, err: Exception) -> None:

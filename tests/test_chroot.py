@@ -1,0 +1,203 @@
+"""Chroot support: the connect-string "/app" suffix of standard ZK clients.
+
+A chrooted client sends every path prefixed and sees every returned path
+(created paths, sync, watch events, multi results) stripped.  The chroot
+node itself is never auto-created — like the Apache client and kazoo.
+The reference never chroots (zkplus had no such option), so this is
+transport surface beyond parity; default (no chroot) behavior is pinned
+unchanged by the rest of the suite.
+"""
+
+import asyncio
+
+import pytest
+
+from registrar_tpu.config import ConfigError, parse_config
+from registrar_tpu.testing.server import ZKServer
+from registrar_tpu.zk.client import Op, ZKClient
+from registrar_tpu.zk.protocol import CreateFlag, Err, ZKError
+
+
+async def _trio():
+    """Server + chrooted client (under /app) + unchrooted observer."""
+    server = await ZKServer().start()
+    observer = await ZKClient([server.address]).connect()
+    await observer.mkdirp("/app")
+    client = await ZKClient([server.address], chroot="/app").connect()
+    return server, client, observer
+
+
+class TestChrootOps:
+    async def test_paths_map_both_ways(self):
+        server, client, observer = await _trio()
+        try:
+            created = await client.create("/x", b"v")
+            assert created == "/x"  # stripped on the way back
+            assert (await observer.get("/app/x"))[0] == b"v"  # prefixed
+
+            await client.put("/deep/node", b"d")  # mkdirp fallback path
+            assert (await observer.get("/app/deep/node"))[0] == b"d"
+
+            assert await client.get_children("/") == ["deep", "x"]
+            assert (await client.stat("/x")).data_length == 1
+            assert await client.sync("/x") == "/x"
+
+            await client.unlink("/x")
+            assert await observer.exists("/app/x") is None
+
+            # root of the chroot is the chroot node itself
+            assert (await client.stat("/")).czxid == (
+                await observer.stat("/app")
+            ).czxid
+        finally:
+            await client.close()
+            await observer.close()
+            await server.stop()
+
+    async def test_ephemeral_and_acl_ops_under_chroot(self):
+        from registrar_tpu.zk.protocol import OPEN_ACL_UNSAFE
+
+        server, client, observer = await _trio()
+        try:
+            await client.create("/eph", b"", CreateFlag.EPHEMERAL)
+            st = await observer.stat("/app/eph")
+            assert st.ephemeral_owner == client.session_id
+
+            acls, stat = await client.get_acl("/eph")
+            assert acls == list(OPEN_ACL_UNSAFE)
+            await client.set_acl("/eph", list(OPEN_ACL_UNSAFE))
+            assert (await observer.stat("/app/eph")).aversion == 1
+        finally:
+            await client.close()
+            await observer.close()
+            await server.stop()
+
+    async def test_multi_paths_mapped(self):
+        server, client, observer = await _trio()
+        try:
+            results = await client.multi(
+                [Op.create("/t", b""), Op.create("/t/a", b"x")]
+            )
+            assert results == ["/t", "/t/a"]  # stripped in results
+            assert await observer.exists("/app/t/a") is not None
+        finally:
+            await client.close()
+            await observer.close()
+            await server.stop()
+
+    async def test_missing_chroot_node_is_no_node(self):
+        # Like real clients: nothing auto-creates the chroot.
+        server = await ZKServer().start()
+        client = await ZKClient([server.address], chroot="/nowhere").connect()
+        try:
+            with pytest.raises(ZKError) as exc:
+                await client.create("/x", b"")
+            assert exc.value.code == Err.NO_NODE
+        finally:
+            await client.close()
+            await server.stop()
+
+    async def test_invalid_chroot_rejected(self):
+        with pytest.raises(ValueError):
+            ZKClient([("h", 1)], chroot="no-slash")
+        with pytest.raises(ValueError):
+            ZKClient([("h", 1)], chroot="/trailing/")
+        # "/" and "" mean no chroot
+        assert ZKClient([("h", 1)], chroot="/").chroot == ""
+        assert ZKClient([("h", 1)], chroot=None).chroot == ""
+
+
+class TestChrootWatches:
+    async def test_watch_events_arrive_in_client_coordinates(self):
+        server, client, observer = await _trio()
+        try:
+            await client.create("/w", b"1")
+            events = []
+            got = asyncio.Event()
+
+            def listen(ev):
+                events.append(ev)
+                got.set()
+
+            client.watch("/w", listen)
+            await client.get("/w", watch=True)
+            await observer.put("/app/w", b"2")  # change via absolute path
+            await asyncio.wait_for(got.wait(), timeout=10)
+            assert events[0].path == "/w"  # stripped
+        finally:
+            await client.close()
+            await observer.close()
+            await server.stop()
+
+    async def test_watches_rearm_after_reconnect_under_chroot(self):
+        server, client, observer = await _trio()
+        try:
+            await client.create("/w", b"1")
+            await client.get("/w", watch=True)
+            got = asyncio.Event()
+            client.watch("/w", lambda ev: got.set())
+
+            await server.drop_connections()
+            # Change the node while the chrooted client is reconnecting;
+            # SetWatches catch-up must deliver the missed event, with the
+            # path back in client coordinates.  (The observer was dropped
+            # too — retry its write through its own reconnect.)
+            deadline = asyncio.get_running_loop().time() + 15
+            while True:
+                try:
+                    await observer.put("/app/w", b"2")
+                    break
+                except ZKError as err:
+                    if err.code != Err.CONNECTION_LOSS:
+                        raise
+                    assert asyncio.get_running_loop().time() < deadline
+                    await asyncio.sleep(0.05)
+            await asyncio.wait_for(got.wait(), timeout=15)
+        finally:
+            await client.close()
+            await observer.close()
+            await server.stop()
+
+
+class TestChrootRegistration:
+    async def test_full_registration_under_chroot(self):
+        """The whole pipeline runs in chroot coordinates; Binder (reading
+        the same chroot) sees the standard layout under the prefix."""
+        from registrar_tpu.registration import register
+
+        server, client, observer = await _trio()
+        try:
+            nodes = await register(
+                client,
+                {"domain": "chroot.test.us", "type": "host"},
+                admin_ip="10.3.3.3",
+                hostname="cbox",
+                settle_delay=0,
+            )
+            assert nodes == ["/us/test/chroot/cbox"]
+            data, st = await observer.get("/app/us/test/chroot/cbox")
+            assert st.ephemeral_owner == client.session_id
+            assert b"10.3.3.3" in data
+        finally:
+            await client.close()
+            await observer.close()
+            await server.stop()
+
+
+class TestChrootConfig:
+    def test_parse_and_normalize(self):
+        base = {
+            "registration": {"domain": "a.b", "type": "host"},
+            "zookeeper": {
+                "servers": [{"host": "h", "port": 1}], "chroot": "/app",
+            },
+        }
+        assert parse_config(base).zookeeper.chroot == "/app"
+
+        base["zookeeper"]["chroot"] = "/"
+        assert parse_config(base).zookeeper.chroot is None
+
+        for bad in ("app", "/app/", 7, "/a//b", "/a/../b"):
+            base["zookeeper"]["chroot"] = bad
+            with pytest.raises(ConfigError):
+                parse_config(base)
