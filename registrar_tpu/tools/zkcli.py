@@ -324,6 +324,12 @@ async def _cmd_admin(args) -> int:
     return 1 if failures else 0
 
 
+async def _cmd_sync(zk: ZKClient, args) -> int:
+    """Read barrier: flush the server's commit pipeline for a path."""
+    print(await zk.sync(args.path))
+    return 0
+
+
 async def _cmd_getacl(zk: ZKClient, args) -> int:
     """Print a node's ACL list in zkCli.sh's getAcl format."""
     acls, stat = await zk.get_acl(args.path)
@@ -437,6 +443,14 @@ def build_parser() -> argparse.ArgumentParser:
     )
     p.set_defaults(fn=_cmd_admin, raw=True)
 
+    p = sub.add_parser(
+        "sync",
+        help="flush the server's commit pipeline for a path (read barrier "
+        "before read-backs in multi-server ensembles)",
+    )
+    p.add_argument("path", nargs="?", default="/")
+    p.set_defaults(fn=_cmd_sync)
+
     p = sub.add_parser("getacl", help="print a znode's ACL list")
     p.add_argument("path")
     p.set_defaults(fn=_cmd_getacl)
@@ -473,12 +487,14 @@ async def _amain(argv=None) -> int:
         # Admin probes speak raw TCP per server; no ZK session involved.
         return await args.fn(args)
     try:
-        zk = await asyncio.wait_for(
-            ZKClient(
-                args.servers, reconnect=False, chroot=args.chroot
-            ).connect(),
-            timeout=10,
-        )
+        # Argument validation (e.g. a malformed --chroot) must not be
+        # reported as a connectivity problem.
+        zk = ZKClient(args.servers, reconnect=False, chroot=args.chroot)
+    except ValueError as e:
+        print(f"zkcli: {e}", file=sys.stderr)
+        return 2
+    try:
+        await asyncio.wait_for(zk.connect(), timeout=10)
     except Exception as e:  # noqa: BLE001
         print(f"zkcli: cannot connect to {args.servers}: {e}", file=sys.stderr)
         return 1

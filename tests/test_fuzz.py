@@ -261,3 +261,37 @@ class TestServerFuzz:
             await probe.close()
         finally:
             await server.stop()
+
+
+class TestChrootMapping:
+    """_abs/_rel are exact inverses for any chroot and any client path."""
+
+    _comp = st.text(
+        alphabet=st.characters(min_codepoint=ord("a"), max_codepoint=ord("z")),
+        min_size=1, max_size=8,
+    )
+    _client_paths = st.lists(_comp, min_size=0, max_size=4).map(
+        lambda parts: "/" + "/".join(parts) if parts else "/"
+    )
+    _chroots = st.lists(_comp, min_size=1, max_size=3).map(
+        lambda parts: "/" + "/".join(parts)
+    )
+
+    @given(_chroots, _client_paths)
+    def test_abs_rel_roundtrip(self, chroot, path):
+        from registrar_tpu.zk.client import ZKClient
+
+        client = ZKClient([("h", 1)], chroot=chroot)
+        absolute = client._abs(path)
+        assert absolute.startswith(chroot)
+        assert client._rel(absolute) == path
+        # _abs always yields a valid znode path
+        proto.check_path(absolute)
+
+    @given(_client_paths)
+    def test_no_chroot_is_identity(self, path):
+        from registrar_tpu.zk.client import ZKClient
+
+        client = ZKClient([("h", 1)])
+        assert client._abs(path) == path
+        assert client._rel(path) == path

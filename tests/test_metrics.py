@@ -157,6 +157,50 @@ class TestInstrumentation:
             await client.close()
             await zk_server.stop()
 
+    async def test_busy_metrics_port_does_not_block_registration(self):
+        """A busy port logs an error; registration must proceed anyway."""
+        from registrar_tpu.config import parse_config
+        from registrar_tpu.main import run
+
+        # Occupy a port for the duration.
+        blocker = await asyncio.start_server(
+            lambda r, w: None, "127.0.0.1", 0
+        )
+        port = blocker.sockets[0].getsockname()[1]
+        zk_server = await ZKServer().start()
+        cfg = parse_config(
+            {
+                "registration": {"domain": "busy.metrics.us", "type": "host"},
+                "adminIp": "10.1.1.2",
+                "zookeeper": {
+                    "servers": [
+                        {"host": zk_server.host, "port": zk_server.port}
+                    ],
+                    "timeout": 5000,
+                },
+                "metrics": {"port": port},
+            }
+        )
+        task = asyncio.create_task(run(cfg, _exit=lambda code: None))
+        probe = None
+        try:
+            probe = await ZKClient([zk_server.address]).connect()
+            deadline = asyncio.get_running_loop().time() + 20
+            while await probe.exists("/us/metrics/busy") is None:
+                assert asyncio.get_running_loop().time() < deadline
+                await asyncio.sleep(0.1)
+        finally:
+            if probe is not None:
+                await probe.close()
+            task.cancel()
+            try:
+                await task
+            except asyncio.CancelledError:
+                pass
+            blocker.close()
+            await blocker.wait_closed()
+            await zk_server.stop()
+
     async def test_daemon_serves_metrics(self):
         """End to end through main.run(): config block -> live /metrics."""
         import socket

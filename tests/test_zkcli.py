@@ -144,6 +144,15 @@ class TestZkCli:
             await client.close()
             await server.stop()
 
+    async def test_sync_command(self):
+        server = await ZKServer().start()
+        try:
+            out = await asyncio.to_thread(_run_cli, server, "sync", "/")
+            assert out.returncode == 0
+            assert out.stdout.strip() == "/"
+        finally:
+            await server.stop()
+
     async def test_acl_commands(self):
         from registrar_tpu.zk.protocol import digest_auth_id
 
