@@ -83,6 +83,22 @@ async def _bench() -> dict:
             await client.heartbeat(nodes)
         heartbeat_ms = (time.perf_counter() - t0) * 1000.0 / iters
 
+        # Binder-view resolution latency (what a DNS answer costs to
+        # assemble from the znodes; registrar_tpu/binderview.py).
+        from registrar_tpu import binderview
+
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            res = await binderview.resolve(
+                observer, REGISTRATION["domain"], "A"
+            )
+        resolve_ms = (time.perf_counter() - t0) * 1000.0 / iters
+        if res.empty:
+            raise RuntimeError(
+                "resolve benchmark measured an empty result — the timed "
+                "path was not the real answer-assembly path"
+            )
+
         return {
             "metric": "register_to_visible_ms",
             "value": round(register_ms, 2),
@@ -94,6 +110,7 @@ async def _bench() -> dict:
                 "publishes no benchmark numbers (BASELINE.md)",
                 "pipeline_ms_no_settle": round(pipeline_ms, 3),
                 "heartbeat_ms": round(heartbeat_ms, 3),
+                "resolve_a_query_ms": round(resolve_ms, 3),
                 "znodes_per_registration": len(nodes),
             },
         }

@@ -51,6 +51,11 @@ _EXPORTS = {
     "create_zk_client": "registrar_tpu.zk.client",
     "Op": "registrar_tpu.zk.client",
     "MultiError": "registrar_tpu.zk.client",
+    # extensions beyond the reference surface
+    "MetricsRegistry": "registrar_tpu.metrics",
+    "MetricsServer": "registrar_tpu.metrics",
+    "instrument": "registrar_tpu.metrics",
+    "resolve": "registrar_tpu.binderview",
 }
 
 
@@ -76,5 +81,9 @@ __all__ = [
     "create_zk_client",
     "Op",
     "MultiError",
+    "MetricsRegistry",
+    "MetricsServer",
+    "instrument",
+    "resolve",
     "__version__",
 ]

@@ -26,6 +26,19 @@ def test_flat_reexport_surface():
     assert isinstance(registrar_tpu.RegistrarEvents, type)
 
 
+def test_every_export_in_all_resolves():
+    for name in registrar_tpu.__all__:
+        assert getattr(registrar_tpu, name) is not None, name
+
+
+def test_extension_exports():
+    # beyond-reference surface: metrics + Binder-view resolution
+    assert isinstance(registrar_tpu.MetricsRegistry, type)
+    assert isinstance(registrar_tpu.MetricsServer, type)
+    assert callable(registrar_tpu.instrument)
+    assert callable(registrar_tpu.resolve)
+
+
 def test_version():
     assert registrar_tpu.__version__
 

@@ -279,8 +279,6 @@ class TestChrootMapping:
 
     @given(_chroots, _client_paths)
     def test_abs_rel_roundtrip(self, chroot, path):
-        from registrar_tpu.zk.client import ZKClient
-
         client = ZKClient([("h", 1)], chroot=chroot)
         absolute = client._abs(path)
         assert absolute.startswith(chroot)
@@ -290,8 +288,6 @@ class TestChrootMapping:
 
     @given(_client_paths)
     def test_no_chroot_is_identity(self, path):
-        from registrar_tpu.zk.client import ZKClient
-
         client = ZKClient([("h", 1)])
         assert client._abs(path) == path
         assert client._rel(path) == path
