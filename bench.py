@@ -27,6 +27,7 @@ import time
 
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
+from registrar_tpu import binderview  # noqa: E402
 from registrar_tpu.registration import register, unregister  # noqa: E402
 from registrar_tpu.testing.server import ZKServer  # noqa: E402
 from registrar_tpu.zk.client import ZKClient  # noqa: E402

@@ -1,0 +1,131 @@
+"""Kitchen-sink daemon run: every opt-in extension enabled together.
+
+Each extension is tested in isolation elsewhere; this guards their
+*interactions* — chroot + metrics + repairHeartbeatMiss + healthCheck in
+one `main.run()` — since option combinations are where integration bugs
+hide (e.g. repair re-registering through the chrooted client, metrics
+counting a health transition that raced a repair).
+"""
+
+import asyncio
+import os
+import tempfile
+
+from registrar_tpu.config import parse_config
+from registrar_tpu.main import run
+from registrar_tpu.testing.server import ZKServer
+from registrar_tpu.zk.client import ZKClient
+from tests.test_metrics import _http_get  # shared HTTP/1.0 scrape helper
+
+
+class TestAllOptionsTogether:
+    async def test_chroot_metrics_repair_health_in_one_daemon(self):
+        import socket
+
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            mport = s.getsockname()[1]
+
+        flag = tempfile.NamedTemporaryFile(delete=False)
+        flag.close()
+
+        zk_server = await ZKServer().start()
+        observer = await ZKClient([zk_server.address]).connect()
+        await observer.mkdirp("/tenant")
+        cfg = parse_config(
+            {
+                "registration": {
+                    "domain": "all.opts.us",
+                    "type": "load_balancer",
+                    "heartbeatInterval": 50,
+                },
+                "adminIp": "10.7.7.1",
+                "zookeeper": {
+                    "servers": [
+                        {"host": zk_server.host, "port": zk_server.port}
+                    ],
+                    "timeout": 5000,
+                    "chroot": "/tenant",
+                },
+                "healthCheck": {
+                    "command": f"test -f {flag.name}",
+                    "interval": 100,
+                    "threshold": 2,
+                },
+                "repairHeartbeatMiss": True,
+                "maxAttempts": 1,  # surface NO_NODE without 15 s of retries
+                "metrics": {"port": mport},
+            }
+        )
+        task = asyncio.create_task(run(cfg, _exit=lambda code: None))
+        node = "/tenant/us/opts/all"
+        try:
+            loop = asyncio.get_running_loop()
+
+            async def wait_for(pred, timeout=20):
+                deadline = loop.time() + timeout
+                while not await pred():
+                    assert loop.time() < deadline
+                    await asyncio.sleep(0.05)
+
+            # 1. Registration lands under the chroot.
+            children = []
+
+            async def registered():
+                children[:] = (
+                    await observer.get_children(node)
+                    if await observer.exists(node) else []
+                )
+                return bool(children)
+
+            await wait_for(registered)
+            hostnode = f"{node}/{children[0]}"
+
+            # 2. Metrics are served and see the registration.
+            _, _, body = await _http_get("127.0.0.1", mport, "/metrics")
+            assert "registrar_registrations_total 1" in body
+            assert "registrar_zk_connected 1" in body
+
+            # 3. Heartbeat repair works through the chrooted client: delete
+            #    the ephemeral (absolute path) and watch it come back.
+            st = await observer.stat(hostnode)
+            await observer.unlink(hostnode)
+
+            async def repaired():
+                new = await observer.exists(hostnode)
+                return new is not None and new.czxid != st.czxid
+
+            await wait_for(repaired)
+
+            # 4. Health down deregisters (and repair must NOT undo it).
+            os.unlink(flag.name)
+
+            async def gone():
+                return await observer.exists(hostnode) is None
+
+            await wait_for(gone)
+            await asyncio.sleep(0.5)  # repair window: stays deregistered
+            assert await observer.exists(hostnode) is None
+            _, _, body = await _http_get("127.0.0.1", mport, "/metrics")
+            assert "registrar_health_down 1" in body
+            assert 'registrar_health_transitions_total{to="down"} 1' in body
+
+            # 5. Recovery re-registers under the chroot.
+            open(flag.name, "w").close()
+
+            async def back():
+                return await observer.exists(hostnode) is not None
+
+            await wait_for(back)
+            _, _, body = await _http_get("127.0.0.1", mport, "/metrics")
+            assert "registrar_health_down 0" in body
+        finally:
+            task.cancel()
+            try:
+                await task
+            except asyncio.CancelledError:
+                pass
+            await observer.close()
+            await zk_server.stop()
+            if os.path.exists(flag.name):
+                os.unlink(flag.name)
