@@ -86,8 +86,6 @@ async def _bench() -> dict:
 
         # Binder-view resolution latency (what a DNS answer costs to
         # assemble from the znodes; registrar_tpu/binderview.py).
-        from registrar_tpu import binderview
-
         t0 = time.perf_counter()
         for _ in range(iters):
             res = await binderview.resolve(

@@ -1,0 +1,100 @@
+"""Release artifact smoke test (SURVEY.md §2.9: Makefile release tarball).
+
+The reference's `make release` ships a tarball rooted at
+/opt/smartdc/registrar containing everything the daemon needs; ours
+roots at opt/registrar.  Building the tarball is CI's job — this test
+goes further and proves the *extracted artifact runs*: config
+validation and a real registration driven solely from the unpacked
+tree, without the repo on the path.
+"""
+
+import asyncio
+import json
+import os
+import shutil
+import subprocess
+import sys
+import tarfile
+
+import pytest
+
+from registrar_tpu.testing.server import ZKServer
+from registrar_tpu.zk.client import ZKClient
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+pytestmark = pytest.mark.skipif(
+    shutil.which("make") is None, reason="make not available"
+)
+
+
+class TestReleaseArtifact:
+    async def test_tarball_contents_run_standalone(self, tmp_path):
+        tarball = os.path.join(REPO, "registrar-release.tar.gz")
+        build = await asyncio.to_thread(
+            subprocess.run,
+            ["make", "release"],
+            cwd=REPO, capture_output=True, text=True, timeout=120,
+        )
+        assert build.returncode == 0, build.stderr
+        assert os.path.exists(tarball)
+
+        with tarfile.open(tarball) as tf:
+            names = tf.getnames()
+            try:
+                tf.extractall(tmp_path, filter="data")
+            except TypeError:  # Python < 3.12: no filter kwarg
+                tf.extractall(tmp_path)
+        root = tmp_path / "opt" / "registrar"
+        assert (root / "registrar_tpu" / "main.py").exists()
+        assert (root / "etc" / "config.coal.json").exists()
+        assert any("systemd" in n for n in names)
+
+        # Environment pointing ONLY at the extracted tree.
+        env = {
+            k: v for k, v in os.environ.items() if k != "PYTHONPATH"
+        }
+        env["PYTHONPATH"] = str(root)
+
+        server = await ZKServer().start()
+        cfg_path = tmp_path / "cfg.json"
+        cfg_path.write_text(json.dumps({
+            "registration": {"domain": "rel.test.us", "type": "host",
+                             "heartbeatInterval": 200},
+            "adminIp": "10.11.11.11",
+            "zookeeper": {"servers": [{"host": server.host,
+                                       "port": server.port}],
+                          "timeout": 5000},
+        }))
+        try:
+            # 1. Config pre-flight from the artifact.
+            out = await asyncio.to_thread(
+                subprocess.run,
+                [sys.executable, "-m", "registrar_tpu",
+                 "-f", str(cfg_path), "-n"],
+                cwd=tmp_path, env=env, capture_output=True, text=True,
+                timeout=30,
+            )
+            assert out.returncode == 0, out.stdout + out.stderr
+            assert "configuration OK" in out.stdout
+
+            # 2. The daemon from the artifact registers for real.
+            proc = subprocess.Popen(
+                [sys.executable, "-m", "registrar_tpu", "-f", str(cfg_path)],
+                cwd=tmp_path, env=env,
+                stdout=subprocess.DEVNULL, stderr=subprocess.STDOUT,
+            )
+            try:
+                probe = await ZKClient([server.address]).connect()
+                deadline = asyncio.get_running_loop().time() + 20
+                while await probe.exists("/us/test/rel") is None:
+                    assert asyncio.get_running_loop().time() < deadline
+                    await asyncio.sleep(0.1)
+                await probe.close()
+            finally:
+                proc.terminate()
+                await asyncio.to_thread(proc.wait, 15)
+        finally:
+            await server.stop()
+            if os.path.exists(tarball):
+                os.unlink(tarball)
