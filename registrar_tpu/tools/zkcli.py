@@ -162,7 +162,7 @@ async def _cmd_tree(zk: ZKClient, args) -> int:
 
 
 async def _cmd_rm(zk: ZKClient, args) -> int:
-    await zk.unlink(args.path)
+    await zk.unlink(args.path, version=args.version)
     return 0
 
 
@@ -217,7 +217,10 @@ async def _cmd_create(zk: ZKClient, args) -> int:
         flags = CreateFlag.EPHEMERAL
     elif args.sequential:
         flags = CreateFlag.PERSISTENT_SEQUENTIAL
-    path = await zk.create(args.path, args.data.encode(), flags)
+    path = await zk.create(
+        args.path, args.data.encode(), flags,
+        acls=args.acl if args.acl else None,
+    )
     print(path)
     if args.ephemeral:
         # An ephemeral dies with this CLI's session the moment we exit —
@@ -231,7 +234,14 @@ async def _cmd_create(zk: ZKClient, args) -> int:
 
 
 async def _cmd_set(zk: ZKClient, args) -> int:
-    stat = await zk.put(args.path, args.data.encode())
+    if args.version != -1:
+        # Conditional set is a plain setData (no create-if-missing
+        # fallback: an expected version implies the node exists).
+        stat = await zk.set_data(
+            args.path, args.data.encode(), version=args.version
+        )
+    else:
+        stat = await zk.put(args.path, args.data.encode())
     print(f"version = {stat.version}")
     return 0
 
@@ -402,6 +412,11 @@ def build_parser() -> argparse.ArgumentParser:
 
     p = sub.add_parser("rm", help="delete a znode")
     p.add_argument("path")
+    p.add_argument(
+        "--version", type=int, default=-1,
+        help="expected data version (conditional delete; default: "
+        "unconditional)",
+    )
     p.set_defaults(fn=_cmd_rm)
 
     p = sub.add_parser("rmr", help="delete a znode subtree, children first")
@@ -413,11 +428,22 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("data", nargs="?", default="")
     p.add_argument("-e", "--ephemeral", action="store_true")
     p.add_argument("-s", "--sequential", action="store_true")
+    p.add_argument(
+        "-a", "--acl", type=_parse_acl, action="append", default=[],
+        metavar="SCHEME:ID:PERMS",
+        help="ACL entries for the new node (repeatable; default "
+        "world:anyone:cdrwa)",
+    )
     p.set_defaults(fn=_cmd_create)
 
     p = sub.add_parser("set", help="set a znode's data (creates if missing)")
     p.add_argument("path")
     p.add_argument("data")
+    p.add_argument(
+        "--version", type=int, default=-1,
+        help="expected data version (conditional set, no create-if-missing; "
+        "default: unconditional upsert)",
+    )
     p.set_defaults(fn=_cmd_set)
 
     p = sub.add_parser("mkdirp", help="create a path and missing ancestors")

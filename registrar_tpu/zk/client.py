@@ -519,11 +519,7 @@ class ZKClient(EventEmitter):
         """
         check_path(path)
         try:
-            r = await self._call(
-                OpCode.SET_DATA,
-                proto.SetDataRequest(path=self._abs(path), data=data),
-            )
-            return proto.SetDataResponse.read(r).stat
+            return await self.set_data(path, data)
         except ZKError as err:
             if err.code != Err.NO_NODE:
                 raise
@@ -534,12 +530,25 @@ class ZKClient(EventEmitter):
         except ZKError as err:
             if err.code != Err.NODE_EXISTS:
                 raise
-            r = await self._call(
-                OpCode.SET_DATA,
-                proto.SetDataRequest(path=self._abs(path), data=data),
-            )
-            return proto.SetDataResponse.read(r).stat
+            return await self.set_data(path, data)  # lost the create race
         return (await self.stat(path))
+
+    async def set_data(
+        self, path: str, data: bytes, version: int = -1
+    ) -> Stat:
+        """Plain setData: NO_NODE if absent, BAD_VERSION on mismatch.
+
+        Unlike :meth:`put` (zkplus semantics: create-if-missing), this is
+        the raw ZooKeeper op — the right primitive for conditional writes.
+        """
+        check_path(path)
+        r = await self._call(
+            OpCode.SET_DATA,
+            proto.SetDataRequest(
+                path=self._abs(path), data=data, version=version
+            ),
+        )
+        return proto.SetDataResponse.read(r).stat
 
     async def unlink(self, path: str, version: int = -1) -> None:
         """Delete a znode (zkplus name, reference lib/register.js:87)."""

@@ -119,6 +119,27 @@ class TestOps:
             await client.close()
             await server.stop()
 
+    async def test_set_data_plain_semantics(self):
+        # Unlike put (zkplus create-if-missing), set_data is the raw op:
+        # NO_NODE when absent, BAD_VERSION on mismatch.
+        server, client = await _pair()
+        try:
+            with pytest.raises(ZKError) as exc:
+                await client.set_data("/absent", b"x")
+            assert exc.value.code == Err.NO_NODE
+
+            await client.create("/n", b"v0")
+            with pytest.raises(ZKError) as exc:
+                await client.set_data("/n", b"v1", version=9)
+            assert exc.value.code == Err.BAD_VERSION
+
+            stat = await client.set_data("/n", b"v1", version=0)
+            assert stat.version == 1
+            assert (await client.get("/n"))[0] == b"v1"
+        finally:
+            await client.close()
+            await server.stop()
+
     async def test_mkdirp_and_nested_create(self):
         server, client = await _pair()
         try:

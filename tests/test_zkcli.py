@@ -144,6 +144,55 @@ class TestZkCli:
             await client.close()
             await server.stop()
 
+    async def test_conditional_writes_and_create_acl(self):
+        server = await ZKServer().start()
+        client = await ZKClient([server.address]).connect()
+        try:
+            await client.create("/c", b"v0")
+
+            # conditional set: wrong version refused, right version lands
+            out = await asyncio.to_thread(
+                _run_cli, server, "set", "/c", "v1", "--version", "7"
+            )
+            assert out.returncode == 1 and "BAD_VERSION" in out.stderr
+            out = await asyncio.to_thread(
+                _run_cli, server, "set", "/c", "v1", "--version", "0"
+            )
+            assert out.returncode == 0 and "version = 1" in out.stdout
+
+            # conditional set never creates
+            out = await asyncio.to_thread(
+                _run_cli, server, "set", "/nope", "x", "--version", "0"
+            )
+            assert out.returncode == 1 and "NO_NODE" in out.stderr
+
+            # conditional rm
+            out = await asyncio.to_thread(
+                _run_cli, server, "rm", "/c", "--version", "0"
+            )
+            assert out.returncode == 1 and "BAD_VERSION" in out.stderr
+            out = await asyncio.to_thread(
+                _run_cli, server, "rm", "/c", "--version", "1"
+            )
+            assert out.returncode == 0
+            assert await client.exists("/c") is None
+
+            # create with explicit ACLs
+            out = await asyncio.to_thread(
+                _run_cli, server, "create", "-a", "world:anyone:r",
+                "/readonly", "data",
+            )
+            assert out.returncode == 0
+            out = await asyncio.to_thread(_run_cli, server, "getacl", "/readonly")
+            assert "'world,'anyone" in out.stdout and ": r\n" in out.stdout
+            out = await asyncio.to_thread(
+                _run_cli, server, "set", "/readonly", "x"
+            )
+            assert out.returncode == 1 and "NO_AUTH" in out.stderr
+        finally:
+            await client.close()
+            await server.stop()
+
     async def test_sync_command(self):
         server = await ZKServer().start()
         try:
