@@ -72,6 +72,18 @@ class MetricsConfig:
     host: str = "127.0.0.1"
 
 
+#: top-level keys the daemon understands (reference keys + extensions);
+#: anything else is reported in Config.unknown_keys so the mainline can
+#: warn about probable typos ("healthcheck" vs "healthCheck") without
+#: breaking the reference's ignore-unknown-keys behavior.
+KNOWN_TOP_LEVEL_KEYS = frozenset(
+    {
+        "adminIp", "zookeeper", "registration", "healthCheck", "logLevel",
+        "maxAttempts", "repairHeartbeatMiss", "metrics",
+    }
+)
+
+
 @dataclass
 class Config:
     zookeeper: ZookeeperConfig
@@ -83,6 +95,9 @@ class Config:
     heartbeat_retry: RetryPolicy = field(default_factory=lambda: HEARTBEAT_RETRY)
     repair_heartbeat_miss: bool = False
     metrics: Optional[MetricsConfig] = None
+    #: unrecognized top-level keys (ignored, like the reference — but
+    #: surfaced so the daemon can warn about probable typos)
+    unknown_keys: Tuple[str, ...] = ()
 
 
 def parse_config(raw: Mapping[str, Any]) -> Config:
@@ -218,6 +233,9 @@ def parse_config(raw: Mapping[str, Any]) -> Config:
         heartbeat_retry=heartbeat_retry,
         repair_heartbeat_miss=repair,
         metrics=metrics,
+        unknown_keys=tuple(
+            sorted(set(raw) - KNOWN_TOP_LEVEL_KEYS)
+        ),
     )
 
 

@@ -86,6 +86,15 @@ def configure(argv=None) -> Config:
         logging.getLogger().setLevel(level)
     if args.verbose:
         jlog.escalate(args.verbose)
+    if cfg.unknown_keys:
+        # Ignored like the reference ignores them — but a typo like
+        # "healthcheck" silently disabling health checking is worth a
+        # warning.
+        log.warning(
+            "configuration has unrecognized top-level keys (ignored): %s",
+            ", ".join(cfg.unknown_keys),
+            extra={"zdata": {"keys": list(cfg.unknown_keys)}},
+        )
     if args.check_config:
         # nginx -t style pre-flight for config-agent/CI pipelines: the same
         # validation the daemon would apply, without touching ZooKeeper —

@@ -49,6 +49,18 @@ class TestParse:
         assert cfg.admin_ip is None
         assert cfg.repair_heartbeat_miss is False  # parity default
 
+    def test_unknown_top_level_keys_surfaced(self):
+        cfg = parse_config(
+            {
+                "registration": {"domain": "a.b", "type": "host"},
+                "zookeeper": {"servers": [{"host": "h", "port": 1}]},
+                "healthcheck": {"command": "true"},  # typo: lowercase c
+                "zzz": 1,
+            }
+        )
+        assert cfg.unknown_keys == ("healthcheck", "zzz")
+        assert cfg.health_check is None  # the typo key was NOT honored
+
     def test_repair_heartbeat_miss_opt_in(self):
         cfg = parse_config(
             {

@@ -69,6 +69,16 @@ class TestCheckConfig:
         assert out.returncode == 1
         assert "servers" in out.stdout  # the validation error is logged
 
+    def test_unknown_keys_warn_but_validate(self, tmp_path):
+        out = self._run(tmp_path, json.dumps({
+            "registration": {"domain": "a.b", "type": "host"},
+            "zookeeper": {"servers": [{"host": "h", "port": 1}]},
+            "healthcheck": {"command": "true"},  # typo: lowercase c
+        }))
+        assert out.returncode == 0  # still valid (ignored, like the ref)
+        assert "unrecognized top-level keys" in out.stdout
+        assert "healthcheck" in out.stdout
+
     def test_invalid_registration_schema_exits_one(self, tmp_path):
         # -n must apply the registration schema check register_plus runs
         # at startup, not just the config-file shape check.
