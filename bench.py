@@ -98,6 +98,34 @@ async def _bench() -> dict:
                 "path was not the real answer-assembly path"
             )
 
+        # Concurrent-registrar throughput: N independent sessions (the
+        # real deployment shape — one registrar per zone) registering
+        # distinct domains at once, settle-free.
+        n_conc = 20
+        conc_clients = [
+            await ZKClient([server.address]).connect() for _ in range(n_conc)
+        ]
+        try:
+            t0 = time.perf_counter()
+            await asyncio.gather(
+                *(
+                    register(
+                        c,
+                        {"domain": f"c{i}.bench.emy-10.joyent.us",
+                         "type": "host"},
+                        admin_ip="10.0.0.2",
+                        hostname=f"host{i}",
+                        settle_delay=0,
+                    )
+                    for i, c in enumerate(conc_clients)
+                )
+            )
+            conc_s = time.perf_counter() - t0
+        finally:
+            for c in conc_clients:
+                await c.close()
+        throughput = n_conc / conc_s
+
         return {
             "metric": "register_to_visible_ms",
             "value": round(register_ms, 2),
@@ -110,6 +138,7 @@ async def _bench() -> dict:
                 "pipeline_ms_no_settle": round(pipeline_ms, 3),
                 "heartbeat_ms": round(heartbeat_ms, 3),
                 "resolve_a_query_ms": round(resolve_ms, 3),
+                "concurrent_registrations_per_s": round(throughput, 1),
                 "znodes_per_registration": len(nodes),
             },
         }

@@ -68,6 +68,14 @@ def parse_args(argv=None) -> argparse.Namespace:
     return parser.parse_args(argv)
 
 
+#: exit status for configuration errors (BSD sysexits EX_CONFIG).
+#: Distinct from the generic exit(1) used for runtime failures (session
+#: expiry, failed initial registration) so the supervisor can crash-
+#: restart on the latter but stop retrying a config that can never work
+#: (systemd/registrar.service sets RestartPreventExitStatus=78).
+EX_CONFIG = 78
+
+
 def configure(argv=None) -> Config:
     """Parse args + config, set up logging (reference main.js:52-84)."""
     args = parse_args(argv)
@@ -77,24 +85,25 @@ def configure(argv=None) -> Config:
     except ConfigError as e:
         log.critical("unable to read configuration %s", args.file,
                      exc_info=(type(e), e, e.__traceback__))
-        sys.exit(1)
-    if cfg.log_level:
-        level = jlog.LEVELS.get(cfg.log_level.lower())
-        if level is None:
-            log.critical("invalid logLevel %r", cfg.log_level)
-            sys.exit(1)
-        logging.getLogger().setLevel(level)
-    if args.verbose:
-        jlog.escalate(args.verbose)
+        sys.exit(EX_CONFIG)
     if cfg.unknown_keys:
         # Ignored like the reference ignores them — but a typo like
         # "healthcheck" silently disabling health checking is worth a
-        # warning.
+        # warning.  Emitted BEFORE the config's own logLevel applies, so
+        # a {"logLevel": "error"} config cannot suppress it.
         log.warning(
             "configuration has unrecognized top-level keys (ignored): %s",
             ", ".join(cfg.unknown_keys),
             extra={"zdata": {"keys": list(cfg.unknown_keys)}},
         )
+    if cfg.log_level:
+        level = jlog.LEVELS.get(cfg.log_level.lower())
+        if level is None:
+            log.critical("invalid logLevel %r", cfg.log_level)
+            sys.exit(EX_CONFIG)
+        logging.getLogger().setLevel(level)
+    if args.verbose:
+        jlog.escalate(args.verbose)
     if args.check_config:
         # nginx -t style pre-flight for config-agent/CI pipelines: the same
         # validation the daemon would apply, without touching ZooKeeper —
@@ -108,7 +117,7 @@ def configure(argv=None) -> Config:
         except ValueError as e:
             log.critical("invalid registration in %s", args.file,
                          exc_info=(type(e), e, e.__traceback__))
-            sys.exit(1)
+            sys.exit(EX_CONFIG)
         log.info("configuration OK", extra={"zdata": {"file": args.file}})
         sys.exit(0)
     log.info("configuration loaded from %s", args.file,

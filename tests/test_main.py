@@ -61,12 +61,14 @@ class TestCheckConfig:
         assert out.returncode == 0
         assert "configuration OK" in out.stdout
 
-    def test_invalid_config_exits_one(self, tmp_path):
+    def test_invalid_config_exits_ex_config(self, tmp_path):
+        # 78 = EX_CONFIG: distinct from runtime exit(1) so systemd's
+        # RestartPreventExitStatus can stop a bad config crash-looping.
         out = self._run(tmp_path, json.dumps({
             "registration": {"domain": "a.b", "type": "host"},
             "zookeeper": {"servers": []},
         }))
-        assert out.returncode == 1
+        assert out.returncode == 78
         assert "servers" in out.stdout  # the validation error is logged
 
     def test_unknown_keys_warn_but_validate(self, tmp_path):
@@ -79,14 +81,26 @@ class TestCheckConfig:
         assert "unrecognized top-level keys" in out.stdout
         assert "healthcheck" in out.stdout
 
-    def test_invalid_registration_schema_exits_one(self, tmp_path):
+    def test_unknown_key_warning_survives_quiet_log_level(self, tmp_path):
+        # The warning must be emitted before the config's own logLevel
+        # applies, or {"logLevel": "error"} would suppress it.
+        out = self._run(tmp_path, json.dumps({
+            "registration": {"domain": "a.b", "type": "host"},
+            "zookeeper": {"servers": [{"host": "h", "port": 1}]},
+            "logLevel": "error",
+            "healthcheck": {"command": "true"},
+        }))
+        assert out.returncode == 0
+        assert "unrecognized top-level keys" in out.stdout
+
+    def test_invalid_registration_schema_exits_ex_config(self, tmp_path):
         # -n must apply the registration schema check register_plus runs
         # at startup, not just the config-file shape check.
         out = self._run(tmp_path, json.dumps({
             "registration": {"domain": "a.b"},  # missing required type
             "zookeeper": {"servers": [{"host": "h", "port": 1}]},
         }))
-        assert out.returncode == 1
+        assert out.returncode == 78
         assert "registration" in out.stdout
 
 
