@@ -55,7 +55,18 @@ from registrar_tpu.retry import HEARTBEAT_RETRY, RetryPolicy
 
 
 class ConfigError(ValueError):
-    """Invalid or unreadable configuration."""
+    """Invalid configuration (parse or validation failure)."""
+
+
+class ConfigUnreadableError(ConfigError):
+    """The config file could not be *read* (missing, permissions, I/O).
+
+    Distinct from semantic invalidity because the right supervisor
+    reaction differs: a file that is not there yet (config-agent racing
+    the unit at boot) is cured by restarting, while a config that parses
+    but can never validate is not — main.py exits 1 for the former and
+    EX_CONFIG (78) for the latter.
+    """
 
 
 @dataclass
@@ -244,8 +255,12 @@ def load_config(path: str) -> Config:
     try:
         with open(path, "r", encoding="utf-8") as f:
             raw = json.load(f)
-    except (OSError, json.JSONDecodeError) as e:
-        raise ConfigError(f"unable to read configuration {path}: {e}") from e
+    except OSError as e:
+        raise ConfigUnreadableError(
+            f"unable to read configuration {path}: {e}"
+        ) from e
+    except json.JSONDecodeError as e:
+        raise ConfigError(f"unable to parse configuration {path}: {e}") from e
     return parse_config(raw)
 
 

@@ -39,7 +39,12 @@ import sys
 from registrar_tpu import __version__
 from registrar_tpu import jlog
 from registrar_tpu.agent import register_plus
-from registrar_tpu.config import Config, ConfigError, load_config
+from registrar_tpu.config import (
+    Config,
+    ConfigError,
+    ConfigUnreadableError,
+    load_config,
+)
 from registrar_tpu.zk.client import ZKClient, create_zk_client
 
 
@@ -82,8 +87,15 @@ def configure(argv=None) -> Config:
     log = jlog.setup("registrar")
     try:
         cfg = load_config(args.file)
-    except ConfigError as e:
+    except ConfigUnreadableError as e:
+        # Read failures (file not provisioned yet, permissions) are often
+        # transient — exit 1 so the supervisor's restart can cure them,
+        # unlike the EX_CONFIG path below which it must not retry.
         log.critical("unable to read configuration %s", args.file,
+                     exc_info=(type(e), e, e.__traceback__))
+        sys.exit(1)
+    except ConfigError as e:
+        log.critical("invalid configuration %s", args.file,
                      exc_info=(type(e), e, e.__traceback__))
         sys.exit(EX_CONFIG)
     if cfg.unknown_keys:

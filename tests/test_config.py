@@ -49,6 +49,21 @@ class TestParse:
         assert cfg.admin_ip is None
         assert cfg.repair_heartbeat_miss is False  # parity default
 
+    def test_example_config_validates(self):
+        # etc/config.example.json documents every key; it must stay valid
+        # (the same check registrar -n applies).
+        import os
+
+        from registrar_tpu.registration import _validate_registration
+
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        cfg = load_config(os.path.join(repo, "etc", "config.example.json"))
+        _validate_registration(cfg.registration)
+        assert cfg.unknown_keys == ()
+        assert cfg.zookeeper.chroot == "/tenants/example"
+        assert cfg.metrics.port == 9090
+        assert cfg.health_check["stdout_match"]["invert"] is True
+
     def test_unknown_top_level_keys_surfaced(self):
         cfg = parse_config(
             {
@@ -163,11 +178,17 @@ class TestLoad:
         assert cfg.registration["type"] == "host"
 
     def test_missing_file(self):
-        with pytest.raises(ConfigError):
+        from registrar_tpu.config import ConfigUnreadableError
+
+        with pytest.raises(ConfigUnreadableError):
             load_config("/nonexistent/config.json")
 
     def test_malformed_json(self, tmp_path):
+        from registrar_tpu.config import ConfigUnreadableError
+
         p = tmp_path / "bad.json"
         p.write_text("{nope")
-        with pytest.raises(ConfigError):
+        with pytest.raises(ConfigError) as exc:
             load_config(str(p))
+        # parse failure is invalid-config (EX_CONFIG), not unreadable
+        assert not isinstance(exc.value, ConfigUnreadableError)

@@ -46,10 +46,13 @@ class TestCheckConfig:
     def _run(self, tmp_path, payload):
         path = tmp_path / "cfg.json"
         path.write_text(payload)
+        # Pin LOG_LEVEL: an ambient LOG_LEVEL=error in the caller's shell
+        # would suppress the log lines these tests assert on.
+        env = {**os.environ, "PYTHONPATH": REPO, "LOG_LEVEL": "info"}
         return subprocess.run(
             [sys.executable, "-m", "registrar_tpu", "-f", str(path), "-n"],
             cwd=REPO, capture_output=True, text=True, timeout=30,
-            env={**os.environ, "PYTHONPATH": REPO},
+            env=env,
         )
 
     def test_valid_config_exits_zero(self, tmp_path):
@@ -60,6 +63,19 @@ class TestCheckConfig:
         }))
         assert out.returncode == 0
         assert "configuration OK" in out.stdout
+
+    def test_missing_file_exits_one_not_ex_config(self, tmp_path):
+        # A file that is not there yet (config-agent racing the unit at
+        # boot) is transient: exit 1 so Restart=always retries, NOT 78
+        # which RestartPreventExitStatus would make permanent.
+        env = {**os.environ, "PYTHONPATH": REPO, "LOG_LEVEL": "info"}
+        out = subprocess.run(
+            [sys.executable, "-m", "registrar_tpu",
+             "-f", str(tmp_path / "nope.json"), "-n"],
+            cwd=REPO, capture_output=True, text=True, timeout=30, env=env,
+        )
+        assert out.returncode == 1
+        assert "unable to read" in out.stdout
 
     def test_invalid_config_exits_ex_config(self, tmp_path):
         # 78 = EX_CONFIG: distinct from runtime exit(1) so systemd's
