@@ -30,7 +30,7 @@ release:
 	rm -rf $(RELSTAGEDIR)
 	mkdir -p $(RELSTAGEDIR)/opt/registrar/etc
 	cp -r registrar_tpu systemd smf docs $(RELSTAGEDIR)/opt/registrar/
-	cp etc/config.coal.json $(RELSTAGEDIR)/opt/registrar/etc/
+	cp etc/config.coal.json etc/config.example.json $(RELSTAGEDIR)/opt/registrar/etc/
 	cp README.md pyproject.toml $(RELSTAGEDIR)/opt/registrar/
 	find $(RELSTAGEDIR) -name __pycache__ -type d | xargs rm -rf
 	tar -czf $(RELEASE_TARBALL) -C $(RELSTAGEDIR) opt
