@@ -7,7 +7,7 @@ NAME = registrar
 RELEASE_TARBALL = $(NAME)-release.tar.gz
 RELSTAGEDIR = /tmp/$(NAME)-release
 
-.PHONY: all check test bench release publish clean
+.PHONY: all check test test-jax bench release publish clean
 
 all: check test
 
@@ -18,8 +18,20 @@ check:
 	$(PYTHON) -c "import registrar_tpu, registrar_tpu.main, \
 	    registrar_tpu.testing.server, registrar_tpu.config"
 
+# Hermetic suite: jax-marked tests are deselected via pyproject addopts,
+# because jax backend init can take minutes in some environments.  (In the
+# reference — its Node.js Makefile, lines 66-68 — `make test` runs the
+# whole suite unconditionally; ours matches that by keeping every
+# always-runnable test in this target.)
 test:
 	$(PYTHON) -m pytest tests/ -x -q
+
+# Opt-in driver-harness compliance tests.  The recipe scrubs the image's
+# TPU-plugin sitecustomize triggers (see __graft_entry__._child_env) so
+# the pytest parent can never wedge on experimental-backend init.
+test-jax:
+	env -u PALLAS_AXON_POOL_IPS -u PYTHONPATH JAX_PLATFORMS=cpu \
+	    $(PYTHON) -m pytest tests/test_graft_entry.py -m jax -x -q
 
 bench:
 	$(PYTHON) bench.py

@@ -12,13 +12,22 @@ import inspect
 import os
 import sys
 
-# Must be set before jax is imported anywhere in the test process.
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Must be set before jax is imported anywhere in the test process.  An
+# explicit override (not setdefault): the image may pin JAX_PLATFORMS to
+# an experimental TPU plugin whose initialization can hang for minutes;
+# the opt-in jax-marked tests validate the virtual CPU mesh only.
+os.environ["JAX_PLATFORMS"] = "cpu"
 xla_flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in xla_flags:
     os.environ["XLA_FLAGS"] = (
         xla_flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+if "jax" in sys.modules:
+    # The image's sitecustomize may have imported jax at interpreter start
+    # (binding JAX_PLATFORMS=axon from the env); the env change above is
+    # then too late, so pin the live config too.  Backends have not been
+    # initialized yet at conftest-import time, so this still takes effect.
+    sys.modules["jax"].config.update("jax_platforms", "cpu")
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
@@ -55,3 +64,8 @@ def pytest_pyfunc_call(pyfuncitem):
 
 def pytest_configure(config):
     config.addinivalue_line("markers", "asyncio_shim: run coroutine test via asyncio.run")
+    config.addinivalue_line(
+        "markers",
+        "jax: needs jax; deselected by default (see pyproject addopts), "
+        "run with `make test-jax`",
+    )

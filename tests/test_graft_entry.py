@@ -1,13 +1,24 @@
 """Driver-harness compliance tests for __graft_entry__.py.
 
-The conftest pins JAX to the virtual 8-device CPU platform before import.
+The jax-dependent tests are marked ``jax`` and deselected by default
+(pyproject addopts): in some environments jax backend initialization can
+take minutes (the image's sitecustomize registers an experimental TPU
+plugin at interpreter start), and the default suite must stay hermetic
+and fast.  Run them with ``make test-jax`` (or ``pytest -m jax``).
+Nothing in this module imports jax at collection time; the deadline test
+needs no jax at all and runs in the default suite.
 """
 
-import jax
+import importlib.util
+
 import pytest
 
+_HAVE_JAX = importlib.util.find_spec("jax") is not None
 
+
+@pytest.mark.jax
 def test_entry_jit_compiles():
+    jax = pytest.importorskip("jax")
     import __graft_entry__ as g
 
     fn, args = g.entry()
@@ -15,8 +26,40 @@ def test_entry_jit_compiles():
     assert out.shape == (g.BATCH, g.DOUT)
 
 
+@pytest.mark.jax
 @pytest.mark.parametrize("n", [1, 2, 4, 8])
 def test_dryrun_multichip(n):
+    # jax runs only in the CPU-pinned child; find_spec (not importorskip)
+    # keeps the expensive import out of this parent process.
+    if not _HAVE_JAX:
+        pytest.skip("jax not installed")
     import __graft_entry__ as g
 
     g.dryrun_multichip(n)
+
+
+def test_dryrun_deadline_is_enforced(monkeypatch, tmp_path):
+    """The dry run must fail loudly, not hang, when the child wedges.
+
+    Needs no jax (the child is a sleeping stub), so it runs in the
+    default suite.
+    """
+    import __graft_entry__ as g
+
+    stub = tmp_path / "wedged_child.py"
+    stub.write_text("import time\ntime.sleep(60)\n")
+    monkeypatch.setattr(g, "_DRYRUN_DEADLINE_S", 0.5)
+    monkeypatch.setattr(g, "_SELF_PATH", str(stub))
+    with pytest.raises(RuntimeError, match="deadline"):
+        g.dryrun_multichip(2)
+
+
+def test_dryrun_child_failure_is_reported(monkeypatch, tmp_path):
+    """A child that exits without the OK marker raises, not passes."""
+    import __graft_entry__ as g
+
+    stub = tmp_path / "broken_child.py"
+    stub.write_text("import sys\nprint('boom')\nsys.exit(3)\n")
+    monkeypatch.setattr(g, "_SELF_PATH", str(stub))
+    with pytest.raises(RuntimeError, match="child failed"):
+        g.dryrun_multichip(2)
