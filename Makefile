@@ -11,12 +11,17 @@ RELSTAGEDIR = /tmp/$(NAME)-release
 
 all: check test
 
-# Lint gate (the reference's `make check` runs jsl+jsstyle; here:
-# byte-compile + pyflakes-ish import check).
+# Lint gate (the reference's `make check` runs jsl+jsstyle with shipped
+# configs, its Makefile:15,18 + tools/jsl.node.conf): byte-compile, the
+# in-tree static checker (undefined names, unused imports), and a
+# strict-warnings import smoke.
 check:
-	$(PYTHON) -m compileall -q registrar_tpu tests bench.py __graft_entry__.py
-	$(PYTHON) -c "import registrar_tpu, registrar_tpu.main, \
-	    registrar_tpu.testing.server, registrar_tpu.config"
+	$(PYTHON) -m compileall -q registrar_tpu tests tools bench.py __graft_entry__.py
+	$(PYTHON) tools/check.py
+	$(PYTHON) -X dev -W error -c "import registrar_tpu, registrar_tpu.main, \
+	    registrar_tpu.testing.server, registrar_tpu.config, \
+	    registrar_tpu.tools.zkcli, registrar_tpu.binderview, \
+	    registrar_tpu.metrics"
 
 # Hermetic suite: jax-marked tests are deselected via pyproject addopts,
 # because jax backend init can take minutes in some environments.  (In the

@@ -45,7 +45,7 @@ from registrar_tpu.config import (
     ConfigUnreadableError,
     load_config,
 )
-from registrar_tpu.zk.client import ZKClient, create_zk_client
+from registrar_tpu.zk.client import create_zk_client
 
 
 def parse_args(argv=None) -> argparse.Namespace:

@@ -8,7 +8,6 @@ initial-registration-failure path never ran; SURVEY.md §4).
 
 import asyncio
 
-import pytest
 
 from registrar_tpu.agent import (
     DEFAULT_HEARTBEAT_INTERVAL_S,

@@ -6,7 +6,6 @@ these are true end-to-end contract tests: register -> ZooKeeper -> resolve
 exactly as Binder would.
 """
 
-import asyncio
 
 from registrar_tpu import binderview
 from registrar_tpu.records import host_record, payload_bytes

@@ -12,7 +12,6 @@ import pytest
 from registrar_tpu.zk.jute import JuteError, Reader, Writer
 from registrar_tpu.zk import protocol as proto
 from registrar_tpu.zk.protocol import (
-    ACL,
     ConnectRequest,
     ConnectResponse,
     CreateRequest,

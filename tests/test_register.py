@@ -8,8 +8,6 @@ known coverage gaps (SURVEY.md §4): multi-node unregister, aliases, ports
 arrays.
 """
 
-import asyncio
-import json
 
 import pytest
 

@@ -16,7 +16,6 @@ import pytest
 from registrar_tpu.retry import RetryPolicy
 from registrar_tpu.testing.server import ZKServer
 from registrar_tpu.zk.client import (
-    SessionExpiredError,
     ZKClient,
     create_zk_client,
 )

@@ -6,11 +6,9 @@ import os
 import subprocess
 import sys
 
-from registrar_tpu.records import host_record, payload_bytes
 from registrar_tpu.registration import register
 from registrar_tpu.testing.server import ZKServer
 from registrar_tpu.zk.client import ZKClient
-from registrar_tpu.zk.protocol import CreateFlag
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
