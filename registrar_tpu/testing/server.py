@@ -165,6 +165,31 @@ _FOUR_LETTER_WORDS = frozenset(
 _SERVER_VERSION = "3.4.14-registrar-tpu-testing"
 
 
+class _SharedState:
+    """Replicated state an ensemble's members hold in common.
+
+    Real ZooKeeper members replicate the znode tree, session table, and
+    zxid via ZAB; an in-process ensemble models the *converged* result by
+    letting every member operate on one state object (the tests all run
+    in a single event loop, so each request applies atomically — the same
+    linearizable history ZAB would produce).  Watches live here too: a
+    write through member A must notify a watcher connected to member B,
+    exactly as in a real ensemble.
+    """
+
+    def __init__(self) -> None:
+        self.root = ZNode(czxid=0, ctime=_now_ms(), mtime=_now_ms())
+        self.zxid = 0
+        self.sessions: Dict[int, Session] = {}
+        self.next_session = int(time.time()) << 24
+        # path -> set of connections, per watch kind
+        self.watches: Dict[str, Dict[str, Set[_Connection]]] = {
+            _WATCH_DATA: {},
+            _WATCH_EXIST: {},
+            _WATCH_CHILD: {},
+        }
+
+
 class ZKServer:
     """Single-node in-process ZooKeeper (see module docstring)."""
 
@@ -176,44 +201,62 @@ class ZKServer:
         max_session_timeout_ms: int = 60_000,
         tick_ms: int = 50,
         snapshot: Optional["ZKServer"] = None,
+        shared: Optional[_SharedState] = None,
+        server_id: int = 0,
     ):
         """``snapshot``: adopt another (stopped) server's tree, sessions,
         and zxid — models a real ensemble surviving a member restart, so
         rolling-restart scenarios (client reattaches, ephemerals survive)
-        are testable.  Session expiry countdowns restart from now."""
+        are testable.  Session expiry countdowns restart from now.
+
+        ``shared``: join a live ensemble's replicated state (see
+        :class:`ZKEnsemble`); mutually exclusive with ``snapshot``.
+        """
         self.host = host
         self._requested_port = port
         self.port: Optional[int] = None
         self.min_session_timeout_ms = min_session_timeout_ms
         self.max_session_timeout_ms = max_session_timeout_ms
         self.tick_ms = tick_ms
+        self.server_id = server_id
+        #: reported by the srvr/mntr admin words; ZKEnsemble sets
+        #: "leader"/"follower"
+        self.mode = "standalone"
+        self._is_ensemble_member = shared is not None
+        if snapshot is not None and shared is not None:
+            raise ValueError("snapshot= and shared= are mutually exclusive")
         if snapshot is not None:
             if snapshot._server is not None:
                 raise ValueError(
                     "snapshot donor must be stopped first (its tree and "
                     "sessions are adopted by reference)"
                 )
-            self.root = snapshot.root
-            self.zxid = snapshot.zxid
-            self.sessions = snapshot.sessions
-            self._next_session = snapshot._next_session
+            if snapshot._is_ensemble_member:
+                # The donor's state is the ensemble's live shared state;
+                # adopting it would alias a running ensemble (and the watch
+                # reset below would wipe the live members' watch tables).
+                raise ValueError(
+                    "cannot adopt an ensemble member as a snapshot donor; "
+                    "use ZKEnsemble.restart() to rejoin the ensemble"
+                )
+            self._state = snapshot._state
+            # The donor is stopped, so every watch-holding connection is
+            # dead; start from a clean watch table.
+            self._state.watches = {
+                _WATCH_DATA: {},
+                _WATCH_EXIST: {},
+                _WATCH_CHILD: {},
+            }
             self._adopted_sessions = True
             for sess in self.sessions.values():
                 sess.conn = None
+        elif shared is not None:
+            self._state = shared
         else:
-            self.root = ZNode(czxid=0, ctime=_now_ms(), mtime=_now_ms())
-            self.zxid = 0
-            self.sessions = {}
-            self._next_session = int(time.time()) << 24
+            self._state = _SharedState()
         self._server: Optional[asyncio.AbstractServer] = None
         self._sweeper: Optional[asyncio.Task] = None
         self._conns: Set[_Connection] = set()
-        # path -> set of connections, per watch kind
-        self._watches: Dict[str, Dict[str, Set[_Connection]]] = {
-            _WATCH_DATA: {},
-            _WATCH_EXIST: {},
-            _WATCH_CHILD: {},
-        }
         #: number of sessions expired by the sweeper (test observability)
         self.expired_count = 0
         #: request/reply counters surfaced via the 4lw admin commands
@@ -227,6 +270,45 @@ class ZKServer:
         #: session liveness) — simulates a wedged-but-connected server for
         #: client watchdog tests
         self.freeze = False
+
+    # -- replicated state (delegates to _SharedState so ensemble members
+    # -- converge by construction; standalone servers own a private one) ----
+
+    @property
+    def root(self) -> ZNode:
+        return self._state.root
+
+    @root.setter
+    def root(self, value: ZNode) -> None:
+        self._state.root = value
+
+    @property
+    def zxid(self) -> int:
+        return self._state.zxid
+
+    @zxid.setter
+    def zxid(self, value: int) -> None:
+        self._state.zxid = value
+
+    @property
+    def sessions(self) -> Dict[int, Session]:
+        return self._state.sessions
+
+    @sessions.setter
+    def sessions(self, value: Dict[int, Session]) -> None:
+        self._state.sessions = value
+
+    @property
+    def _next_session(self) -> int:
+        return self._state.next_session
+
+    @_next_session.setter
+    def _next_session(self, value: int) -> None:
+        self._state.next_session = value
+
+    @property
+    def _watches(self) -> Dict[str, Dict[str, Set["_Connection"]]]:
+        return self._state.watches
 
     # -- lifecycle ----------------------------------------------------------
 
@@ -474,7 +556,7 @@ class ZKServer:
                 f"Connections: {len(self._conns)}",
                 "Outstanding: 0",
                 f"Zxid: 0x{self.zxid:x}",
-                "Mode: standalone",
+                f"Mode: {self.mode}",
                 f"Node count: {nodes}",
             ]
             return "\n".join(lines) + "\n"
@@ -487,7 +569,7 @@ class ZKServer:
                 ("zk_packets_sent", self.packets_sent),
                 ("zk_num_alive_connections", len(self._conns)),
                 ("zk_outstanding_requests", 0),
-                ("zk_server_state", "standalone"),
+                ("zk_server_state", self.mode),
                 ("zk_znode_count", nodes),
                 ("zk_watch_count", watches),
                 ("zk_ephemerals_count", ephemerals),
@@ -565,7 +647,7 @@ class ZKServer:
                 ("minSessionTimeout", self.min_session_timeout_ms),
                 ("maxSessionTimeout", self.max_session_timeout_ms),
                 ("tickTime", self.tick_ms),
-                ("serverId", 0),
+                ("serverId", self.server_id),
             ]
             return "".join(f"{k}={v}\n" for k, v in rows)
         if cmd == "wchs":
@@ -600,6 +682,8 @@ class ZKServer:
                     await self._expire(sess)
 
     async def _expire(self, sess: Session) -> None:
+        if sess.closed:
+            return  # another ensemble member's sweeper got here first
         log.debug("expiring session 0x%x", sess.session_id)
         sess.closed = True
         self.sessions.pop(sess.session_id, None)
@@ -1365,6 +1449,137 @@ class ZKServer:
         return proto.encode_reply_payload(xid, self.zxid, err, body)
 
 
+class ZKEnsemble:
+    """N in-process ZK members sharing one replicated tree + session table.
+
+    Models the production deployment the reference points clients at — a
+    3–5 member ensemble (reference etc/config.coal.json:9-16, README's
+    ops guidance) — closing the round-1 gap that failover was only ever
+    tested against a single restarted server.  A client holding a session
+    through member A can, when A dies, reattach the *same* session (with
+    its ephemeral znodes intact) through member B, because members share a
+    :class:`_SharedState`.  Watches set via one member fire on writes made
+    through any member.
+
+    Usage::
+
+        async with ZKEnsemble(3) as ens:
+            cfg_servers = [
+                {"host": h, "port": p} for h, p in ens.addresses
+            ]
+            ...
+            await ens.kill(0)       # the member the client is talking to
+            ...                     # client reattaches via another member
+            await ens.restart(0)    # member rejoins with the shared state
+    """
+
+    def __init__(
+        self,
+        size: int = 3,
+        host: str = "127.0.0.1",
+        base_port: Optional[int] = None,
+        **server_kwargs,
+    ):
+        """``base_port``: members listen on consecutive ports starting
+        here (for operators wanting a predictable servers list); default
+        lets the OS pick free ports (right for tests)."""
+        if size < 1:
+            raise ValueError("ensemble size must be >= 1")
+        self.state = _SharedState()
+        self.servers: List[Optional[ZKServer]] = []
+        self._host = host
+        self._server_kwargs = server_kwargs
+        self._size = size
+        self._ports: List[Optional[int]] = [
+            base_port + i if base_port else None for i in range(size)
+        ]
+
+    def _new_member(self, i: int, port: int = 0) -> ZKServer:
+        member = ZKServer(
+            host=self._host,
+            port=port,
+            shared=self.state,
+            server_id=i + 1,  # real ensembles number members from 1
+            **self._server_kwargs,
+        )
+        return member
+
+    async def start(self) -> "ZKEnsemble":
+        self.servers = []
+        for i in range(self._size):
+            member = self._new_member(i, port=self._ports[i] or 0)
+            await member.start()
+            self._ports[i] = member.port
+            self.servers.append(member)
+        self._elect()
+        return self
+
+    async def stop(self) -> None:
+        for member in self.servers:
+            if member is not None and member._server is not None:
+                await member.stop()
+
+    async def __aenter__(self) -> "ZKEnsemble":
+        return await self.start()
+
+    async def __aexit__(self, *exc) -> None:
+        await self.stop()
+
+    @property
+    def addresses(self) -> List[Tuple[str, int]]:
+        """(host, port) of every member, dead or alive — the client's
+        ``servers`` list stays stable across member restarts."""
+        return [(self._host, p) for p in self._ports if p is not None]
+
+    def _elect(self) -> None:
+        # Cosmetic leader/follower labels for the srvr/mntr admin words;
+        # replication itself needs no leader here (single event loop).
+        leader_set = False
+        for member in self.servers:
+            if member is None or member._server is None:
+                continue
+            member.mode = "follower" if leader_set else "leader"
+            leader_set = True
+
+    async def kill(self, i: int) -> None:
+        """Stop member ``i`` (connections die; sessions and ephemerals
+        survive in the shared state until their own timeouts)."""
+        member = self.servers[i]
+        if member is None or member._server is None:
+            return
+        await member.stop()
+        self.servers[i] = None
+        self._elect()
+
+    async def restart(self, i: int) -> ZKServer:
+        """Bring member ``i`` back on its original port, joined to the
+        ensemble's shared state."""
+        if self.servers[i] is not None and self.servers[i]._server is not None:
+            return self.servers[i]
+        member = self._new_member(i, port=self._ports[i] or 0)
+        await member.start()
+        self._ports[i] = member.port
+        self.servers[i] = member
+        self._elect()
+        return member
+
+    @property
+    def live(self) -> List[ZKServer]:
+        return [
+            m for m in self.servers if m is not None and m._server is not None
+        ]
+
+    def get_node(self, path: str) -> Optional[ZNode]:
+        """Direct shared-tree access for assertions (member-independent)."""
+        node = self.state.root
+        if path != "/":
+            for comp in path.strip("/").split("/"):
+                node = node.children.get(comp)
+                if node is None:
+                    return None
+        return node
+
+
 async def _amain(argv=None) -> None:
     parser = argparse.ArgumentParser(
         description="standalone in-process ZooKeeper test server"
@@ -1376,11 +1591,51 @@ async def _amain(argv=None) -> None:
     )
     parser.add_argument(
         "--snapshot-file", metavar="PATH", default=None,
-        help="persist the tree/sessions/zxid here on shutdown and load it "
-        "on startup when present (real ZooKeeper's snapshot analog)",
+        help="persist the tree/sessions/zxid here (loaded on startup when "
+        "present, saved every --snapshot-interval seconds and on clean "
+        "SIGTERM/SIGINT; a crash/SIGKILL loses at most one interval — "
+        "real ZooKeeper's continuously-fsynced txlog has no analog here)",
+    )
+    parser.add_argument(
+        "--snapshot-interval", type=float, default=30.0, metavar="SECONDS",
+        help="periodic --snapshot-file save cadence (0 disables the "
+        "periodic safety net, keeping shutdown-only saves)",
+    )
+    parser.add_argument(
+        "--ensemble", type=int, default=1, metavar="N",
+        help="run an N-member ensemble sharing one replicated tree on "
+        "consecutive ports starting at --port (models the 3-5 member "
+        "production deployments clients are pointed at)",
     )
     args = parser.parse_args(argv)
     logging.basicConfig(level=logging.DEBUG)
+    if args.ensemble > 1 and args.snapshot_file:
+        parser.error("--snapshot-file is standalone-only (use --ensemble 1)")
+
+    stopping = asyncio.Event()
+    loop = asyncio.get_running_loop()
+    for sig in (signal.SIGTERM, signal.SIGINT):
+        try:
+            loop.add_signal_handler(sig, stopping.set)
+        except NotImplementedError:
+            pass
+
+    if args.ensemble > 1:
+        ens = ZKEnsemble(
+            size=args.ensemble,
+            host=args.host,
+            base_port=args.port or None,
+            max_session_timeout_ms=args.max_session_timeout,
+        )
+        await ens.start()
+        hosts = ",".join(f"{h}:{p}" for h, p in ens.addresses)
+        print(f"zk test ensemble listening on {hosts}", flush=True)
+        try:
+            await stopping.wait()
+        finally:
+            await ens.stop()
+        return
+
     server = ZKServer(
         host=args.host,
         port=args.port,
@@ -1391,16 +1646,33 @@ async def _amain(argv=None) -> None:
         print(f"loaded snapshot from {args.snapshot_file}", flush=True)
     await server.start()
     print(f"zk test server listening on {args.host}:{server.port}", flush=True)
-    stopping = asyncio.Event()
-    loop = asyncio.get_running_loop()
-    for sig in (signal.SIGTERM, signal.SIGINT):
-        try:
-            loop.add_signal_handler(sig, stopping.set)
-        except NotImplementedError:
-            pass
+
+    async def periodic_saves() -> None:
+        # Crash safety net: without it a SIGKILL would lose everything
+        # since the last shutdown (the advisor's round-1 finding).  A
+        # transiently failing save (disk full, permissions) must not kill
+        # the net — log and retry next interval.
+        while True:
+            await asyncio.sleep(args.snapshot_interval)
+            try:
+                server.save_snapshot(args.snapshot_file)
+            except OSError:
+                log.exception("periodic snapshot save failed; will retry")
+
+    saver = (
+        asyncio.create_task(periodic_saves())
+        if args.snapshot_file and args.snapshot_interval > 0
+        else None
+    )
     try:
         await stopping.wait()
     finally:
+        if saver is not None:
+            saver.cancel()
+            try:
+                await saver
+            except (asyncio.CancelledError, Exception):  # noqa: BLE001
+                pass  # a dead saver must not block the final save below
         await server.stop()
         if args.snapshot_file:
             server.save_snapshot(args.snapshot_file)

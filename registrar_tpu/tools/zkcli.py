@@ -436,7 +436,11 @@ def build_parser() -> argparse.ArgumentParser:
     )
     p.set_defaults(fn=_cmd_create)
 
-    p = sub.add_parser("set", help="set a znode's data (creates if missing)")
+    p = sub.add_parser(
+        "set",
+        help="set a znode's data (creates if missing, unless --version "
+        "makes it a conditional plain set)",
+    )
     p.add_argument("path")
     p.add_argument("data")
     p.add_argument(

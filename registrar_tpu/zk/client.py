@@ -218,7 +218,18 @@ class ZKClient(EventEmitter):
 
         Auth state is per-connection server-side, so every (re)connect must
         replay it before any ACL-guarded operation runs (the Apache client
-        does the same with its authInfo list in primeConnection)."""
+        does the same with its authInfo list in primeConnection).
+
+        A credential the server rejects (AUTH_FAILED) is dropped from the
+        stored list: the server hangs up after answering AUTH_FAILED, and
+        replaying the same rejected credential on every reconnect would
+        turn the reconnect loop into a permanent connect/reject cycle.
+        Subsequent ACL-guarded operations then fail with NO_AUTH, which is
+        visible to the caller (the Apache client instead parks the whole
+        session in a terminal AUTH_FAILED state; keeping the session
+        usable for the un-authed surface suits a daemon whose core
+        registration traffic never uses ACLs)."""
+        rejected = []
         for scheme, auth in self._auths:
             try:
                 await self._submit(
@@ -229,7 +240,14 @@ class ZKClient(EventEmitter):
             except ZKError as err:
                 log.warning("replaying %s auth failed: %s", scheme, err)
                 if err.code == Err.AUTH_FAILED:
+                    rejected.append((scheme, auth))
                     self.emit("auth_failed", scheme)
+        for cred in rejected:
+            self._auths.remove(cred)
+            log.error(
+                "dropped rejected %s credential; ACL-guarded ops will fail "
+                "with NO_AUTH until add_auth() succeeds again", cred[0],
+            )
 
     async def _rearm_watches(self) -> None:
         if not any(self._watch_paths.values()):

@@ -346,6 +346,44 @@ class TestAuthScheme:
             await client.close()
             await server.stop()
 
+    async def test_rejected_replay_credential_is_dropped(self):
+        # Round-1 advisor finding: a credential rejected during replay
+        # must be dropped, or every reconnect replays it, gets the
+        # connection dropped (real ZK hangs up after AUTH_FAILED), and the
+        # client loops connect/reject forever.
+        import asyncio
+
+        server, client = await _pair()
+        try:
+            await client.create("/pre", b"")
+            # A credential the server will reject on replay (unknown
+            # scheme), planted as though it had been accepted once.
+            client._auths.append(("kerberos", b"stale"))
+            rejections = []
+            client.on("auth_failed", rejections.append)
+            reconnected = asyncio.Event()
+            client.on("connect", lambda *a: reconnected.set())
+
+            await server.drop_connections()
+            await asyncio.wait_for(reconnected.wait(), timeout=10)
+            # Replay rejected once, credential dropped; if it were still
+            # stored, the AUTH_FAILED hang-up loop would keep the client
+            # from ever settling — wait until service is restored.
+            deadline = asyncio.get_event_loop().time() + 10
+            while True:
+                try:
+                    await client.get("/pre")
+                    break
+                except Exception:
+                    assert asyncio.get_event_loop().time() < deadline
+                    await asyncio.sleep(0.05)
+            assert "kerberos" in rejections
+            assert ("kerberos", b"stale") not in client._auths
+            assert client.connected
+        finally:
+            await client.close()
+            await server.stop()
+
 
 class TestIpScheme:
     async def test_loopback_matches_exact_and_cidr(self):
