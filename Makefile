@@ -41,16 +41,25 @@ test-jax:
 bench:
 	$(PYTHON) bench.py
 
-# Release tarball rooted at /opt/registrar (the reference roots its
-# tarball at /opt/smartdc/registrar, Makefile:70-95).
+# Release tarball rooted at $(PREFIX) (the reference roots its tarball
+# at /opt/smartdc/registrar, Makefile:70-95).  The SMF manifest is
+# generated from its .xml.in template at build time, like the
+# reference's SMF_MANIFESTS_IN substitution (reference Makefile:19):
+# the shipped registrar.xml is svccfg-importable as-is, no @@ tokens.
+PREFIX ?= /opt/registrar
+# Top-level path component of $(PREFIX) — what the tarball is rooted at
+# (so a non-/opt PREFIX still builds).
+PREFIX_TOP = $(firstword $(subst /, ,$(PREFIX)))
 release:
 	rm -rf $(RELSTAGEDIR)
-	mkdir -p $(RELSTAGEDIR)/opt/registrar/etc
-	cp -r registrar_tpu systemd smf docs $(RELSTAGEDIR)/opt/registrar/
-	cp etc/config.coal.json etc/config.example.json $(RELSTAGEDIR)/opt/registrar/etc/
-	cp README.md pyproject.toml $(RELSTAGEDIR)/opt/registrar/
+	mkdir -p $(RELSTAGEDIR)$(PREFIX)/etc $(RELSTAGEDIR)$(PREFIX)/smf/manifests
+	cp -r registrar_tpu systemd docs $(RELSTAGEDIR)$(PREFIX)/
+	sed 's|@@PREFIX@@|$(PREFIX)|g' smf/manifests/registrar.xml.in \
+	    > $(RELSTAGEDIR)$(PREFIX)/smf/manifests/registrar.xml
+	cp etc/config.coal.json etc/config.example.json $(RELSTAGEDIR)$(PREFIX)/etc/
+	cp README.md pyproject.toml $(RELSTAGEDIR)$(PREFIX)/
 	find $(RELSTAGEDIR) -name __pycache__ -type d | xargs rm -rf
-	tar -czf $(RELEASE_TARBALL) -C $(RELSTAGEDIR) opt
+	tar -czf $(RELEASE_TARBALL) -C $(RELSTAGEDIR) $(PREFIX_TOP)
 	rm -rf $(RELSTAGEDIR)
 	@echo "release: $(RELEASE_TARBALL)"
 

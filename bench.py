@@ -22,6 +22,7 @@ behavior the survey pins).  The settle-free pipeline cost is reported in
 import asyncio
 import json
 import os
+import subprocess
 import sys
 import time
 
@@ -31,6 +32,7 @@ from registrar_tpu import binderview  # noqa: E402
 from registrar_tpu.registration import register, unregister  # noqa: E402
 from registrar_tpu.testing.server import ZKServer  # noqa: E402
 from registrar_tpu.zk.client import ZKClient  # noqa: E402
+from registrar_tpu.zk.protocol import CreateFlag  # noqa: E402
 
 REGISTRATION = {
     "domain": "bench.emy-10.joyent.us",
@@ -43,6 +45,67 @@ REGISTRATION = {
 }
 
 BASELINE_FLOOR_MS = 1000.0  # reference lib/register.js:232-235 settle delay
+
+
+async def _daemon_rss_mb(server) -> "float | None":
+    """Resident memory of a real daemon process once registered, in MiB.
+
+    Returns None where /proc isn't available (non-Linux)."""
+    import tempfile
+
+    if not os.path.isdir("/proc"):
+        return None
+    with tempfile.TemporaryDirectory() as td:
+        cfg_path = os.path.join(td, "cfg.json")
+        with open(cfg_path, "w", encoding="utf-8") as f:
+            json.dump(
+                {
+                    "registration": {
+                        "domain": "rss.bench.emy-10.joyent.us",
+                        "type": "host",
+                    },
+                    "adminIp": "10.2.0.1",
+                    "zookeeper": {
+                        "servers": [
+                            {"host": server.host, "port": server.port}
+                        ],
+                        "timeout": 5000,
+                    },
+                },
+                f,
+            )
+        env = dict(os.environ)
+        env["PYTHONPATH"] = os.path.dirname(os.path.abspath(__file__))
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "registrar_tpu", "-f", cfg_path],
+            env=env,
+            stdout=subprocess.DEVNULL,
+            stderr=subprocess.STDOUT,
+        )
+        try:
+            probe = await ZKClient([server.address]).connect()
+            try:
+                deadline = time.monotonic() + 20
+                while (
+                    await probe.exists("/us/joyent/emy-10/bench/rss")
+                ) is None:
+                    if time.monotonic() > deadline:
+                        raise RuntimeError("daemon never registered")
+                    await asyncio.sleep(0.1)
+            finally:
+                await probe.close()
+            with open(f"/proc/{proc.pid}/status", encoding="utf-8") as f:
+                for line in f:
+                    if line.startswith("VmRSS:"):
+                        return round(int(line.split()[1]) / 1024.0, 1)
+            return None
+        finally:
+            proc.terminate()
+            try:
+                await asyncio.to_thread(proc.wait, 15)
+            except subprocess.TimeoutExpired:
+                proc.kill()  # metrics are already in hand; don't leak
+                await asyncio.to_thread(proc.wait)
 
 
 async def _bench() -> dict:
@@ -69,7 +132,9 @@ async def _bench() -> dict:
 
         # Settle-free pipeline cost over many iterations (implementation
         # overhead: 4 ephemeral nodes + service record + cleanup, ~13 RPCs).
-        iters = 50
+        # Enough iterations to ride out scheduler noise — the driver
+        # records a single run.
+        iters = 200
         t0 = time.perf_counter()
         for _ in range(iters):
             nodes = await register(
@@ -126,6 +191,92 @@ async def _bench() -> dict:
                 await c.close()
         throughput = n_conc / conc_s
 
+        # ---- scale extras (round-2: prove the O(N) paths stay flat) ----
+
+        # Heartbeat over many owned znodes: one session, N ephemerals,
+        # the agent's hot loop #1 stat fan-out.
+        heartbeat_scale = {}
+        for n in (100, 1000):
+            base = f"/hbscale{n}"
+            await client.mkdirp(base)
+            paths = [f"{base}/e{i}" for i in range(n)]
+            await asyncio.gather(
+                *(
+                    client.create(p, b"", CreateFlag.EPHEMERAL)
+                    for p in paths
+                )
+            )
+            hb_iters = 5
+            t0 = time.perf_counter()
+            for _ in range(hb_iters):
+                await client.heartbeat(paths)
+            heartbeat_scale[n] = round(
+                (time.perf_counter() - t0) * 1000.0 / hb_iters, 3
+            )
+
+        # Resolution over a 50-instance service (the biggest realistic
+        # Binder answer: a large stateless fleet behind one domain).
+        fleet_domain = "fleet.bench.emy-10.joyent.us"
+        fleet_reg = {
+            "domain": fleet_domain,
+            "type": "load_balancer",
+            "service": {
+                "type": "service",
+                "service": {"srvce": "_http", "proto": "_tcp", "port": 80},
+            },
+        }
+        for i in range(50):
+            await register(
+                client, fleet_reg, admin_ip=f"10.1.{i // 256}.{i % 256}",
+                hostname=f"inst{i}", settle_delay=0,
+            )
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            res_a = await binderview.resolve(observer, fleet_domain, "A")
+        fleet_a_ms = (time.perf_counter() - t0) * 1000.0 / iters
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            res_srv = await binderview.resolve(
+                observer, f"_http._tcp.{fleet_domain}", "SRV"
+            )
+        fleet_srv_ms = (time.perf_counter() - t0) * 1000.0 / iters
+        if len(res_a.answers) != 50 or len(res_srv.answers) != 50:
+            raise RuntimeError(
+                "fleet resolve did not see all 50 instances "
+                f"(A={len(res_a.answers)} SRV={len(res_srv.answers)})"
+            )
+
+        # Watch fan-out: 50 sessions watching one node; time from a
+        # write to the last notification arriving.
+        watchers = [
+            await ZKClient([server.address]).connect() for _ in range(50)
+        ]
+        try:
+            await client.put("/fanout", b"v0")
+            notified = asyncio.Event()
+            pending = len(watchers)
+
+            def on_event(_ev):
+                nonlocal pending
+                pending -= 1
+                if pending == 0:
+                    notified.set()
+
+            for wcl in watchers:
+                wcl.watch("/fanout", on_event)
+                await wcl.get("/fanout", watch=True)
+            t0 = time.perf_counter()
+            await client.set_data("/fanout", b"v1")
+            await asyncio.wait_for(notified.wait(), timeout=10)
+            fanout_ms = (time.perf_counter() - t0) * 1000.0
+        finally:
+            for wcl in watchers:
+                await wcl.close()
+
+        # Daemon RSS: the real deployed process (register + heartbeat
+        # loop) measured from /proc after it finishes registering.
+        daemon_rss_mb = await _daemon_rss_mb(server)
+
         return {
             "metric": "register_to_visible_ms",
             "value": round(register_ms, 2),
@@ -140,6 +291,12 @@ async def _bench() -> dict:
                 "resolve_a_query_ms": round(resolve_ms, 3),
                 "concurrent_registrations_per_s": round(throughput, 1),
                 "znodes_per_registration": len(nodes),
+                "heartbeat_ms_100_znodes": heartbeat_scale[100],
+                "heartbeat_ms_1000_znodes": heartbeat_scale[1000],
+                "resolve_a_ms_50_instances": round(fleet_a_ms, 3),
+                "resolve_srv_ms_50_instances": round(fleet_srv_ms, 3),
+                "watch_fanout_ms_50_watchers": round(fanout_ms, 3),
+                "daemon_rss_mb": daemon_rss_mb,
             },
         }
     finally:

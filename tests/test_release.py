@@ -33,7 +33,9 @@ class TestReleaseArtifact:
         tarball = os.path.join(REPO, "registrar-release.tar.gz")
         build = await asyncio.to_thread(
             subprocess.run,
-            ["make", "release"],
+            # PREFIX pinned: an exported PREFIX in the environment would
+            # otherwise change the layout under test (Makefile uses ?=).
+            ["make", "release", "PREFIX=/opt/registrar"],
             cwd=REPO, capture_output=True, text=True, timeout=120,
         )
         assert build.returncode == 0, build.stderr
@@ -49,6 +51,19 @@ class TestReleaseArtifact:
         assert (root / "registrar_tpu" / "main.py").exists()
         assert (root / "etc" / "config.coal.json").exists()
         assert any("systemd" in n for n in names)
+
+        # The shipped SMF manifest is generated from the .xml.in template
+        # (reference Makefile:19): valid XML, fully substituted, and its
+        # paths point into the install prefix.
+        manifest = root / "smf" / "manifests" / "registrar.xml"
+        assert manifest.exists()
+        assert not any(n.endswith(".xml.in") for n in names)
+        text = manifest.read_text()
+        assert "@@" not in text
+        assert "/opt/registrar/etc/config.json" in text
+        import xml.etree.ElementTree as ET
+
+        ET.fromstring(text)  # svccfg-importable at least as far as XML
 
         # Environment pointing ONLY at the extracted tree.
         env = {
