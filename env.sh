@@ -1,0 +1,17 @@
+#
+# Developer environment helpers — source this from the repo root
+# (the analog of the reference's env.sh, which put the bundled node on
+# PATH and aliased `run`).
+#
+#     . ./env.sh
+#     zkserve          # hermetic ZooKeeper on 127.0.0.1:21811
+#     run              # the daemon against the shipped sample config, verbose
+#     zkcli tree /
+#
+
+export PYTHONPATH="$PWD${PYTHONPATH:+:$PYTHONPATH}"
+
+alias run='python3 -m registrar_tpu -f ./etc/config.coal.json -v'
+alias zkserve='python3 -m registrar_tpu.testing.server --port 21811'
+alias zkensemble='python3 -m registrar_tpu.testing.server --port 21811 --ensemble 3'
+alias zkcli='python3 -m registrar_tpu.tools.zkcli -s 127.0.0.1:21811'

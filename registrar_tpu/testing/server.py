@@ -38,6 +38,13 @@ from typing import Dict, List, Optional, Set, Tuple
 from registrar_tpu.zk import protocol as proto
 from registrar_tpu.zk.jute import Reader, Writer
 from registrar_tpu.zk.protocol import Err, EventType, KeeperState, OpCode, Stat
+from registrar_tpu.zk.quota import (
+    LIMITS_LEAF,
+    QUOTA_ROOT,
+    STATS_LEAF,
+    format_quota,
+    parse_quota,
+)
 
 log = logging.getLogger("registrar_tpu.testing.server")
 
@@ -188,6 +195,12 @@ class _SharedState:
             _WATCH_EXIST: {},
             _WATCH_CHILD: {},
         }
+        ensure_system_nodes(self.root)
+
+
+def ensure_system_nodes(root: ZNode) -> None:
+    zk = root.children.setdefault("zookeeper", ZNode(ctime=_now_ms()))
+    zk.children.setdefault("quota", ZNode(ctime=_now_ms()))
 
 
 class ZKServer:
@@ -259,6 +272,8 @@ class ZKServer:
         self._conns: Set[_Connection] = set()
         #: number of sessions expired by the sweeper (test observability)
         self.expired_count = 0
+        #: soft-quota violations logged by this member (test observability)
+        self.quota_warnings = 0
         #: request/reply counters surfaced via the 4lw admin commands
         self.packets_received = 0
         self.packets_sent = 0
@@ -493,6 +508,7 @@ class ZKServer:
                 continue
             parent_path, name = self._split(p)
             self._resolve(parent_path).children[name] = node  # parents first
+        ensure_system_nodes(self.root)  # snapshots may predate /zookeeper
         self.sessions = {}
         for s in payload["sessions"]:
             sess = Session(
@@ -702,6 +718,86 @@ class ZKServer:
                 pass
         sess.ephemerals.clear()
 
+    # -- quotas (real ZK 3.4 semantics: soft limits under /zookeeper/quota,
+    # -- violations logged, never enforced) ----------------------------------
+
+    def _subtree_usage(self, path: str) -> Tuple[int, int]:
+        """(znode count, total data bytes) of the subtree at ``path``."""
+        try:
+            start = self._resolve(path)
+        except KeyError:
+            return (0, 0)
+        count, size = 0, 0
+        stack = [start]
+        while stack:
+            node = stack.pop()
+            count += 1
+            size += len(node.data)
+            stack.extend(node.children.values())
+        return (count, size)
+
+    def _governing_quota(self, path: str) -> Optional[Tuple[str, Dict[str, int]]]:
+        """The quota target governing ``path``, if any: walk the path's
+        prefixes looking for /zookeeper/quota<prefix>/zookeeper_limits
+        (setquota forbids nesting, so at most one governs)."""
+        if path == "/" or path == "/zookeeper" or path.startswith("/zookeeper/"):
+            return None
+        comps = path.strip("/").split("/")
+        quota_node = self.get_node(QUOTA_ROOT)
+        if quota_node is None or not quota_node.children:
+            return None
+        node = quota_node
+        prefix = ""
+        for comp in comps:
+            node = node.children.get(comp)
+            if node is None:
+                return None
+            prefix += "/" + comp
+            limits = node.children.get(LIMITS_LEAF)
+            if limits is not None:
+                return (prefix, parse_quota(limits.data))
+        return None
+
+    def _check_quota(self, path: str) -> None:
+        """After a write under a quota'd subtree, log (never fail) when the
+        limit is exceeded — real ZK's soft enforcement."""
+        governing = self._governing_quota(path)
+        if governing is None:
+            return
+        target, limits = governing
+        count, nbytes = self._subtree_usage(target)
+        if limits["count"] >= 0 and count > limits["count"]:
+            self.quota_warnings += 1
+            log.warning(
+                "Quota exceeded: %s count=%d limit=%d",
+                target, count, limits["count"],
+            )
+        if limits["bytes"] >= 0 and nbytes > limits["bytes"]:
+            self.quota_warnings += 1
+            log.warning(
+                "Quota exceeded: %s bytes=%d limit=%d",
+                target, nbytes, limits["bytes"],
+            )
+
+    async def _refresh_quota_stats(self, path: str) -> None:
+        """Serve live usage from a .../zookeeper_stats read — the lazy
+        equivalent of real ZK updating the stats node on every write,
+        applied as a genuine setData (version bump + data watches) so
+        stat/watch semantics on the stats node stay honest."""
+        if not (
+            path.startswith(QUOTA_ROOT + "/") and path.endswith("/" + STATS_LEAF)
+        ):
+            return
+        target = path[len(QUOTA_ROOT): -len("/" + STATS_LEAF)]
+        try:
+            node = self._resolve(path)
+        except KeyError:
+            return
+        count, nbytes = self._subtree_usage(target)
+        data = format_quota(count, nbytes)
+        if data != node.data:
+            await self._set_data_node(path, data, -1)
+
     # -- tree ops -----------------------------------------------------------
 
     def _resolve(self, path: str) -> ZNode:
@@ -894,6 +990,7 @@ class ZKServer:
         parent.pzxid = zxid
         if ephemeral:
             session.ephemerals.add(path)
+        self._check_quota(path)
         await self._fire_watches(_WATCH_EXIST, path, EventType.NODE_CREATED)
         await self._fire_watches(_WATCH_DATA, path, EventType.NODE_CREATED)
         await self._fire_watches(
@@ -950,6 +1047,7 @@ class ZKServer:
         node.version += 1
         node.mzxid = self._next_zxid()
         node.mtime = _now_ms()
+        self._check_quota(path)
         await self._fire_watches(_WATCH_DATA, path, EventType.NODE_DATA_CHANGED)
         return node.stat()
 
@@ -1313,6 +1411,7 @@ class ZKServer:
             if op == OpCode.GET_DATA:
                 req = proto.GetDataRequest.read(r)
                 proto.check_path(req.path)
+                await self._refresh_quota_stats(req.path)
                 try:
                     node = self._resolve(req.path)
                 except KeyError:

@@ -31,6 +31,13 @@ from typing import List, Tuple
 
 from registrar_tpu import binderview
 from registrar_tpu.zk.client import ZKClient
+from registrar_tpu.zk.quota import (
+    LIMITS_LEAF,
+    QUOTA_ROOT,
+    STATS_LEAF,
+    format_quota,
+    parse_quota,
+)
 from registrar_tpu.zk.protocol import (
     ACL,
     CreateFlag,
@@ -291,6 +298,127 @@ async def _cmd_rmr(zk: ZKClient, args) -> int:
     return 0
 
 
+async def _quota_conflict(zk: ZKClient, path: str) -> "str | None":
+    """A quota may not nest inside another (zkCli.sh refuses both
+    directions).  Returns the conflicting target path, if any."""
+    # Ancestor (or self) already quota'd?
+    comps = path.strip("/").split("/")
+    prefix = ""
+    for comp in comps:
+        prefix += "/" + comp
+        if await zk.exists(f"{QUOTA_ROOT}{prefix}/{LIMITS_LEAF}"):
+            return prefix
+
+    # Descendant already quota'd?
+    async def walk(qpath: str, target: str) -> "str | None":
+        try:
+            children = await zk.get_children(qpath)
+        except ZKError as e:
+            if e.code == Err.NO_NODE:
+                return None
+            raise
+        for child in children:
+            if child == LIMITS_LEAF and qpath != f"{QUOTA_ROOT}{path}":
+                return target
+            if child in (LIMITS_LEAF, STATS_LEAF):
+                continue
+            found = await walk(f"{qpath}/{child}", f"{target}/{child}")
+            if found:
+                return found
+        return None
+
+    return await walk(f"{QUOTA_ROOT}{path}", path)
+
+
+async def _cmd_setquota(zk: ZKClient, args) -> int:
+    """zkCli.sh ``setquota -n N | -b B path`` (soft limits: the server
+    logs violations, it never rejects writes)."""
+    if args.count is None and args.bytes is None:
+        print("zkcli: setquota needs -n COUNT and/or -b BYTES", file=sys.stderr)
+        return 2
+    conflict = await _quota_conflict(zk, args.path)
+    if conflict and conflict != args.path:
+        print(
+            f"zkcli: {conflict} already has a quota; nested quotas are not "
+            "allowed", file=sys.stderr,
+        )
+        return 1
+    limits_path = f"{QUOTA_ROOT}{args.path}/{LIMITS_LEAF}"
+    stats_path = f"{QUOTA_ROOT}{args.path}/{STATS_LEAF}"
+    existing = await zk.exists(limits_path)
+    quota = {"count": -1, "bytes": -1}
+    if existing:
+        data, _ = await zk.get(limits_path)
+        quota = parse_quota(data)
+    if args.count is not None:
+        quota["count"] = args.count
+    if args.bytes is not None:
+        quota["bytes"] = args.bytes
+    await zk.mkdirp(f"{QUOTA_ROOT}{args.path}")
+    await zk.put(limits_path, format_quota(quota["count"], quota["bytes"]))
+    if not await zk.exists(stats_path):
+        await zk.put(stats_path, format_quota(0, 0))
+    print(f"quota for {args.path}: count={quota['count']},bytes={quota['bytes']}")
+    return 0
+
+
+async def _cmd_listquota(zk: ZKClient, args) -> int:
+    """zkCli.sh ``listquota path``: the limit and the live usage."""
+    limits_path = f"{QUOTA_ROOT}{args.path}/{LIMITS_LEAF}"
+    try:
+        data, _ = await zk.get(limits_path)
+    except ZKError as e:
+        if e.code == Err.NO_NODE:
+            print(f"quota for {args.path} does not exist")
+            return 1
+        raise
+    print(f"absolute path is {limits_path}")
+    quota = parse_quota(data)
+    print(f"Output quota for {args.path} "
+          f"count={quota['count']},bytes={quota['bytes']}")
+    stats, _ = await zk.get(f"{QUOTA_ROOT}{args.path}/{STATS_LEAF}")
+    usage = parse_quota(stats)
+    print(f"Output stat for {args.path} "
+          f"count={usage['count']},bytes={usage['bytes']}")
+    return 0
+
+
+async def _cmd_delquota(zk: ZKClient, args) -> int:
+    """zkCli.sh ``delquota [-n|-b] path``: clear one limit dimension, or
+    the whole quota when no flag is given."""
+    limits_path = f"{QUOTA_ROOT}{args.path}/{LIMITS_LEAF}"
+    if args.count or args.bytes:
+        try:
+            data, _ = await zk.get(limits_path)
+        except ZKError as e:
+            if e.code == Err.NO_NODE:
+                print(f"quota for {args.path} does not exist", file=sys.stderr)
+                return 1
+            raise
+        quota = parse_quota(data)
+        if args.count:
+            quota["count"] = -1
+        if args.bytes:
+            quota["bytes"] = -1
+        await zk.put(limits_path, format_quota(quota["count"], quota["bytes"]))
+        print(f"quota for {args.path}: "
+              f"count={quota['count']},bytes={quota['bytes']}")
+        return 0
+    for leaf in (LIMITS_LEAF, STATS_LEAF):
+        try:
+            await zk.unlink(f"{QUOTA_ROOT}{args.path}/{leaf}")
+        except ZKError as e:
+            if e.code != Err.NO_NODE:
+                raise
+    try:
+        await zk.unlink(f"{QUOTA_ROOT}{args.path}")
+    except ZKError as e:
+        if e.code not in (Err.NO_NODE, Err.NOT_EMPTY):
+            raise
+    print(f"quota for {args.path} deleted")
+    return 0
+
+
 async def _cmd_admin(args) -> int:
     """Send a 4-letter-word admin command to every server, raw TCP.
 
@@ -507,6 +635,32 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("-t", "--qtype", default="A", type=str.upper,
                    choices=["A", "SRV"])
     p.set_defaults(fn=_cmd_resolve)
+
+    p = sub.add_parser(
+        "setquota", help="set a soft quota on a subtree (zkCli.sh setquota)"
+    )
+    p.add_argument("path")
+    p.add_argument("-n", "--count", type=int, default=None,
+                   help="max znodes in the subtree")
+    p.add_argument("-b", "--bytes", type=int, default=None,
+                   help="max total data bytes in the subtree")
+    p.set_defaults(fn=_cmd_setquota)
+
+    p = sub.add_parser(
+        "listquota", help="show a subtree's quota and live usage"
+    )
+    p.add_argument("path")
+    p.set_defaults(fn=_cmd_listquota)
+
+    p = sub.add_parser(
+        "delquota", help="delete a subtree's quota (or one dimension of it)"
+    )
+    p.add_argument("path")
+    p.add_argument("-n", "--count", action="store_true",
+                   help="clear only the znode-count limit")
+    p.add_argument("-b", "--bytes", action="store_true",
+                   help="clear only the byte limit")
+    p.set_defaults(fn=_cmd_delquota)
 
     return parser
 

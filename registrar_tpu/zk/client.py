@@ -437,12 +437,22 @@ class ZKClient(EventEmitter):
         self.emit("watch", event)
         self._watch_emitter.emit(event.path, event)
 
-    async def _submit(self, xid: int, op: int, body) -> Optional[Reader]:
+    def _post(self, xid: int, op: int, body) -> asyncio.Future:
+        """Queue one request on the wire without awaiting anything.
+
+        The pipelining primitive: callers fan out many posts back to back
+        (one buffered write each), drain once, then await the futures —
+        avoiding a Task per operation for large fan-outs like the
+        heartbeat's stat sweep."""
         if not self._connected or self._writer is None:
             raise ZKError(Err.CONNECTION_LOSS)
         fut: asyncio.Future = asyncio.get_running_loop().create_future()
         self._pending.append((xid, fut))
         self._writer.write(proto.encode_request(xid, op, body))
+        return fut
+
+    async def _submit(self, xid: int, op: int, body) -> Optional[Reader]:
+        fut = self._post(xid, op, body)
         try:
             await self._writer.drain()
         except (ConnectionError, OSError):
@@ -757,14 +767,36 @@ class ZKClient(EventEmitter):
         makes the same distinction).
         """
         nodes = list(nodes)
+        for n in nodes:
+            check_path(n)
 
         async def check() -> None:
-            results = await asyncio.gather(
-                *(self.stat(n) for n in nodes), return_exceptions=True
-            )
+            # Pipelined: post every exists request (buffered writes), one
+            # drain, then collect replies in order — no per-node Task, so
+            # a 1000-znode sweep is one scheduling round, not a thousand.
+            futs: List[asyncio.Future] = []
+            post_err: Optional[BaseException] = None
+            try:
+                for n in nodes:
+                    futs.append(
+                        self._post(
+                            self._next_xid(),
+                            OpCode.EXISTS,
+                            proto.ExistsRequest(path=self._abs(n), watch=False),
+                        )
+                    )
+                if futs and self._writer is not None:
+                    await self._writer.drain()
+            except (ConnectionError, OSError):
+                await self._teardown(expected=False)
+            except ZKError as e:  # not connected: fail after draining futs
+                post_err = e
+            results = await asyncio.gather(*futs, return_exceptions=True)
             for res in results:
                 if isinstance(res, BaseException):
                     raise res
+            if post_err is not None:
+                raise post_err
 
         await call_with_backoff(check, retry or HEARTBEAT_RETRY)
 

@@ -47,8 +47,9 @@ class TestFourLetterWords:
                 await client.close()
             assert "Zookeeper version:" in out
             assert "Mode: standalone" in out
-            # root + /x
-            assert "Node count: 2" in out
+            # root + /x + the pre-created /zookeeper + /zookeeper/quota
+            # system nodes (real ZK counts them in srvr too)
+            assert "Node count: 4" in out
             assert "Zxid: 0x1" in out
 
     async def test_stat_lists_clients(self):

@@ -11,11 +11,18 @@ topology via ZKEnsemble.
 """
 
 import asyncio
+import json
+import os
+import socket
+import subprocess
+import sys
 
 from registrar_tpu.registration import register
 from registrar_tpu.testing.server import ZKEnsemble, ZKServer
 from registrar_tpu.zk.client import ZKClient
 from registrar_tpu.zk.protocol import CreateFlag
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
 def member_holding(ens, session_id):
@@ -194,6 +201,81 @@ async def test_ensemble_size_one_behaves_like_standalone():
             assert ens.get_node("/solo").data == b"ok"
         finally:
             await client.close()
+
+
+async def test_daemon_rides_through_member_death(tmp_path):
+    # Full-stack version of the failover property: the real daemon
+    # process, configured with the whole ensemble's servers list, keeps
+    # its registration (and never re-registers or restarts) when the
+    # member it is connected to dies.
+    async with ZKEnsemble(3, max_session_timeout_ms=60_000) as ens:
+        config = {
+            "registration": {
+                "domain": "ha.e2e.registrar",
+                "type": "host",
+                "heartbeatInterval": 200,
+            },
+            "adminIp": "10.66.66.70",
+            "zookeeper": {
+                "servers": [
+                    {"host": h, "port": p} for h, p in ens.addresses
+                ],
+                "timeout": 30_000,
+            },
+        }
+        cfg_path = tmp_path / "config.json"
+        cfg_path.write_text(json.dumps(config))
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "registrar_tpu", "-f", str(cfg_path)],
+            cwd=REPO,
+            stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT,
+            env={**os.environ, "PYTHONPATH": REPO},
+        )
+        try:
+            node = f"/registrar/e2e/ha/{socket.gethostname()}"
+            deadline = asyncio.get_event_loop().time() + 15
+            while ens.get_node(node) is None:
+                assert asyncio.get_event_loop().time() < deadline
+                await asyncio.sleep(0.1)
+            before = ens.get_node(node)
+            sid = before.ephemeral_owner
+            czxid = before.czxid
+
+            victim = member_holding(ens, sid)
+            await ens.kill(victim)
+
+            # Wait until the daemon's session lands on a surviving member.
+            deadline = asyncio.get_event_loop().time() + 15
+            while True:
+                # The znode must exist at every instant of the failover.
+                now = ens.get_node(node)
+                assert now is not None and now.ephemeral_owner == sid
+                try:
+                    if member_holding(ens, sid) != victim:
+                        break
+                except AssertionError:
+                    pass  # between connections
+                assert asyncio.get_event_loop().time() < deadline
+                await asyncio.sleep(0.05)
+
+            # Give a few heartbeat intervals to shake out re-registration.
+            await asyncio.sleep(1.0)
+            after = ens.get_node(node)
+            assert after.ephemeral_owner == sid
+            assert after.czxid == czxid  # never deleted + recreated
+            assert proc.poll() is None  # daemon never crashed/restarted
+        finally:
+            if proc.poll() is None:
+                proc.terminate()
+            # communicate(), not wait(): a wedged daemon spewing into the
+            # pipe would fill the OS buffer and deadlock a bare wait().
+            out_b, _ = proc.communicate(timeout=15)
+            out = out_b.decode()
+        registered_events = [
+            line for line in out.splitlines() if "registrar: registered" in line
+        ]
+        assert len(registered_events) == 1, out  # exactly one registration
 
 
 async def test_dead_member_rejected_as_snapshot_donor():

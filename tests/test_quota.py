@@ -1,0 +1,196 @@
+"""Quota tests: zkCli.sh setquota/listquota/delquota parity.
+
+Real ZooKeeper 3.4 stores soft quotas as znodes under /zookeeper/quota
+(<path>/zookeeper_limits holds ``count=N,bytes=B``, the server maintains
+usage in <path>/zookeeper_stats) and *logs* violations without ever
+rejecting writes.  The test server implements the same contract
+(registrar_tpu/testing/server.py), and zkcli ships the three commands.
+"""
+
+import asyncio
+import os
+import subprocess
+import sys
+
+from registrar_tpu.testing.server import ZKServer
+from registrar_tpu.zk.quota import parse_quota
+from registrar_tpu.zk.client import ZKClient
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _run_cli(server, *args):
+    return subprocess.run(
+        [sys.executable, "-m", "registrar_tpu.tools.zkcli",
+         "-s", f"{server.host}:{server.port}", *args],
+        cwd=REPO, capture_output=True, text=True, timeout=30,
+        env={**os.environ, "PYTHONPATH": REPO},
+    )
+
+
+async def test_system_nodes_precreated():
+    async with ZKServer() as server:
+        client = await ZKClient([server.address]).connect()
+        try:
+            assert await client.exists("/zookeeper/quota") is not None
+        finally:
+            await client.close()
+
+
+async def test_setquota_listquota_roundtrip():
+    async with ZKServer() as server:
+        client = await ZKClient([server.address]).connect()
+        try:
+            await client.mkdirp("/app/a")
+            await client.put("/app/a/n1", b"12345")
+
+            out = await asyncio.to_thread(
+                _run_cli, server, "setquota", "-n", "5", "/app"
+            )
+            assert out.returncode == 0, out.stderr
+            assert "count=5,bytes=-1" in out.stdout
+
+            out = await asyncio.to_thread(_run_cli, server, "listquota", "/app")
+            assert out.returncode == 0
+            assert "Output quota for /app count=5,bytes=-1" in out.stdout
+            # live usage: /app + /app/a + /app/a/n1, 5 data bytes
+            assert "Output stat for /app count=3,bytes=5" in out.stdout
+        finally:
+            await client.close()
+
+
+async def test_stats_track_writes():
+    async with ZKServer() as server:
+        client = await ZKClient([server.address]).connect()
+        try:
+            await client.mkdirp("/app")
+            await asyncio.to_thread(
+                _run_cli, server, "setquota", "-n", "100", "/app"
+            )
+            for i in range(3):
+                await client.put(f"/app/c{i}", b"xx")
+            stats, _ = await client.get("/zookeeper/quota/app/zookeeper_stats")
+            usage = parse_quota(stats)
+            assert usage == {"count": 4, "bytes": 6}
+        finally:
+            await client.close()
+
+
+async def test_exceeding_count_logs_soft_warning():
+    async with ZKServer() as server:
+        client = await ZKClient([server.address]).connect()
+        try:
+            await client.mkdirp("/small")
+            out = await asyncio.to_thread(
+                _run_cli, server, "setquota", "-n", "2", "/small"
+            )
+            assert out.returncode == 0
+            await client.put("/small/one", b"")
+            assert server.quota_warnings == 0
+            # Third node exceeds count=2 — write SUCCEEDS (soft limit)
+            # but the server records the violation.
+            await client.put("/small/two", b"")
+            assert server.quota_warnings == 1
+            assert await client.exists("/small/two") is not None
+        finally:
+            await client.close()
+
+
+async def test_exceeding_bytes_logs_soft_warning():
+    async with ZKServer() as server:
+        client = await ZKClient([server.address]).connect()
+        try:
+            await client.mkdirp("/fat")
+            await asyncio.to_thread(
+                _run_cli, server, "setquota", "-b", "10", "/fat"
+            )
+            await client.put("/fat/blob", b"x" * 8)
+            assert server.quota_warnings == 0
+            await client.set_data("/fat/blob", b"x" * 11)
+            assert server.quota_warnings == 1
+        finally:
+            await client.close()
+
+
+async def test_nested_quota_rejected_both_directions():
+    async with ZKServer() as server:
+        client = await ZKClient([server.address]).connect()
+        try:
+            await client.mkdirp("/top/mid/leaf")
+            assert (await asyncio.to_thread(
+                _run_cli, server, "setquota", "-n", "10", "/top/mid"
+            )).returncode == 0
+
+            out = await asyncio.to_thread(
+                _run_cli, server, "setquota", "-n", "5", "/top/mid/leaf"
+            )
+            assert out.returncode == 1
+            assert "already has a quota" in out.stderr
+
+            out = await asyncio.to_thread(
+                _run_cli, server, "setquota", "-n", "50", "/top"
+            )
+            assert out.returncode == 1
+            assert "already has a quota" in out.stderr
+
+            # Updating the SAME path is allowed (not "nesting").
+            out = await asyncio.to_thread(
+                _run_cli, server, "setquota", "-b", "99", "/top/mid"
+            )
+            assert out.returncode == 0
+            assert "count=10,bytes=99" in out.stdout
+        finally:
+            await client.close()
+
+
+async def test_delquota_dimension_and_full():
+    async with ZKServer() as server:
+        client = await ZKClient([server.address]).connect()
+        try:
+            await client.mkdirp("/q")
+            await asyncio.to_thread(
+                _run_cli, server, "setquota", "-n", "7", "-b", "70", "/q"
+            )
+
+            out = await asyncio.to_thread(
+                _run_cli, server, "delquota", "-n", "/q"
+            )
+            assert out.returncode == 0
+            assert "count=-1,bytes=70" in out.stdout
+
+            out = await asyncio.to_thread(_run_cli, server, "delquota", "/q")
+            assert out.returncode == 0
+            out = await asyncio.to_thread(_run_cli, server, "listquota", "/q")
+            assert out.returncode == 1
+            assert "does not exist" in out.stdout
+            # and violations no longer tick
+            before = server.quota_warnings
+            for i in range(10):
+                await client.put(f"/q/n{i}", b"data")
+            assert server.quota_warnings == before
+        finally:
+            await client.close()
+
+
+async def test_registration_traffic_unaffected_by_quota_machinery():
+    # The daemon's paths never touch /zookeeper; a quota'd domain subtree
+    # still registers fine (soft limits never reject writes).
+    from registrar_tpu.registration import register
+
+    async with ZKServer() as server:
+        client = await ZKClient([server.address]).connect()
+        try:
+            await client.mkdirp("/us/test")
+            await asyncio.to_thread(
+                _run_cli, server, "setquota", "-n", "1", "/us"
+            )
+            nodes = await register(
+                client,
+                {"domain": "quotad.test.us", "type": "host"},
+                admin_ip="10.9.9.9", hostname="h1", settle_delay=0,
+            )
+            for n in nodes:
+                assert await client.exists(n) is not None
+            assert server.quota_warnings > 0  # soft-flagged, not blocked
+        finally:
+            await client.close()
