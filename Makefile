@@ -57,7 +57,7 @@ release:
 	sed 's|@@PREFIX@@|$(PREFIX)|g' smf/manifests/registrar.xml.in \
 	    > $(RELSTAGEDIR)$(PREFIX)/smf/manifests/registrar.xml
 	cp etc/config.coal.json etc/config.example.json $(RELSTAGEDIR)$(PREFIX)/etc/
-	cp README.md pyproject.toml $(RELSTAGEDIR)$(PREFIX)/
+	cp README.md LICENSE pyproject.toml $(RELSTAGEDIR)$(PREFIX)/
 	find $(RELSTAGEDIR) -name __pycache__ -type d | xargs rm -rf
 	tar -czf $(RELEASE_TARBALL) -C $(RELSTAGEDIR) $(PREFIX_TOP)
 	rm -rf $(RELSTAGEDIR)

@@ -52,6 +52,11 @@ class TestReleaseArtifact:
         assert (root / "etc" / "config.coal.json").exists()
         assert any("systemd" in n for n in names)
 
+        # The MPL-2.0 license text ships in the tarball like the
+        # reference's LICENSE does (reference LICENSE, Makefile release).
+        license_text = (root / "LICENSE").read_text()
+        assert "Mozilla Public License Version 2.0" in license_text
+
         # The shipped SMF manifest is generated from the .xml.in template
         # (reference Makefile:19): valid XML, fully substituted, and its
         # paths point into the install prefix.
