@@ -185,3 +185,94 @@ class TestRealZooKeeper:
             await client.unlink(path)
         finally:
             await client.close()
+
+    async def test_daemon_e2e_against_real_zk(self, tmp_path):
+        """Short daemon e2e: the real daemon registers into the real
+        ZooKeeper, the znode payload matches the contract, and SIGKILL
+        (the SMF ':kill' analog) lets the ephemeral vanish via real
+        session expiry — the reference's deployment story
+        (reference main.js:141-144, smf/manifests/registrar.xml.in)
+        against the reference's test dependency (test/helper.js:57-62).
+        """
+        import asyncio
+        import json
+        import signal
+        import socket
+        import subprocess
+        import sys
+
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        token = uuid.uuid4().hex[:8]
+        domain = f"{token}.e2e.registrar"  # -> /registrar/e2e/<token>/<host>
+        host, port = _servers()[0]
+        config = {
+            "registration": {
+                "domain": domain,
+                "type": "load_balancer",
+                "heartbeatInterval": 500,
+                "service": {
+                    "type": "service",
+                    "service": {"srvce": "_http", "proto": "_tcp", "port": 80},
+                },
+            },
+            "adminIp": "10.250.0.2",
+            # real ZooKeeper clamps the session timeout to >= 2*tickTime
+            # (4 s with the stock 2 s tick), so expiry below takes a few
+            # seconds — keep the requested value at the floor.
+            "zookeeper": {
+                "servers": [{"host": host, "port": port}],
+                "timeout": 4000,
+            },
+        }
+        cfg_path = tmp_path / "config.json"
+        cfg_path.write_text(json.dumps(config))
+
+        observer = await ZKClient(_servers()).connect()
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "registrar_tpu", "-f", str(cfg_path)],
+            cwd=repo,
+            stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT,
+            env={**os.environ, "PYTHONPATH": repo},
+        )
+        try:
+            hostname = socket.gethostname()
+            host_node = f"/registrar/e2e/{token}/{hostname}"
+            svc_node = f"/registrar/e2e/{token}"
+            # daemon start + 1 s contract settle delay
+            for _ in range(150):
+                if await observer.exists(host_node):
+                    break
+                await asyncio.sleep(0.1)
+            else:
+                raise AssertionError("host znode never appeared in real ZK")
+            data, st = await observer.get(host_node)
+            assert st.ephemeral_owner != 0
+            payload = parse_payload(data)
+            assert payload["type"] == "load_balancer"
+            assert payload["load_balancer"]["ports"] == [80]
+            svc, svc_st = await observer.get(svc_node)
+            assert svc_st.ephemeral_owner == 0
+            assert parse_payload(svc)["type"] == "service"
+
+            proc.send_signal(signal.SIGKILL)
+            proc.wait(timeout=10)
+            # ephemeral vanishes only when the real session expires
+            # (>= the 4 s floor after the last heartbeat)
+            for _ in range(300):
+                if not await observer.exists(host_node):
+                    break
+                await asyncio.sleep(0.1)
+            else:
+                raise AssertionError("ephemeral survived real session expiry")
+            assert await observer.exists(svc_node) is not None
+        finally:
+            if proc.poll() is None:
+                proc.kill()
+            # clean up the persistent chain this test minted
+            for p in (f"/registrar/e2e/{token}", "/registrar/e2e", "/registrar"):
+                try:
+                    await observer.unlink(p)
+                except Exception:  # noqa: BLE001 - shared parents may remain
+                    break
+            await observer.close()
