@@ -182,6 +182,12 @@ class _SharedState:
     linearizable history ZAB would produce).  Watches live here too: a
     write through member A must notify a watcher connected to member B,
     exactly as in a real ensemble.
+
+    Members configured with ``apply_delay_ms`` opt out of instant
+    convergence on the *read* side: their read view freezes at the
+    pre-commit state when another member commits, and catches up on
+    sync()/own-write/quiescence (see ZKServer.apply_delay_ms) — the
+    stale-follower-read behavior sync() exists to fence.
     """
 
     def __init__(self) -> None:
@@ -195,12 +201,39 @@ class _SharedState:
             _WATCH_EXIST: {},
             _WATCH_CHILD: {},
         }
+        #: live members, so a commit through one can freeze the stale read
+        #: view of members configured with an apply delay (see
+        #: ZKServer.apply_delay_ms)
+        self.members: Set["ZKServer"] = set()
+        #: monotonic time of the newest commit — drives lagging members'
+        #: quiescence-based catch-up
+        self.last_commit = 0.0
         ensure_system_nodes(self.root)
 
 
 def ensure_system_nodes(root: ZNode) -> None:
     zk = root.children.setdefault("zookeeper", ZNode(ctime=_now_ms()))
     zk.children.setdefault("quota", ZNode(ctime=_now_ms()))
+
+
+def _clone_tree(node: ZNode) -> ZNode:
+    """Deep point-in-time copy of a znode subtree (a lagging member's
+    frozen read view).  Immutable payloads (bytes) are shared; structure,
+    stats, and ACL lists are copied."""
+    return ZNode(
+        data=node.data,
+        ephemeral_owner=node.ephemeral_owner,
+        children={k: _clone_tree(v) for k, v in node.children.items()},
+        czxid=node.czxid,
+        mzxid=node.mzxid,
+        pzxid=node.pzxid,
+        ctime=node.ctime,
+        mtime=node.mtime,
+        version=node.version,
+        cversion=node.cversion,
+        aversion=node.aversion,
+        acls=list(node.acls),
+    )
 
 
 class ZKServer:
@@ -216,6 +249,7 @@ class ZKServer:
         snapshot: Optional["ZKServer"] = None,
         shared: Optional[_SharedState] = None,
         server_id: int = 0,
+        apply_delay_ms: int = 0,
     ):
         """``snapshot``: adopt another (stopped) server's tree, sessions,
         and zxid — models a real ensemble surviving a member restart, so
@@ -224,6 +258,20 @@ class ZKServer:
 
         ``shared``: join a live ensemble's replicated state (see
         :class:`ZKEnsemble`); mutually exclusive with ``snapshot``.
+
+        ``apply_delay_ms``: model a lagging follower.  When > 0, a commit
+        made through any *other* member freezes this member's read view
+        at the pre-commit state; reads served here stay stale until the
+        member catches up — on ``sync()`` through it (the client-visible
+        barrier real ZooKeeper's sync provides), on a write it serves
+        itself (ZooKeeper's read-your-writes guarantee: a follower applies
+        a commit before acking it to the issuing client), or once the
+        commit stream has been quiescent for ``apply_delay_ms`` (the
+        sweeper's batch catch-up; under continuous churn the member stays
+        behind, as a saturated real follower would).  Watches still fire
+        from the replicated state, which may notify a client of a change
+        its next read does not show yet — the same reordering a real
+        follower's event pipeline can exhibit.  See ZKEnsemble.set_lag.
         """
         self.host = host
         self._requested_port = port
@@ -285,6 +333,15 @@ class ZKServer:
         #: session liveness) — simulates a wedged-but-connected server for
         #: client watchdog tests
         self.freeze = False
+        #: replication lag (see __init__ docstring); mutable at runtime
+        self.apply_delay_ms = apply_delay_ms
+        #: frozen stale read view while behind; None = caught up
+        self._lag_root: Optional[ZNode] = None
+        #: watches armed against the stale view — each may guard a
+        #: transition that already committed, so catch-up must deliver
+        #: the missed event (real ZK fires it when the follower applies
+        #: the txn); list of (kind, path, conn)
+        self._lag_watches: List[Tuple[str, str, _Connection]] = []
 
     # -- replicated state (delegates to _SharedState so ensemble members
     # -- converge by construction; standalone servers own a private one) ----
@@ -340,11 +397,13 @@ class ZKServer:
             self._handle_conn, self.host, self._requested_port
         )
         self.port = self._server.sockets[0].getsockname()[1]
+        self._state.members.add(self)
         self._sweeper = asyncio.create_task(self._sweep_loop())
         log.debug("ZKServer listening on %s:%d", self.host, self.port)
         return self
 
     async def stop(self) -> None:
+        self._state.members.discard(self)
         if self._sweeper:
             self._sweeper.cancel()
             try:
@@ -687,6 +746,16 @@ class ZKServer:
         while True:
             await asyncio.sleep(self.tick_ms / 1000.0)
             now = time.monotonic()
+            # Lagging member batch catch-up: once the commit stream has
+            # been quiescent for apply_delay_ms, the member applies its
+            # backlog (real followers stream commits; quiescence-gating is
+            # what keeps the frozen view a true point-in-time prefix).
+            if (
+                self._lag_root is not None
+                and self.apply_delay_ms > 0
+                and now - self._state.last_commit >= self.apply_delay_ms / 1000.0
+            ):
+                self._catch_up()
             for sess in list(self.sessions.values()):
                 # A live connection keeps the session alive via pings; the
                 # expiry countdown only runs while disconnected (matching
@@ -800,21 +869,102 @@ class ZKServer:
 
     # -- tree ops -----------------------------------------------------------
 
-    def _resolve(self, path: str) -> ZNode:
+    def _resolve(self, path: str, root: Optional[ZNode] = None) -> ZNode:
+        node = root if root is not None else self.root
         if path == "/":
-            return self.root
-        node = self.root
+            return node
         for comp in path.strip("/").split("/"):
             node = node.children[comp]  # KeyError -> NO_NODE
         return node
+
+    def _resolve_read(self, path: str) -> ZNode:
+        """Resolve against this member's *read view*: the frozen stale
+        tree while lagging behind the replicated state, else the live
+        tree.  Write paths always use :meth:`_resolve` (commits go to the
+        replicated state, as a real follower forwards them to the
+        leader)."""
+        return self._resolve(
+            path, self._lag_root if self._lag_root is not None else self.root
+        )
 
     def _split(self, path: str) -> Tuple[str, str]:
         parent, _, name = path.rpartition("/")
         return (parent or "/", name)
 
     def _next_zxid(self) -> int:
+        # A commit is about to apply to the replicated state: every other
+        # live member configured to lag, and currently caught up, freezes
+        # its read view at the pre-commit state.  (The committing member
+        # itself never freezes — a follower applies a commit before acking
+        # it, preserving read-your-writes.)
+        for member in self._state.members:
+            if (
+                member is not self
+                and member.apply_delay_ms > 0
+                and member._lag_root is None
+            ):
+                member._lag_root = _clone_tree(self._state.root)
         self.zxid += 1
+        self._state.last_commit = time.monotonic()
         return self.zxid
+
+    def _catch_up(self) -> None:
+        """Apply the replicated state up to now: drop the stale read view.
+
+        Watches armed against the stale view guard transitions that may
+        already have committed (their events fired before the watch
+        existed); real ZooKeeper's never-miss-a-transition guarantee
+        means the follower delivers them when it applies the txns, so
+        compare each armed path's stale state against the live tree and
+        synthesize the missed event — the same reconciliation the
+        SetWatches handler performs for reconnecting clients.
+        """
+        if self._lag_root is None:
+            return
+        stale_root, self._lag_root = self._lag_root, None
+        pending, self._lag_watches = self._lag_watches, []
+        for kind, path, conn in pending:
+            if conn.closed:
+                continue
+            # Only reconcile watches still armed: a watch the live
+            # commit path already fired (popping it from the shared
+            # table) must not deliver twice — one-shot semantics.  This
+            # also collapses duplicate _lag_watches entries.
+            holders = self._watches[kind].get(path)
+            if holders is None or conn not in holders:
+                continue
+            try:
+                live: Optional[ZNode] = self._resolve(path)
+            except KeyError:
+                live = None
+            try:
+                stale: Optional[ZNode] = self._resolve(path, stale_root)
+            except KeyError:
+                stale = None
+            ev: Optional[int] = None
+            if kind == _WATCH_EXIST:
+                if live is not None:
+                    ev = EventType.NODE_CREATED
+            elif kind == _WATCH_DATA:
+                if live is None:
+                    ev = EventType.NODE_DELETED
+                elif stale is not None and live.mzxid != stale.mzxid:
+                    ev = EventType.NODE_DATA_CHANGED
+            elif kind == _WATCH_CHILD:
+                if live is None:
+                    ev = EventType.NODE_DELETED
+                elif stale is not None and live.cversion != stale.cversion:
+                    ev = EventType.NODE_CHILDREN_CHANGED
+            if ev is None:
+                continue  # no missed transition; the armed watch stands
+            # One-shot semantics: retire this connection's watch, leave
+            # other holders of the same (kind, path) armed.
+            holders.discard(conn)
+            if not holders:
+                self._watches[kind].pop(path, None)
+            asyncio.ensure_future(
+                self._send_watch_events({conn}, ev, path)
+            )
 
     async def _fire_watches(self, kind: str, path: str, ev_type: int) -> None:
         conns = self._watches[kind].pop(path, set())
@@ -830,6 +980,10 @@ class ZKServer:
 
     def _add_watch(self, kind: str, path: str, conn: _Connection) -> None:
         self._watches[kind].setdefault(path, set()).add(conn)
+        if self._lag_root is not None:
+            # Armed against the stale view: catch-up must reconcile it
+            # against the live tree (see _catch_up).
+            self._lag_watches.append((kind, path, conn))
 
     # -- ACLs (ZooKeeper 3.4 semantics) --------------------------------------
     #
@@ -1014,9 +1168,12 @@ class ZKServer:
             raise proto.ZKError(Err.BAD_VERSION, path)
         if node.children:
             raise proto.ZKError(Err.NOT_EMPTY, path)
+        # Allocate the zxid before mutating: lagging members freeze their
+        # read view at the pre-commit state inside _next_zxid.
+        zxid = self._next_zxid()
         del parent.children[name]
         parent.cversion += 1
-        parent.pzxid = self._next_zxid()
+        parent.pzxid = zxid
         if node.ephemeral_owner:
             owner = self.sessions.get(node.ephemeral_owner)
             if owner:
@@ -1043,9 +1200,10 @@ class ZKServer:
             self._check_acl(node.acls, proto.Perms.WRITE, sess)
         if version != -1 and node.version != version:
             raise proto.ZKError(Err.BAD_VERSION, path)
+        # zxid first: _next_zxid freezes lagging members' pre-commit view.
+        node.mzxid = self._next_zxid()
         node.data = data or b""
         node.version += 1
-        node.mzxid = self._next_zxid()
         node.mtime = _now_ms()
         self._check_quota(path)
         await self._fire_watches(_WATCH_DATA, path, EventType.NODE_DATA_CHANGED)
@@ -1385,6 +1543,7 @@ class ZKServer:
                 path = await self._create_node(
                     req.path, req.data, req.flags, sess, req.acls
                 )
+                self._catch_up()  # read-your-writes on this member
                 return self._reply(hdr.xid, Err.OK, proto.CreateResponse(path=path))
             if op == OpCode.DELETE:
                 req = proto.DeleteRequest.read(r)
@@ -1393,12 +1552,13 @@ class ZKServer:
                     await self._delete_node(req.path, req.version, sess)
                 except KeyError:
                     raise proto.ZKError(Err.NO_NODE, req.path)
+                self._catch_up()
                 return self._reply(hdr.xid, Err.OK)
             if op == OpCode.EXISTS:
                 req = proto.ExistsRequest.read(r)
                 proto.check_path(req.path)
                 try:
-                    node = self._resolve(req.path)
+                    node = self._resolve_read(req.path)
                 except KeyError:
                     if req.watch:
                         self._add_watch(_WATCH_EXIST, req.path, conn)
@@ -1413,7 +1573,7 @@ class ZKServer:
                 proto.check_path(req.path)
                 await self._refresh_quota_stats(req.path)
                 try:
-                    node = self._resolve(req.path)
+                    node = self._resolve_read(req.path)
                 except KeyError:
                     raise proto.ZKError(Err.NO_NODE, req.path)
                 self._check_acl(node.acls, proto.Perms.READ, sess)
@@ -1430,6 +1590,7 @@ class ZKServer:
                 stat = await self._set_data_node(
                     req.path, req.data, req.version, sess
                 )
+                self._catch_up()
                 return self._reply(
                     hdr.xid, Err.OK, proto.SetDataResponse(stat=stat)
                 )
@@ -1437,7 +1598,7 @@ class ZKServer:
                 req = proto.GetACLRequest.read(r)
                 proto.check_path(req.path)
                 try:
-                    node = self._resolve(req.path)
+                    node = self._resolve_read(req.path)
                 except KeyError:
                     raise proto.ZKError(Err.NO_NODE, req.path)
                 # Unchecked in 3.4 (ADMIN-gating arrived with 3.5's
@@ -1459,9 +1620,14 @@ class ZKServer:
                 self._check_acl(node.acls, proto.Perms.ADMIN, sess)
                 if req.version != -1 and node.aversion != req.version:
                     raise proto.ZKError(Err.BAD_VERSION, req.path)
-                node.acls = self._fix_acls(req.acls, sess)
-                node.aversion += 1
+                # Validate (fix_acls raises INVALID_ACL) before the zxid
+                # is allocated — a failed op must not consume a zxid or
+                # freeze lagging members.
+                fixed_acls = self._fix_acls(req.acls, sess)
                 self._next_zxid()  # a write transaction, but mzxid untouched
+                node.acls = fixed_acls
+                node.aversion += 1
+                self._catch_up()
                 return self._reply(
                     hdr.xid, Err.OK, proto.SetACLResponse(stat=node.stat())
                 )
@@ -1469,7 +1635,7 @@ class ZKServer:
                 req = proto.GetChildrenRequest.read(r)
                 proto.check_path(req.path)
                 try:
-                    node = self._resolve(req.path)
+                    node = self._resolve_read(req.path)
                 except KeyError:
                     raise proto.ZKError(Err.NO_NODE, req.path)
                 self._check_acl(node.acls, proto.Perms.READ, sess)
@@ -1518,15 +1684,20 @@ class ZKServer:
                 return self._reply(hdr.xid, Err.OK)
             if op == OpCode.SYNC:
                 req = proto.SyncRequest.read(r)
-                # Single-node server: everything is already committed, so
-                # sync degenerates to an ordering barrier through the
-                # request pipeline (real ZK flushes the leader pipeline).
+                # The catch-up barrier: real ZK's sync makes the serving
+                # follower flush the leader's pipeline so subsequent reads
+                # through it are current.  A lagging member applies its
+                # whole backlog here; a caught-up one degenerates to a
+                # request-pipeline ordering barrier.
+                self._catch_up()
                 return self._reply(
                     hdr.xid, Err.OK, proto.SyncResponse(path=req.path)
                 )
             if op == OpCode.MULTI:
                 req = proto.MultiRequest.read(r)
-                return self._reply(hdr.xid, Err.OK, await self._multi(req, sess))
+                reply = self._reply(hdr.xid, Err.OK, await self._multi(req, sess))
+                self._catch_up()
+                return reply
             if op == OpCode.CHECK:
                 req = proto.CheckVersionRequest.read(r)
                 proto.check_path(req.path)
@@ -1661,6 +1832,20 @@ class ZKEnsemble:
         self.servers[i] = member
         self._elect()
         return member
+
+    def set_lag(self, i: int, apply_delay_ms: int) -> None:
+        """Make member ``i`` a lagging follower (``apply_delay_ms`` > 0)
+        or bring it back in step (0, after an immediate catch-up).
+        Lag starts from the *next* commit made through another member;
+        the member's current view is the replicated state.  Reads through
+        a lagging member then return stale data until a client issues
+        ``sync()`` on it — the scenario ZKClient.sync exists for."""
+        member = self.servers[i]
+        if member is None or member._server is None:
+            raise ValueError(f"member {i} is not running")
+        member.apply_delay_ms = apply_delay_ms
+        if apply_delay_ms <= 0:
+            member._catch_up()
 
     @property
     def live(self) -> List[ZKServer]:
