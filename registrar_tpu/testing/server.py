@@ -337,6 +337,9 @@ class ZKServer:
         self.apply_delay_ms = apply_delay_ms
         #: frozen stale read view while behind; None = caught up
         self._lag_root: Optional[ZNode] = None
+        #: the zxid the frozen view corresponds to (stamped on replies
+        #: while lagging); meaningful only when _lag_root is not None
+        self._lag_zxid = 0
         #: watches armed against the stale view — each may guard a
         #: transition that already committed, so catch-up must deliver
         #: the missed event (real ZK fires it when the follower applies
@@ -904,6 +907,7 @@ class ZKServer:
                 and member._lag_root is None
             ):
                 member._lag_root = _clone_tree(self._state.root)
+                member._lag_zxid = self._state.zxid
         self.zxid += 1
         self._state.last_commit = time.monotonic()
         return self.zxid
@@ -978,11 +982,16 @@ class ZKServer:
             if not conn.closed:
                 await conn.send_event(ev_type, path)
 
-    def _add_watch(self, kind: str, path: str, conn: _Connection) -> None:
+    def _add_watch(
+        self, kind: str, path: str, conn: _Connection, stale_view: bool = False
+    ) -> None:
         self._watches[kind].setdefault(path, set()).add(conn)
-        if self._lag_root is not None:
+        if stale_view and self._lag_root is not None:
             # Armed against the stale view: catch-up must reconcile it
-            # against the live tree (see _catch_up).
+            # against the live tree (see _catch_up).  Watches re-armed by
+            # the SET_WATCHES handler never enroll — that handler already
+            # reconciled them against the live tree via relative_zxid, so
+            # a catch-up event would duplicate what the client has seen.
             self._lag_watches.append((kind, path, conn))
 
     # -- ACLs (ZooKeeper 3.4 semantics) --------------------------------------
@@ -1561,10 +1570,14 @@ class ZKServer:
                     node = self._resolve_read(req.path)
                 except KeyError:
                     if req.watch:
-                        self._add_watch(_WATCH_EXIST, req.path, conn)
+                        self._add_watch(
+                            _WATCH_EXIST, req.path, conn, stale_view=True
+                        )
                     raise proto.ZKError(Err.NO_NODE, req.path)
                 if req.watch:
-                    self._add_watch(_WATCH_DATA, req.path, conn)
+                    self._add_watch(
+                        _WATCH_DATA, req.path, conn, stale_view=True
+                    )
                 return self._reply(
                     hdr.xid, Err.OK, proto.ExistsResponse(stat=node.stat())
                 )
@@ -1578,7 +1591,9 @@ class ZKServer:
                     raise proto.ZKError(Err.NO_NODE, req.path)
                 self._check_acl(node.acls, proto.Perms.READ, sess)
                 if req.watch:
-                    self._add_watch(_WATCH_DATA, req.path, conn)
+                    self._add_watch(
+                        _WATCH_DATA, req.path, conn, stale_view=True
+                    )
                 return self._reply(
                     hdr.xid,
                     Err.OK,
@@ -1640,7 +1655,9 @@ class ZKServer:
                     raise proto.ZKError(Err.NO_NODE, req.path)
                 self._check_acl(node.acls, proto.Perms.READ, sess)
                 if req.watch:
-                    self._add_watch(_WATCH_CHILD, req.path, conn)
+                    self._add_watch(
+                        _WATCH_CHILD, req.path, conn, stale_view=True
+                    )
                 children = sorted(node.children)
                 if op == OpCode.GET_CHILDREN:
                     body = proto.GetChildrenResponse(children=children)
@@ -1716,7 +1733,13 @@ class ZKServer:
             return self._reply(hdr.xid, Err.BAD_ARGUMENTS)
 
     def _reply(self, xid: int, err: int, body=None) -> bytes:
-        return proto.encode_reply_payload(xid, self.zxid, err, body)
+        # A lagging member stamps replies with the zxid its frozen view
+        # corresponds to (real followers report their own
+        # lastProcessedZxid).  Stamping the live shared zxid would make a
+        # client's last_zxid overstate what it observed, suppressing the
+        # SetWatches reconciliation it is owed after a reconnect.
+        zxid = self._lag_zxid if self._lag_root is not None else self.zxid
+        return proto.encode_reply_payload(xid, zxid, err, body)
 
 
 class ZKEnsemble:

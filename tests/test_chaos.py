@@ -1,0 +1,283 @@
+"""Chaos soak: seeded fault injection under register/unregister churn.
+
+The existing soak/ensemble tests only ever kill a member *between*
+operations.  Here a chaos task kills and restarts ensemble members and
+severs client connections at random moments — statistically landing
+inside the five-stage registration pipeline (cleanup → settle → mkdirp
+→ create → service put), exactly where orphan ephemerals or
+half-registrations would be minted — while N registrars churn
+register/heartbeat/unregister through it all.
+
+Afterwards the system must converge:
+
+  * every registrar ends registered, its host znode ephemeral-owned by
+    its own live session;
+  * the persistent service record at the domain node is intact;
+  * no orphan ephemerals anywhere in the tree (an ephemeral whose owner
+    session no longer exists);
+  * the Binder view answers with exactly the N live instances.
+
+Reproducibility: the run is driven by one RNG seed, printed at start
+(so it appears in pytest's captured output on failure).  Pin it with
+``CHAOS_SEED=<n>``; lengthen the churn window with ``CHAOS_SECONDS=<s>``
+(default keeps the whole test well under 10 s).
+
+Failure-detection parity: SURVEY.md §5 — liveness via sessions,
+crash-and-restart recovery, idempotent re-registration
+(reference lib/register.js:78-105 cleanup stage) are the app's core
+domain; this is the adversarial test of all three at once.
+"""
+
+import asyncio
+import os
+import random
+import sys
+
+from registrar_tpu import binderview
+from registrar_tpu.records import parse_payload
+from registrar_tpu.registration import register, unregister
+from registrar_tpu.retry import RetryPolicy
+from registrar_tpu.testing.server import ZKEnsemble
+from registrar_tpu.zk.client import SessionExpiredError, ZKClient
+from registrar_tpu.zk.protocol import ZKError
+
+DOMAIN = "chaos.prod.us"
+PATH = "/us/prod/chaos"
+N_WORKERS = 6
+ENSEMBLE = 3
+
+#: chaos-appropriate reconnect: spin back fast instead of the production
+#: 1–90 s schedule, so convergence after the storm is quick
+FAST_RECONNECT = RetryPolicy(
+    max_attempts=float("inf"), initial_delay=0.02, max_delay=0.25
+)
+
+
+def _reg():
+    return {
+        "domain": DOMAIN,
+        "type": "load_balancer",
+        "service": {
+            "type": "service",
+            "service": {"srvce": "_http", "proto": "_tcp", "port": 80},
+        },
+    }
+
+
+class _Worker:
+    """One registrar instance churning through the chaos."""
+
+    def __init__(self, i: int, ens: ZKEnsemble, seed: int):
+        self.i = i
+        self.ens = ens
+        self.rng = random.Random(seed)
+        self.hostname = f"chaos{i}"
+        self.admin_ip = f"10.9.0.{i + 1}"
+        self.client: ZKClient = None
+        self.nodes = None
+        self.ops = 0
+
+    async def connect(self) -> None:
+        self.client = ZKClient(
+            self.ens.addresses,
+            timeout_ms=8000,
+            reconnect_policy=FAST_RECONNECT,
+        )
+        await self.client.connect()
+
+    async def _register(self) -> None:
+        self.nodes = await register(
+            self.client,
+            _reg(),
+            admin_ip=self.admin_ip,
+            hostname=self.hostname,
+            # short but non-zero: keeps the pipeline window open so
+            # chaos can land between its stages
+            settle_delay=self.rng.uniform(0.005, 0.04),
+        )
+
+    async def churn(self, stop: asyncio.Event) -> None:
+        while not stop.is_set():
+            try:
+                if self.nodes is None:
+                    await self._register()
+                else:
+                    roll = self.rng.random()
+                    if roll < 0.45:
+                        await self.client.heartbeat(
+                            self.nodes, retry=RetryPolicy(max_attempts=1)
+                        )
+                    elif roll < 0.75:
+                        await unregister(self.client, self.nodes)
+                        self.nodes = None
+                    else:
+                        # re-register over the live registration: the
+                        # cleanup stage must make this idempotent
+                        await self._register()
+                self.ops += 1
+            except SessionExpiredError:
+                await self.connect()  # fresh session; ephemerals are gone
+                self.nodes = None
+            except (ZKError, ConnectionError, OSError):
+                # interrupted mid-pipeline; state unknown — the next
+                # register()'s cleanup stage reconciles it
+                self.nodes = None
+            await asyncio.sleep(self.rng.uniform(0.0, 0.02))
+
+    async def converge(self) -> None:
+        """Post-storm: end registered, however the churn left us."""
+        for _ in range(200):
+            try:
+                if self.client.closed:
+                    await self.connect()
+                await self._register()
+                return
+            except SessionExpiredError:
+                await self.connect()
+                self.nodes = None
+            except (ZKError, ConnectionError, OSError):
+                await asyncio.sleep(0.05)
+        raise AssertionError(f"worker {self.i} never converged")
+
+
+async def _chaos_task(
+    ens: ZKEnsemble,
+    rng: random.Random,
+    stop: asyncio.Event,
+    events: list,
+    max_events: float = float("inf"),
+) -> None:
+    while not stop.is_set() and len(events) < max_events:
+        await asyncio.sleep(rng.uniform(0.02, 0.1))
+        live = [
+            i
+            for i, m in enumerate(ens.servers)
+            if m is not None and m._server is not None
+        ]
+        dead = [i for i in range(ENSEMBLE) if i not in live]
+        roll = rng.random()
+        if roll < 0.35 and len(live) > 1:
+            i = rng.choice(live)
+            await ens.kill(i)
+            events.append(("kill", i))
+        elif roll < 0.65 and dead:
+            i = rng.choice(dead)
+            await ens.restart(i)
+            events.append(("restart", i))
+        elif live:
+            i = rng.choice(live)
+            await ens.servers[i].drop_connections()
+            events.append(("drop", i))
+    # storm over: restore full strength
+    for i in range(ENSEMBLE):
+        await ens.restart(i)
+
+
+def _orphan_ephemerals(ens: ZKEnsemble) -> list:
+    """Every ephemeral in the tree whose owner session is gone."""
+    orphans = []
+
+    def walk(node, prefix):
+        for name, child in node.children.items():
+            path = f"{prefix}/{name}" if prefix != "/" else f"/{name}"
+            if child.ephemeral_owner:
+                sess = ens.state.sessions.get(child.ephemeral_owner)
+                if sess is None or sess.closed:
+                    orphans.append((path, child.ephemeral_owner))
+            walk(child, path)
+
+    walk(ens.state.root, "/")
+    return orphans
+
+
+async def test_chaos_churn_converges():
+    seed = int(os.environ.get("CHAOS_SEED", random.randrange(2**32)))
+    churn_s = float(os.environ.get("CHAOS_SECONDS", "2.5"))
+    print(f"CHAOS_SEED={seed} CHAOS_SECONDS={churn_s}", file=sys.stderr)
+    rng = random.Random(seed)
+
+    async with ZKEnsemble(ENSEMBLE, tick_ms=20) as ens:
+        workers = [
+            _Worker(i, ens, rng.randrange(2**32)) for i in range(N_WORKERS)
+        ]
+        for w in workers:
+            await w.connect()
+
+        stop = asyncio.Event()
+        events: list = []
+        tasks = [asyncio.create_task(w.churn(stop)) for w in workers]
+        chaos = asyncio.create_task(_chaos_task(ens, rng, stop, events))
+
+        await asyncio.sleep(churn_s)
+        stop.set()
+        await asyncio.gather(*tasks)
+        await chaos  # restores all members
+        assert events, "chaos task injected no faults"
+        total_ops = sum(w.ops for w in workers)
+        assert total_ops >= N_WORKERS, f"churn barely ran ({total_ops} ops)"
+
+        # -- convergence ---------------------------------------------------
+        await asyncio.gather(*(w.converge() for w in workers))
+
+        try:
+            # every worker owns its host znode with its live session
+            for w in workers:
+                st = await w.client.stat(f"{PATH}/{w.hostname}")
+                assert st is not None
+                assert st.ephemeral_owner == w.client.session_id, (
+                    f"worker {w.i}: owner 0x{st.ephemeral_owner:x} != "
+                    f"session 0x{w.client.session_id:x}"
+                )
+
+            # the persistent service record survived the storm
+            svc, svc_st = await workers[0].client.get(PATH)
+            assert svc_st.ephemeral_owner == 0
+            assert parse_payload(svc)["type"] == "service"
+
+            # no ephemeral anywhere belongs to a dead session
+            orphans = _orphan_ephemerals(ens)
+            assert not orphans, f"orphan ephemerals: {orphans}"
+
+            # the Binder view answers with exactly the live fleet
+            res = await binderview.resolve(workers[0].client, DOMAIN, "A")
+            assert sorted(a.data for a in res.answers) == sorted(
+                w.admin_ip for w in workers
+            )
+
+            # Full teardown drains the domain completely.  Host nodes
+            # first; the shared domain node (the service record, which
+            # register appended to every worker's owned list) can only
+            # go once it has no children — the same NOT_EMPTY ordering a
+            # fleet draining against real ZooKeeper must respect.
+            for w in workers:
+                await unregister(
+                    w.client, [n for n in w.nodes if n != PATH]
+                )
+            kids = await workers[0].client.get_children(PATH)
+            assert kids == []
+            await unregister(workers[0].client, [PATH])
+            assert await workers[0].client.exists(PATH) is None
+            orphans = _orphan_ephemerals(ens)
+            assert not orphans, f"orphans after teardown: {orphans}"
+        finally:
+            for w in workers:
+                if w.client is not None and not w.client.closed:
+                    await w.client.close()
+
+
+async def test_chaos_repeats_with_fixed_seed():
+    """The same seed must drive the same fault schedule (kill/restart/drop
+    decisions) — reproducibility is what makes a failing run debuggable.
+    Driven by event count, not wall clock, so the schedule is exact."""
+    async def fault_schedule(seed: int) -> list:
+        rng = random.Random(seed)
+        async with ZKEnsemble(ENSEMBLE, tick_ms=20) as ens:
+            stop = asyncio.Event()
+            events: list = []
+            await _chaos_task(ens, rng, stop, events, max_events=12)
+            return events
+
+    a = await fault_schedule(1234)
+    b = await fault_schedule(1234)
+    assert a == b
+    assert len(a) == 12

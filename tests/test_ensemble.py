@@ -436,6 +436,67 @@ class TestReplicationLag:
                 await reader.close()
                 await writer.close()
 
+    async def test_lagging_member_reports_its_applied_zxid(self):
+        # A real follower stamps replies with its own lastProcessedZxid.
+        # If a lagging member stamped the live shared zxid instead, the
+        # client's last_zxid would overstate what it observed and the
+        # SetWatches reconciliation after a reconnect would be
+        # suppressed for changes it never saw.
+        async with ZKEnsemble(2) as ens:
+            writer = await ZKClient([ens.addresses[0]]).connect()
+            reader = await ZKClient([ens.addresses[1]]).connect()
+            try:
+                await writer.create("/zx", b"v1")
+                await reader.sync("/")
+                base = reader.last_zxid
+                ens.set_lag(1, 60_000)
+                await writer.put("/zx", b"v2")
+                assert (await reader.get("/zx"))[0] == b"v1"
+                assert reader.last_zxid == base  # not the live zxid
+                await reader.sync("/")
+                assert (await reader.get("/zx"))[0] == b"v2"
+                assert reader.last_zxid > base
+            finally:
+                await reader.close()
+                await writer.close()
+
+    async def test_setwatches_rearm_not_enrolled_for_catch_up(self):
+        # A watch re-armed via the SET_WATCHES reconnect handler was
+        # already reconciled against the live tree (relative_zxid); if
+        # catch-up reconciled it again, the client could receive an
+        # event for a transition it already observed.
+        async with ZKEnsemble(2) as ens:
+            writer = await ZKClient([ens.addresses[0]]).connect()
+            # pinned to member 1 so the reconnect lands there again
+            reader = await ZKClient([ens.addresses[1]]).connect()
+            try:
+                await reader.create("/w", b"")
+                events = []
+                reader.watch("/w", events.append)
+                assert await reader.exists("/w", watch=True) is not None
+                ens.set_lag(1, 60_000)
+                await writer.create("/other", b"")  # freezes member 1
+                member = ens.servers[1]
+                assert member._lag_root is not None
+
+                await member.drop_connections()
+                for _ in range(100):  # reconnect + SetWatches re-arm
+                    try:
+                        if await reader.exists("/w") is not None:
+                            break
+                    except Exception:  # noqa: BLE001 - still reconnecting
+                        pass
+                    await asyncio.sleep(0.05)
+                assert all(
+                    path != "/w" for _, path, _ in member._lag_watches
+                ), "SetWatches re-arm must not enroll in lag reconciliation"
+                await reader.sync("/")
+                await asyncio.sleep(0.2)
+                assert events == []  # no phantom notification
+            finally:
+                await reader.close()
+                await writer.close()
+
     async def test_set_lag_zero_catches_up_immediately(self):
         async with ZKEnsemble(2) as ens:
             writer = await ZKClient([ens.addresses[0]]).connect()
