@@ -1454,6 +1454,20 @@ class ZKServer:
         if payload is None:
             return
         req = proto.ConnectRequest.read(Reader(payload))
+        # Real ZooKeeper refuses a session whose client has seen a newer
+        # zxid than this server ("Refusing session request as it has seen
+        # zxid ...") by closing the connection without a ConnectResponse;
+        # the client then tries another member.  Essential for lagging
+        # members: accepting such a client would rewind its last_zxid via
+        # our stale reply stamps and later re-deliver watch events it
+        # already observed.
+        view_zxid = self._lag_zxid if self._lag_root is not None else self.zxid
+        if req.last_zxid_seen > view_zxid:
+            log.warning(
+                "refusing session 0x%x: client has seen zxid 0x%x, ours is 0x%x",
+                req.session_id, req.last_zxid_seen, view_zxid,
+            )
+            return
         sess = self._establish_session(req)
         w = Writer()
         if sess is None:

@@ -18,6 +18,21 @@ one command instead of manual tree-walking::
     python -m registrar_tpu.tools.zkcli -s 127.0.0.1:2181 --auth digest:ops:pw \
         setacl /us/joyent/locked digest:ops:HASH:cdrwa world:anyone:r
 
+With no command, zkcli enters an interactive prompt running the same
+commands over ONE ZooKeeper session — the ``zkCli.sh -server`` workflow
+the reference's debugging notes teach (reference README.md:785-807)::
+
+    $ python -m registrar_tpu.tools.zkcli -s 127.0.0.1:2181
+    zkcli> ls /us/joyent/emy-10
+    zkcli> get /us/joyent/emy-10/authcache
+    zkcli> addauth digest:ops:pw
+    zkcli> quit
+
+Extra prompt-only commands: ``addauth SCHEME:CRED`` (authenticate the
+live session), ``help``, ``quit``/``exit``; ``#`` starts a comment.
+Because the session persists between commands, ``create -e`` ephemerals
+live until the prompt exits — handy for rehearsing registrar failover.
+
 Exit status: 0 on success, 1 on ZK errors (e.g. no such node), 2 on usage.
 """
 
@@ -30,6 +45,7 @@ import sys
 from typing import List, Tuple
 
 from registrar_tpu import binderview
+from registrar_tpu.retry import RetryPolicy
 from registrar_tpu.zk.client import ZKClient
 from registrar_tpu.zk.quota import (
     LIMITS_LEAF,
@@ -229,9 +245,11 @@ async def _cmd_create(zk: ZKClient, args) -> int:
         acls=args.acl if args.acl else None,
     )
     print(path)
-    if args.ephemeral:
-        # An ephemeral dies with this CLI's session the moment we exit —
-        # only useful for watching the effect from another session.
+    if args.ephemeral and not getattr(args, "repl", False):
+        # In one-shot mode an ephemeral dies with this CLI's session the
+        # moment we exit — only useful for watching the effect from
+        # another session.  At the interactive prompt the session (and
+        # so the node) lives until 'quit', so no warning there.
         print(
             "zkcli: note: ephemeral node is deleted when this command's "
             "session closes (now)",
@@ -501,7 +519,8 @@ async def _cmd_resolve(zk: ZKClient, args) -> int:
 def build_parser() -> argparse.ArgumentParser:
     parser = argparse.ArgumentParser(
         prog="zkcli",
-        description="inspect registrar service-discovery state in ZooKeeper",
+        description="inspect registrar service-discovery state in ZooKeeper"
+        " (no command: enter the interactive prompt over one session)",
     )
     parser.add_argument(
         "-s", "--servers", type=_parse_servers,
@@ -519,8 +538,14 @@ def build_parser() -> argparse.ArgumentParser:
         help="prefix every path with this znode (the connect-string "
         "\"host:port/app\" suffix of standard ZooKeeper clients)",
     )
-    sub = parser.add_subparsers(dest="command", required=True)
+    sub = parser.add_subparsers(dest="command")
+    _register_commands(sub)
+    return parser
 
+
+def _register_commands(sub) -> None:
+    """Attach every zkcli command to a subparsers object — shared between
+    the one-shot argv parser and the interactive prompt's line parser."""
     p = sub.add_parser("ls", help="list children of a znode")
     p.add_argument("path")
     p.set_defaults(fn=_cmd_ls)
@@ -662,7 +687,121 @@ def build_parser() -> argparse.ArgumentParser:
                    help="clear only the byte limit")
     p.set_defaults(fn=_cmd_delquota)
 
+
+def _repl_parser() -> argparse.ArgumentParser:
+    parser = argparse.ArgumentParser(
+        prog="",
+        description="zkcli interactive commands (plus: addauth "
+        "SCHEME:CRED, help, quit)",
+    )
+    sub = parser.add_subparsers(dest="command")
+    _register_commands(sub)
     return parser
+
+
+async def _repl(zk: ZKClient, args) -> int:
+    """Interactive prompt: every command runs over the ONE connected
+    session, like a ``zkCli.sh -server host:port`` session (the workflow
+    the reference's debugging notes teach, reference README.md:785-807).
+    One-shot invocations pay a fresh connect per command; here ephemeral
+    nodes created with ``create -e`` live exactly as long as the prompt.
+    """
+    import shlex
+    import signal
+
+    interactive = sys.stdin.isatty()
+    if interactive:
+        try:
+            # input() below is what readline hooks for editing/history
+            import readline  # noqa: F401
+        except ImportError:
+            pass
+        host, port = zk.connected_server or zk.servers[0]
+        print(
+            f"connected to {host}:{port} "
+            f"(session 0x{zk.session_id:x}); "
+            "'help' lists commands, 'quit' leaves"
+        )
+
+    def _read_line():
+        if interactive:
+            try:
+                return input("zkcli> ")
+            except EOFError:
+                return None
+        raw = sys.stdin.readline()
+        return raw.rstrip("\n") if raw else None
+
+    async def _run_cancellable(coro) -> None:
+        # ctrl-C aborts the running command (e.g. an open-ended `watch`)
+        # and returns to the prompt; the session — and any ephemerals the
+        # operator is rehearsing with — survives.  Matches zkCli.sh.
+        task = asyncio.ensure_future(coro)
+        loop = asyncio.get_running_loop()
+        try:
+            loop.add_signal_handler(signal.SIGINT, task.cancel)
+            installed = True
+        except (NotImplementedError, RuntimeError):
+            installed = False
+        try:
+            await task
+        except asyncio.CancelledError:
+            print("^C", file=sys.stderr)
+        finally:
+            if installed:
+                loop.remove_signal_handler(signal.SIGINT)
+
+    parser = _repl_parser()
+    loop = asyncio.get_running_loop()
+    while True:
+        line = await loop.run_in_executor(None, _read_line)
+        if line is None:
+            break  # EOF
+        line = line.strip()
+        if not line or line.startswith("#"):
+            continue
+        try:
+            words = shlex.split(line)
+        except ValueError as e:
+            print(f"zkcli: {e}", file=sys.stderr)
+            continue
+        if words[0] in ("quit", "exit"):
+            break
+        if words[0] == "help":
+            parser.print_help()
+            continue
+        if words[0] == "addauth":
+            # zkCli.sh's addauth: authenticate the LIVE session (the
+            # one-shot mode's --auth flag, but mid-session).
+            if len(words) != 2:
+                print("usage: addauth SCHEME:CRED (e.g. digest:user:pw)",
+                      file=sys.stderr)
+                continue
+            try:
+                scheme, cred = _parse_auth(words[1])
+                await zk.add_auth(scheme, cred)
+            except (ZKError, argparse.ArgumentTypeError) as e:
+                print(f"zkcli: {e}", file=sys.stderr)
+            continue
+        try:
+            cmd = parser.parse_args(words)
+        except SystemExit:
+            continue  # argparse reported usage; the prompt survives
+        if cmd.command is None:
+            continue
+        cmd.repl = True
+        try:
+            if getattr(cmd, "raw", False):
+                # admin words probe the servers over raw TCP
+                cmd.servers = args.servers
+                await _run_cancellable(cmd.fn(cmd))
+            else:
+                await _run_cancellable(cmd.fn(zk, cmd))
+        except ZKError as e:
+            print(f"zkcli: {e}", file=sys.stderr)
+        except ValueError as e:
+            print(f"zkcli: {e}", file=sys.stderr)
+    return 0
 
 
 async def _amain(argv=None) -> int:
@@ -672,8 +811,17 @@ async def _amain(argv=None) -> int:
         return await args.fn(args)
     try:
         # Argument validation (e.g. a malformed --chroot) must not be
-        # reported as a connectivity problem.
-        zk = ZKClient(args.servers, reconnect=False, chroot=args.chroot)
+        # reported as a connectivity problem.  One-shot commands never
+        # reconnect (fail fast); the interactive prompt must ride out
+        # transient blips mid-investigation, like zkCli.sh.
+        zk = ZKClient(
+            args.servers,
+            reconnect=args.command is None,
+            reconnect_policy=RetryPolicy(
+                max_attempts=float("inf"), initial_delay=0.5, max_delay=15
+            ),
+            chroot=args.chroot,
+        )
     except ValueError as e:
         print(f"zkcli: {e}", file=sys.stderr)
         return 2
@@ -685,6 +833,8 @@ async def _amain(argv=None) -> int:
     try:
         for scheme, cred in args.auth:
             await zk.add_auth(scheme, cred)
+        if args.command is None:
+            return await _repl(zk, args)
         return await args.fn(zk, args)
     except ZKError as e:
         print(f"zkcli: {e}", file=sys.stderr)

@@ -103,6 +103,10 @@ class ZKClient(EventEmitter):
         self.session_passwd = b"\x00" * 16
         self.negotiated_timeout_ms = timeout_ms
         self.last_zxid = 0
+        #: (host, port) the session is currently attached through (the
+        #: server list is shuffled on connect, so callers reporting "where
+        #: am I connected" must read this, not servers[0])
+        self.connected_server: Optional[Tuple[str, int]] = None
 
         self._reader: Optional[asyncio.StreamReader] = None
         self._writer: Optional[asyncio.StreamWriter] = None
@@ -197,6 +201,7 @@ class ZKClient(EventEmitter):
         self.session_id = resp.session_id
         self.session_passwd = resp.passwd
         self.negotiated_timeout_ms = resp.timeout_ms
+        self.connected_server = (host, port)
         self._reader = reader
         self._writer = writer
         self._connected = True

@@ -17,6 +17,8 @@ import socket
 import subprocess
 import sys
 
+import pytest
+
 from registrar_tpu.registration import register
 from registrar_tpu.testing.server import ZKEnsemble, ZKServer
 from registrar_tpu.zk.client import ZKClient
@@ -495,6 +497,53 @@ class TestReplicationLag:
                 assert events == []  # no phantom notification
             finally:
                 await reader.close()
+                await writer.close()
+
+    async def test_lagging_member_refuses_client_from_the_future(self):
+        # Real ZooKeeper refuses a session whose client has seen a newer
+        # zxid than the server (closing the connection with no
+        # ConnectResponse); otherwise the member's stale reply stamps
+        # would rewind the client's last_zxid and later reconnects would
+        # re-deliver watch events it already observed.
+        import struct
+
+        from registrar_tpu.zk.jute import Writer
+        from registrar_tpu.zk.protocol import ConnectRequest, frame
+
+        async with ZKEnsemble(2) as ens:
+            writer = await ZKClient([ens.addresses[0]]).connect()
+            try:
+                await writer.create("/f", b"v1")
+                ens.set_lag(1, 60_000)
+                await writer.put("/f", b"v2")  # freezes member 1 behind
+                live_zxid = writer.last_zxid
+
+                async def handshake(addr, last_seen):
+                    r, w = await asyncio.open_connection(*addr)
+                    try:
+                        req = ConnectRequest(
+                            timeout_ms=5000, last_zxid_seen=last_seen
+                        )
+                        jw = Writer()
+                        req.write(jw)
+                        w.write(frame(jw.to_bytes()))
+                        await w.drain()
+                        hdr = await r.readexactly(4)
+                        length = struct.unpack(">i", hdr)[0]
+                        return await r.readexactly(length)
+                    finally:
+                        w.close()
+
+                # The caught-up member accepts the client.
+                reply = await handshake(ens.addresses[0], live_zxid)
+                assert struct.unpack(">q", reply[8:16])[0] != 0  # session id
+                # The lagging member refuses it: EOF, no ConnectResponse.
+                with pytest.raises(asyncio.IncompleteReadError):
+                    await handshake(ens.addresses[1], live_zxid)
+                # ... but accepts a client at or behind its view.
+                reply = await handshake(ens.addresses[1], 0)
+                assert struct.unpack(">q", reply[8:16])[0] != 0
+            finally:
                 await writer.close()
 
     async def test_set_lag_zero_catches_up_immediately(self):

@@ -305,3 +305,181 @@ class TestZkCli:
         )
         assert proc.returncode == 1
         assert "cannot connect" in proc.stderr
+
+
+def _run_repl(server, script, *cli_args):
+    """Run zkcli with no subcommand (interactive prompt) feeding ``script``
+    lines on stdin — how the docs' debugging transcripts are driven."""
+    return subprocess.run(
+        [sys.executable, "-m", "registrar_tpu.tools.zkcli",
+         "-s", f"{server.host}:{server.port}", *cli_args],
+        input="".join(line + "\n" for line in script),
+        cwd=REPO, capture_output=True, text=True, timeout=30,
+        env={**os.environ, "PYTHONPATH": REPO},
+    )
+
+
+class TestZkCliRepl:
+    """The interactive prompt: one session, many commands — the
+    ``zkCli.sh -server`` operator workflow (reference README.md:785-807
+    runs its debugging transcript inside one interactive session)."""
+
+    async def test_session_persists_across_commands(self):
+        server = await ZKServer().start()
+        client = await _seed(server)
+        try:
+            out = await asyncio.to_thread(
+                _run_repl, server,
+                [
+                    "# rehearsing a registrar: ephemeral + read-back",
+                    "create -e /repl-host '{\"type\":\"host\"}'",
+                    "stat /repl-host",
+                    "get /repl-host",
+                    "ls /",
+                    "resolve cli.test.us",
+                    "quit",
+                ],
+            )
+            assert out.returncode == 0
+            assert "/repl-host" in out.stdout
+            assert '{"type":"host"}' in out.stdout
+            # the one-shot "deleted (now)" warning must NOT appear: the
+            # prompt's session outlives the command
+            assert "deleted when this command's session" not in out.stderr
+            # it really was ephemeral (non-zero owner in stat output)
+            owner_lines = [
+                ln for ln in out.stdout.splitlines()
+                if ln.startswith("ephemeralOwner = 0x")
+            ]
+            assert owner_lines and owner_lines[0] != "ephemeralOwner = 0x0"
+            assert "10.5.5.5" in out.stdout  # resolve worked in-session
+            # session closed on quit -> the ephemeral is gone
+            assert await ZKClient([server.address]).connect() is not None
+            probe = await ZKClient([server.address]).connect()
+            try:
+                assert await probe.exists("/repl-host") is None
+            finally:
+                await probe.close()
+        finally:
+            await client.close()
+            await server.stop()
+
+    async def test_errors_do_not_kill_the_prompt(self):
+        server = await ZKServer().start()
+        try:
+            out = await asyncio.to_thread(
+                _run_repl, server,
+                [
+                    "get /missing",        # ZK error
+                    "nosuchcommand /x",    # parse error
+                    "get --badflag",       # usage error
+                    "addauth malformed",   # bad credential shape
+                    "addauth",             # missing argument
+                    "",                    # blank line
+                    "create /still-alive ok",
+                    "get /still-alive",
+                    "exit",
+                ],
+            )
+            assert out.returncode == 0  # the prompt survived everything
+            assert "NO_NODE" in out.stderr
+            assert "invalid choice: 'nosuchcommand'" in out.stderr
+            assert "expected scheme:credential" in out.stderr
+            assert "usage: addauth" in out.stderr
+            assert "ok" in out.stdout.splitlines()
+        finally:
+            await server.stop()
+
+    async def test_admin_and_addauth_in_repl(self):
+        server = await ZKServer().start()
+        try:
+            out = await asyncio.to_thread(
+                _run_repl, server,
+                [
+                    "admin ruok",
+                    "addauth digest:ops:pw",
+                    "create /locked secret -a auth::cdrwa",
+                    "getacl /locked",
+                    "quit",
+                ],
+            )
+            assert out.returncode == 0
+            assert "imok" in out.stdout
+            assert "digest" in out.stdout  # ACL minted from the live auth
+        finally:
+            await server.stop()
+
+    async def test_eof_ends_the_prompt_cleanly(self):
+        server = await ZKServer().start()
+        try:
+            out = await asyncio.to_thread(
+                _run_repl, server, ["ls /"]  # no quit: stdin EOF ends it
+            )
+            assert out.returncode == 0
+            assert "zookeeper" in out.stdout
+        finally:
+            await server.stop()
+
+    async def test_prompt_rides_out_a_server_restart(self):
+        # The one-shot CLI fails fast (reconnect off); the prompt must
+        # reconnect through a ZooKeeper restart mid-investigation, like
+        # zkCli.sh.
+        server = await ZKServer().start()
+        port = server.port
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "registrar_tpu.tools.zkcli",
+             "-s", f"127.0.0.1:{port}"],
+            stdin=subprocess.PIPE, stdout=subprocess.PIPE,
+            stderr=subprocess.PIPE, text=True, cwd=REPO,
+            env={**os.environ, "PYTHONPATH": REPO},
+        )
+        try:
+            proc.stdin.write("create /survives v1\n")
+            proc.stdin.flush()
+            await asyncio.sleep(1.0)  # let it execute pre-restart
+
+            await server.stop()
+            server = await ZKServer(port=port, snapshot=server).start()
+            await asyncio.sleep(2.0)  # reconnect policy: 0.5 s first retry
+
+            proc.stdin.write("get /survives\nquit\n")
+            proc.stdin.flush()
+            # to_thread: blocking in the event loop would starve the
+            # in-process ZKServer the child is talking to
+            out, err = await asyncio.to_thread(proc.communicate, timeout=20)
+            assert proc.returncode == 0, err
+            assert "v1" in out.splitlines()  # read back through the SAME repl
+        finally:
+            if proc.poll() is None:
+                proc.kill()
+            await server.stop()
+
+    async def test_ctrl_c_aborts_watch_not_the_session(self):
+        # An open-ended `watch` at the prompt is interrupted by SIGINT
+        # and the prompt (and session) keeps going.
+        import signal
+
+        server = await ZKServer().start()
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "registrar_tpu.tools.zkcli",
+             "-s", f"{server.host}:{server.port}"],
+            stdin=subprocess.PIPE, stdout=subprocess.PIPE,
+            stderr=subprocess.PIPE, text=True, cwd=REPO,
+            env={**os.environ, "PYTHONPATH": REPO},
+        )
+        try:
+            proc.stdin.write("watch /\n")  # no --duration: runs until ^C
+            proc.stdin.flush()
+            await asyncio.sleep(1.5)  # the watch is now armed and waiting
+            proc.send_signal(signal.SIGINT)
+            await asyncio.sleep(0.5)
+            proc.stdin.write("ls /\nquit\n")
+            proc.stdin.flush()
+            out, err = await asyncio.to_thread(proc.communicate, timeout=20)
+            assert proc.returncode == 0, err
+            assert "^C" in err
+            assert "zookeeper" in out  # the prompt survived the interrupt
+        finally:
+            if proc.poll() is None:
+                proc.kill()
+            await server.stop()
