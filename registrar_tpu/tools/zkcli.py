@@ -706,7 +706,6 @@ async def _repl(zk: ZKClient, args) -> int:
     One-shot invocations pay a fresh connect per command; here ephemeral
     nodes created with ``create -e`` live exactly as long as the prompt.
     """
-    import shlex
     import signal
 
     interactive = sys.stdin.isatty()
@@ -732,27 +731,53 @@ async def _repl(zk: ZKClient, args) -> int:
         raw = sys.stdin.readline()
         return raw.rstrip("\n") if raw else None
 
+    loop = asyncio.get_running_loop()
+
+    def _install_sigint(handler) -> bool:
+        try:
+            loop.add_signal_handler(signal.SIGINT, handler)
+            return True
+        except (NotImplementedError, RuntimeError):
+            return False
+
+    def _sigint_at_prompt() -> None:
+        # ctrl-C at the idle prompt must NOT tear down the session (the
+        # ephemerals the operator is rehearsing with would vanish), and
+        # letting KeyboardInterrupt escape would also leave the executor
+        # thread blocked in input(), hanging interpreter shutdown until
+        # a stray Enter.  Consume it and point at the real exits.
+        print("^C (use 'quit' or ctrl-D to leave)", file=sys.stderr)
+
+    sigint_managed = _install_sigint(_sigint_at_prompt)
+
     async def _run_cancellable(coro) -> None:
         # ctrl-C aborts the running command (e.g. an open-ended `watch`)
         # and returns to the prompt; the session — and any ephemerals the
         # operator is rehearsing with — survives.  Matches zkCli.sh.
         task = asyncio.ensure_future(coro)
-        loop = asyncio.get_running_loop()
-        try:
-            loop.add_signal_handler(signal.SIGINT, task.cancel)
-            installed = True
-        except (NotImplementedError, RuntimeError):
-            installed = False
+        if sigint_managed:
+            _install_sigint(task.cancel)
         try:
             await task
         except asyncio.CancelledError:
             print("^C", file=sys.stderr)
         finally:
-            if installed:
-                loop.remove_signal_handler(signal.SIGINT)
+            if sigint_managed:
+                _install_sigint(_sigint_at_prompt)
 
     parser = _repl_parser()
-    loop = asyncio.get_running_loop()
+    try:
+        return await _repl_loop(
+            zk, args, parser, loop, _read_line, _run_cancellable
+        )
+    finally:
+        if sigint_managed:
+            loop.remove_signal_handler(signal.SIGINT)
+
+
+async def _repl_loop(zk, args, parser, loop, _read_line, _run_cancellable) -> int:
+    import shlex
+
     while True:
         line = await loop.run_in_executor(None, _read_line)
         if line is None:

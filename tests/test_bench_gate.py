@@ -1,0 +1,199 @@
+"""The bench regression gate: compare logic, retry merge, and exit wiring.
+
+Round-2 directive #7: bench.py must fail when a metric regresses >10%
+against the checked-in BENCH_BASELINE.json — so a slowdown is caught by
+CI/the driver instead of a judge eyeballing two JSONs.  The full bench
+is exercised by CI's bench step and the driver; these tests pin the
+gate's decision logic and the process exit code without paying for real
+benchmark runs.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import bench
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+BASELINE = {
+    "tolerance_pct": 10,
+    "metrics": {
+        "register_to_visible_ms": {"value": 1000, "direction": "lower"},
+        "pipeline_ms_no_settle": {"value": 1.0, "direction": "lower"},
+        "concurrent_registrations_per_s": {"value": 2000, "direction": "higher"},
+        "daemon_rss_mb": {"value": 30.0, "direction": "lower"},
+    },
+}
+
+
+def _result(value=1000.0, pipeline=1.0, conc=2000.0, rss=25.0):
+    return {
+        "metric": "register_to_visible_ms",
+        "value": value,
+        "unit": "ms",
+        "vs_baseline": 1.0,
+        "extra": {
+            "baseline": "prose, not a number",
+            "pipeline_ms_no_settle": pipeline,
+            "concurrent_registrations_per_s": conc,
+            "daemon_rss_mb": rss,
+        },
+    }
+
+
+class TestGateLogic:
+    def test_at_baseline_passes(self):
+        assert bench.gate(_result(), BASELINE, 10) == []
+
+    def test_within_tolerance_passes(self):
+        # ratio-symmetric: higher-is-better floor is 2000/1.1 = 1818.2
+        res = _result(value=1099.0, pipeline=1.09, conc=1850.0)
+        assert bench.gate(res, BASELINE, 10) == []
+
+    def test_lower_is_better_regression_fails(self):
+        res = _result(pipeline=1.11)  # 11% over
+        failures = bench.gate(res, BASELINE, 10)
+        assert len(failures) == 1
+        assert failures[0].startswith("pipeline_ms_no_settle:")
+
+    def test_higher_is_better_regression_fails(self):
+        res = _result(conc=1810.0)  # below 2000/1.1
+        failures = bench.gate(res, BASELINE, 10)
+        assert len(failures) == 1
+        assert failures[0].startswith("concurrent_registrations_per_s:")
+
+    def test_wide_tolerance_still_gates_throughput_collapse(self):
+        # At tolerance >= 100% a subtractive bound would pass ANY value;
+        # the ratio bound keeps gating: floor at 300% is 2000/4 = 500.
+        res = _result(conc=499.0)
+        failures = bench.gate(res, BASELINE, 300)
+        assert len(failures) == 1
+        assert failures[0].startswith("concurrent_registrations_per_s:")
+        assert bench.gate(_result(conc=501.0), BASELINE, 300) == []
+
+    def test_headline_metric_gated_too(self):
+        failures = bench.gate(_result(value=1101.0), BASELINE, 10)
+        assert len(failures) == 1
+        assert failures[0].startswith("register_to_visible_ms:")
+
+    def test_none_metric_skipped(self):
+        res = _result()
+        res["extra"]["daemon_rss_mb"] = None  # off-Linux
+        assert bench.gate(res, BASELINE, 10) == []
+
+    def test_missing_metric_is_a_regression(self):
+        res = _result()
+        del res["extra"]["pipeline_ms_no_settle"]
+        failures = bench.gate(res, BASELINE, 10)
+        assert failures == ["pipeline_ms_no_settle: missing from bench output"]
+
+    def test_env_tolerance_override(self, monkeypatch):
+        monkeypatch.setenv("BENCH_TOLERANCE_PCT", "50")
+        res = _result(pipeline=1.4)  # 40% over: fails at 10%, passes at 50%
+        assert bench.gate(res, BASELINE) == []
+        monkeypatch.setenv("BENCH_TOLERANCE_PCT", "10")
+        assert bench.gate(res, BASELINE) != []
+
+    def test_best_of_is_direction_aware(self):
+        a = _result(value=1200.0, pipeline=0.9, conc=1500.0)
+        b = _result(value=1000.0, pipeline=1.2, conc=2100.0)
+        best = bench.best_of(a, b, BASELINE)
+        assert best["register_to_visible_ms"] == 1000.0  # lower wins
+        assert best["pipeline_ms_no_settle"] == 0.9
+        assert best["concurrent_registrations_per_s"] == 2100.0  # higher wins
+
+    def test_checked_in_baseline_is_well_formed(self):
+        baseline = bench.load_baseline()
+        assert baseline is not None
+        assert baseline["tolerance_pct"] == 10
+        for name, spec in baseline["metrics"].items():
+            assert spec["direction"] in ("lower", "higher"), name
+            assert isinstance(spec["value"], (int, float)), name
+
+
+class TestGateExitWiring:
+    """The process-level contract: one JSON line on stdout; exit 1 plus a
+    stderr report on regression.  Uses a stubbed _bench so the test does
+    not pay for (or flake on) real benchmark runs."""
+
+    def _run(self, baseline: dict, fake_value: float):
+        stub = f"""
+import asyncio, json, sys
+sys.path.insert(0, {REPO!r})
+import bench
+
+async def fake_bench():
+    return {{
+        "metric": "register_to_visible_ms", "value": {fake_value},
+        "unit": "ms", "vs_baseline": 1.0,
+        "extra": {{"pipeline_ms_no_settle": 1.0,
+                   "concurrent_registrations_per_s": 2000.0,
+                   "daemon_rss_mb": 25.0}},
+    }}
+
+bench._bench = fake_bench
+sys.exit(bench.main())
+"""
+        import tempfile
+
+        with tempfile.TemporaryDirectory() as td:
+            bl_path = os.path.join(td, "baseline.json")
+            with open(bl_path, "w", encoding="utf-8") as f:
+                json.dump(baseline, f)
+            env = {**os.environ, "PYTHONPATH": REPO,
+                   "BENCH_BASELINE_PATH": bl_path, "BENCH_GATE": "1"}
+            # hermetic: an exported tolerance (e.g. from reproducing the
+            # CI bench step locally) must not flip these outcomes
+            env.pop("BENCH_TOLERANCE_PCT", None)
+            return subprocess.run(
+                [sys.executable, "-c", stub],
+                capture_output=True, text=True, timeout=60, cwd=REPO,
+                env=env,
+            )
+
+    def test_pass_exits_zero_with_one_json_line(self):
+        out = self._run(BASELINE, fake_value=1000.0)
+        assert out.returncode == 0, out.stderr
+        lines = out.stdout.strip().splitlines()
+        assert len(lines) == 1
+        assert json.loads(lines[0])["metric"] == "register_to_visible_ms"
+
+    def test_regression_exits_one_after_retry(self):
+        out = self._run(BASELINE, fake_value=1200.0)  # 20% over, both runs
+        assert out.returncode == 1
+        assert "retrying once" in out.stderr
+        assert "REGRESSION vs BENCH_BASELINE.json" in out.stderr
+        assert "register_to_visible_ms" in out.stderr
+        # the output contract holds even on failure: one JSON line
+        assert len(out.stdout.strip().splitlines()) == 1
+
+    def test_gate_disabled_by_env(self):
+        import tempfile
+
+        with tempfile.TemporaryDirectory() as td:
+            bl_path = os.path.join(td, "baseline.json")
+            with open(bl_path, "w", encoding="utf-8") as f:
+                json.dump(BASELINE, f)
+            stub = f"""
+import asyncio, json, sys
+sys.path.insert(0, {REPO!r})
+import bench
+
+async def fake_bench():
+    return {{"metric": "register_to_visible_ms", "value": 9999.0,
+             "unit": "ms", "vs_baseline": 1.0, "extra": {{}}}}
+
+bench._bench = fake_bench
+sys.exit(bench.main())
+"""
+            env = {**os.environ, "PYTHONPATH": REPO,
+                   "BENCH_BASELINE_PATH": bl_path, "BENCH_GATE": "0"}
+            env.pop("BENCH_TOLERANCE_PCT", None)
+            out = subprocess.run(
+                [sys.executable, "-c", stub],
+                capture_output=True, text=True, timeout=60, cwd=REPO,
+                env=env,
+            )
+            assert out.returncode == 0

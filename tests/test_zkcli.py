@@ -354,7 +354,6 @@ class TestZkCliRepl:
             assert owner_lines and owner_lines[0] != "ephemeralOwner = 0x0"
             assert "10.5.5.5" in out.stdout  # resolve worked in-session
             # session closed on quit -> the ephemeral is gone
-            assert await ZKClient([server.address]).connect() is not None
             probe = await ZKClient([server.address]).connect()
             try:
                 assert await probe.exists("/repl-host") is None
@@ -479,6 +478,44 @@ class TestZkCliRepl:
             assert proc.returncode == 0, err
             assert "^C" in err
             assert "zookeeper" in out  # the prompt survived the interrupt
+        finally:
+            if proc.poll() is None:
+                proc.kill()
+            await server.stop()
+
+    async def test_ctrl_c_at_idle_prompt_keeps_the_session(self):
+        # SIGINT while waiting for input must not tear the session down
+        # (nor hang shutdown on the blocked stdin read): the prompt
+        # consumes it and keeps serving commands.
+        import signal
+
+        server = await ZKServer().start()
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "registrar_tpu.tools.zkcli",
+             "-s", f"{server.host}:{server.port}"],
+            stdin=subprocess.PIPE, stdout=subprocess.PIPE,
+            stderr=subprocess.PIPE, text=True, cwd=REPO,
+            env={**os.environ, "PYTHONPATH": REPO},
+        )
+        try:
+            proc.stdin.write("create -e /idle-eph x\n")
+            proc.stdin.flush()
+            await asyncio.sleep(1.5)  # idle at the prompt now
+            proc.send_signal(signal.SIGINT)
+            await asyncio.sleep(0.3)
+            assert proc.poll() is None  # still running
+            # the session survived: its ephemeral is still there
+            probe = await ZKClient([server.address]).connect()
+            try:
+                assert await probe.exists("/idle-eph") is not None
+            finally:
+                await probe.close()
+            proc.stdin.write("stat /idle-eph\nquit\n")
+            proc.stdin.flush()
+            out, err = await asyncio.to_thread(proc.communicate, timeout=20)
+            assert proc.returncode == 0, err
+            assert "use 'quit' or ctrl-D" in err
+            assert "ephemeralOwner = 0x" in out
         finally:
             if proc.poll() is None:
                 proc.kill()
