@@ -320,6 +320,9 @@ class ZKServer:
         self._conns: Set[_Connection] = set()
         #: number of sessions expired by the sweeper (test observability)
         self.expired_count = 0
+        #: connections refused because the client had seen a newer zxid
+        #: than this member's view (test observability)
+        self.refused_count = 0
         #: soft-quota violations logged by this member (test observability)
         self.quota_warnings = 0
         #: request/reply counters surfaced via the 4lw admin commands
@@ -1463,6 +1466,7 @@ class ZKServer:
         # already observed.
         view_zxid = self._lag_zxid if self._lag_root is not None else self.zxid
         if req.last_zxid_seen > view_zxid:
+            self.refused_count += 1
             log.warning(
                 "refusing session 0x%x: client has seen zxid 0x%x, ours is 0x%x",
                 req.session_id, req.last_zxid_seen, view_zxid,

@@ -1,12 +1,14 @@
 """Chaos soak: seeded fault injection under register/unregister churn.
 
 The existing soak/ensemble tests only ever kill a member *between*
-operations.  Here a chaos task kills and restarts ensemble members and
-severs client connections at random moments — statistically landing
-inside the five-stage registration pipeline (cleanup → settle → mkdirp
-→ create → service put), exactly where orphan ephemerals or
-half-registrations would be minted — while N registrars churn
-register/heartbeat/unregister through it all.
+operations.  Here a chaos task kills and restarts ensemble members,
+severs client connections, and toggles per-member replication lag at
+random moments — statistically landing inside the five-stage
+registration pipeline (cleanup → settle → mkdirp → create → service
+put), exactly where orphan ephemerals or half-registrations would be
+minted — while N registrars churn register/heartbeat/unregister
+through it all (stale reads, ahead-of-view connection refusals, and
+catch-up all exercised under churn).
 
 Afterwards the system must converge:
 
@@ -156,21 +158,30 @@ async def _chaos_task(
         ]
         dead = [i for i in range(ENSEMBLE) if i not in live]
         roll = rng.random()
-        if roll < 0.35 and len(live) > 1:
+        if roll < 0.3 and len(live) > 1:
             i = rng.choice(live)
             await ens.kill(i)
             events.append(("kill", i))
-        elif roll < 0.65 and dead:
+        elif roll < 0.55 and dead:
             i = rng.choice(dead)
             await ens.restart(i)
             events.append(("restart", i))
+        elif roll < 0.75 and live:
+            # toggle replication lag: stale reads, refused reconnects
+            # from ahead-of-view clients, catch-up on writes — all under
+            # churn
+            i = rng.choice(live)
+            lagging = ens.servers[i].apply_delay_ms > 0
+            ens.set_lag(i, 0 if lagging else 150)
+            events.append(("lag-off" if lagging else "lag-on", i))
         elif live:
             i = rng.choice(live)
             await ens.servers[i].drop_connections()
             events.append(("drop", i))
-    # storm over: restore full strength
+    # storm over: restore full strength and linearizable reads
     for i in range(ENSEMBLE):
         await ens.restart(i)
+        ens.set_lag(i, 0)
 
 
 def _orphan_ephemerals(ens: ZKEnsemble) -> list:

@@ -22,7 +22,7 @@ import pytest
 from registrar_tpu.registration import register
 from registrar_tpu.testing.server import ZKEnsemble, ZKServer
 from registrar_tpu.zk.client import ZKClient
-from registrar_tpu.zk.protocol import CreateFlag
+from registrar_tpu.zk.protocol import CreateFlag, ZKError
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
@@ -545,6 +545,64 @@ class TestReplicationLag:
                 assert struct.unpack(">q", reply[8:16])[0] != 0
             finally:
                 await writer.close()
+
+    async def test_client_fails_over_past_a_refusing_lagging_member(self):
+        # A client ahead of a lagging member (it observed a commit
+        # through the fresh member) is refused by the laggard at connect
+        # and must transparently land on a member that can serve it —
+        # the reconnect loop absorbing the refusal is what makes the
+        # refusal guard deployable.
+        from registrar_tpu.retry import RetryPolicy
+
+        fast = RetryPolicy(
+            max_attempts=float("inf"), initial_delay=0.02, max_delay=0.2
+        )
+        async with ZKEnsemble(2) as ens:
+            client = ZKClient(ens.addresses, reconnect_policy=fast)
+            await client.connect()
+            try:
+                await client.create("/ff", b"v0")
+                ens.set_lag(1, 60_000)
+                # Each write bumps the live zxid; if we're on member 1
+                # the write catches it up, so the next write (via
+                # whichever member) still leaves client.last_zxid at the
+                # live zxid and member 1 frozen whenever we're on 0.
+                # A refusal needs (client on member 0 at drop) AND (the
+                # reconnect shuffle trying member 1 first) — roughly one
+                # cycle in four — so loop until one is observed, bounded
+                # at 60 cycles (P(none) < 1e-7) with a minimum of 5
+                # cycles of pure failover exercise.
+                cycle = 0
+                while cycle < 60 and (
+                    cycle < 5 or ens.servers[1].refused_count == 0
+                ):
+                    await client.put("/ff", f"v{cycle}".encode())
+                    holder = ens.servers[0] if any(
+                        c.session is not None
+                        and c.session.session_id == client.session_id
+                        for c in ens.servers[0]._conns
+                    ) else ens.servers[1]
+                    await holder.drop_connections()
+                    # Reconnect may try the laggard first (refused, EOF)
+                    # before landing somewhere serviceable; in-flight ops
+                    # fail fast with CONNECTION_LOSS while it settles, so
+                    # read like a real caller: retry the op.
+                    for _ in range(200):
+                        try:
+                            data, _ = await client.get("/ff")
+                            break
+                        except ZKError:
+                            await asyncio.sleep(0.02)
+                    else:
+                        raise AssertionError(
+                            f"cycle {cycle}: client never reconnected"
+                        )
+                    # wherever it landed, its view serves its zxid
+                    assert data == f"v{cycle}".encode()
+                    cycle += 1
+                assert ens.servers[1].refused_count >= 1
+            finally:
+                await client.close()
 
     async def test_set_lag_zero_catches_up_immediately(self):
         async with ZKEnsemble(2) as ens:
