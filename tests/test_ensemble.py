@@ -577,11 +577,9 @@ class TestReplicationLag:
                     cycle < 5 or ens.servers[1].refused_count == 0
                 ):
                     await client.put("/ff", f"v{cycle}".encode())
-                    holder = ens.servers[0] if any(
-                        c.session is not None
-                        and c.session.session_id == client.session_id
-                        for c in ens.servers[0]._conns
-                    ) else ens.servers[1]
+                    holder = ens.servers[
+                        member_holding(ens, client.session_id)
+                    ]
                     await holder.drop_connections()
                     # Reconnect may try the laggard first (refused, EOF)
                     # before landing somewhere serviceable; in-flight ops
