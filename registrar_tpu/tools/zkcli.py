@@ -563,7 +563,8 @@ def _register_commands(sub) -> None:
     p.add_argument("path", nargs="?", default="/")
     p.set_defaults(fn=_cmd_tree)
 
-    p = sub.add_parser("rm", help="delete a znode")
+    # aliases = zkCli.sh's names, so operator muscle memory carries over
+    p = sub.add_parser("rm", aliases=["delete"], help="delete a znode")
     p.add_argument("path")
     p.add_argument(
         "--version", type=int, default=-1,
@@ -572,7 +573,10 @@ def _register_commands(sub) -> None:
     )
     p.set_defaults(fn=_cmd_rm)
 
-    p = sub.add_parser("rmr", help="delete a znode subtree, children first")
+    p = sub.add_parser(
+        "rmr", aliases=["deleteall"],
+        help="delete a znode subtree, children first",
+    )
     p.add_argument("path")
     p.set_defaults(fn=_cmd_rmr)
 
@@ -634,12 +638,15 @@ def _register_commands(sub) -> None:
     p.add_argument("path", nargs="?", default="/")
     p.set_defaults(fn=_cmd_sync)
 
-    p = sub.add_parser("getacl", help="print a znode's ACL list")
+    p = sub.add_parser(
+        "getacl", aliases=["getAcl"], help="print a znode's ACL list"
+    )
     p.add_argument("path")
     p.set_defaults(fn=_cmd_getacl)
 
     p = sub.add_parser(
-        "setacl", help="replace a znode's ACL list (requires ADMIN)"
+        "setacl", aliases=["setAcl"],
+        help="replace a znode's ACL list (requires ADMIN)",
     )
     p.add_argument("path")
     p.add_argument(

@@ -408,6 +408,35 @@ class TestZkCliRepl:
         finally:
             await server.stop()
 
+    async def test_zkcli_sh_command_aliases(self):
+        # zkCli.sh operator muscle memory: delete/deleteall/getAcl/setAcl
+        # work as aliases (reference README.md:787-789 tells operators to
+        # use zkCli.sh; same verbs must land here).
+        server = await ZKServer().start()
+        try:
+            out = await asyncio.to_thread(
+                _run_repl, server,
+                [
+                    "create /alias v",
+                    "getAcl /alias",
+                    "setAcl /alias world:anyone:r",
+                    "delete /alias",
+                    "create /sub/a b",  # fails: no parent - prompt survives
+                    "mkdirp /sub",
+                    "create /sub/a b",
+                    "deleteall /sub",
+                    "ls /",
+                    "quit",
+                ],
+            )
+            assert out.returncode == 0
+            assert "'world,'anyone" in out.stdout
+            assert "deleted 2 node(s)" in out.stdout
+            # nothing left but the system node
+            assert out.stdout.splitlines()[-1] == "zookeeper"
+        finally:
+            await server.stop()
+
     async def test_eof_ends_the_prompt_cleanly(self):
         server = await ZKServer().start()
         try:
