@@ -459,18 +459,25 @@ class TestZkCliRepl:
              "-s", f"127.0.0.1:{port}"],
             stdin=subprocess.PIPE, stdout=subprocess.PIPE,
             stderr=subprocess.PIPE, text=True, cwd=REPO,
-            env={**os.environ, "PYTHONPATH": REPO},
+            # unbuffered: the test reads stdout markers line by line
+            env={**os.environ, "PYTHONPATH": REPO, "PYTHONUNBUFFERED": "1"},
         )
         try:
             proc.stdin.write("create /survives v1\n")
             proc.stdin.flush()
-            await asyncio.sleep(1.0)  # let it execute pre-restart
+            # wait for the command's output, not a guessed sleep
+            line = await asyncio.wait_for(
+                asyncio.to_thread(proc.stdout.readline), timeout=30
+            )
+            assert line.strip() == "/survives"
 
             await server.stop()
             server = await ZKServer(port=port, snapshot=server).start()
-            await asyncio.sleep(2.0)  # reconnect policy: 0.5 s first retry
+            await asyncio.sleep(1.0)  # reconnect policy: 0.5 s first retry
 
-            proc.stdin.write("get /survives\nquit\n")
+            # several attempts: reads fail fast with CONNECTION_LOSS
+            # until the reconnect lands, then serve normally
+            proc.stdin.write("get /survives\n" * 5 + "quit\n")
             proc.stdin.flush()
             # to_thread: blocking in the event loop would starve the
             # in-process ZKServer the child is talking to
@@ -493,12 +500,18 @@ class TestZkCliRepl:
              "-s", f"{server.host}:{server.port}"],
             stdin=subprocess.PIPE, stdout=subprocess.PIPE,
             stderr=subprocess.PIPE, text=True, cwd=REPO,
-            env={**os.environ, "PYTHONPATH": REPO},
+            # unbuffered: the test reads output markers line by line
+            env={**os.environ, "PYTHONPATH": REPO, "PYTHONUNBUFFERED": "1"},
         )
         try:
             proc.stdin.write("watch /\n")  # no --duration: runs until ^C
             proc.stdin.flush()
-            await asyncio.sleep(1.5)  # the watch is now armed and waiting
+            # SIGINT only after the watch announces itself — a fixed sleep
+            # could fire before the REPL's handler is even installed
+            line = await asyncio.wait_for(
+                asyncio.to_thread(proc.stderr.readline), timeout=30
+            )
+            assert "watching /" in line
             proc.send_signal(signal.SIGINT)
             await asyncio.sleep(0.5)
             proc.stdin.write("ls /\nquit\n")
@@ -524,12 +537,18 @@ class TestZkCliRepl:
              "-s", f"{server.host}:{server.port}"],
             stdin=subprocess.PIPE, stdout=subprocess.PIPE,
             stderr=subprocess.PIPE, text=True, cwd=REPO,
-            env={**os.environ, "PYTHONPATH": REPO},
+            # unbuffered: the test reads output markers line by line
+            env={**os.environ, "PYTHONPATH": REPO, "PYTHONUNBUFFERED": "1"},
         )
         try:
             proc.stdin.write("create -e /idle-eph x\n")
             proc.stdin.flush()
-            await asyncio.sleep(1.5)  # idle at the prompt now
+            # wait for the create to echo: the REPL is provably up and
+            # back at the prompt before we interrupt it
+            line = await asyncio.wait_for(
+                asyncio.to_thread(proc.stdout.readline), timeout=30
+            )
+            assert line.strip() == "/idle-eph"
             proc.send_signal(signal.SIGINT)
             await asyncio.sleep(0.3)
             assert proc.poll() is None  # still running
