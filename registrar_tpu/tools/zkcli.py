@@ -762,8 +762,19 @@ async def _repl(zk: ZKClient, args) -> int:
         # and returns to the prompt; the session — and any ephemerals the
         # operator is rehearsing with — survives.  Matches zkCli.sh.
         task = asyncio.ensure_future(coro)
+
+        def _sigint_during_command() -> None:
+            # A SIGINT can land in the gap after the command finishes but
+            # before the prompt handler is reinstalled below; cancelling
+            # a done task is a silent no-op, so treat that case as a
+            # prompt-level interrupt instead of swallowing it.
+            if task.done():
+                _sigint_at_prompt()
+            else:
+                task.cancel()
+
         if sigint_managed:
-            _install_sigint(task.cancel)
+            _install_sigint(_sigint_during_command)
         try:
             await task
         except asyncio.CancelledError:

@@ -473,11 +473,14 @@ class TestZkCliRepl:
 
             await server.stop()
             server = await ZKServer(port=port, snapshot=server).start()
-            await asyncio.sleep(1.0)  # reconnect policy: 0.5 s first retry
+            # cover the 0.5 s and 1.5 s reconnect retries before reading;
+            # stdin lines are consumed immediately, so the margin must be
+            # here, not in extra commands
+            await asyncio.sleep(2.0)
 
-            # several attempts: reads fail fast with CONNECTION_LOSS
-            # until the reconnect lands, then serve normally
-            proc.stdin.write("get /survives\n" * 5 + "quit\n")
+            # a few attempts in case the reconnect still races: failed
+            # reads fail fast with CONNECTION_LOSS, a landed one prints v1
+            proc.stdin.write("get /survives\n" * 3 + "quit\n")
             proc.stdin.flush()
             # to_thread: blocking in the event loop would starve the
             # in-process ZKServer the child is talking to
