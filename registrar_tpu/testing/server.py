@@ -1932,10 +1932,34 @@ async def _amain(argv=None) -> None:
         "consecutive ports starting at --port (models the 3-5 member "
         "production deployments clients are pointed at)",
     )
+    parser.add_argument(
+        "--lag", action="append", default=[], metavar="MEMBER:MS",
+        help="make ensemble member MEMBER (0-based) a lagging follower "
+        "with an MS-millisecond apply delay (repeatable; requires "
+        "--ensemble > 1).  Reads through that member return stale data "
+        "until a client issues sync() on it — rehearses ZKClient.sync's "
+        "read barrier from the command line",
+    )
     args = parser.parse_args(argv)
     logging.basicConfig(level=logging.DEBUG)
     if args.ensemble > 1 and args.snapshot_file:
         parser.error("--snapshot-file is standalone-only (use --ensemble 1)")
+    if args.lag and args.ensemble <= 1:
+        parser.error("--lag requires --ensemble > 1")
+    lags = []
+    for spec in args.lag:
+        member_s, _, ms_s = spec.partition(":")
+        try:
+            member, ms = int(member_s), int(ms_s)
+        except ValueError:
+            parser.error(f"--lag expects MEMBER:MS (e.g. 1:60000), got {spec!r}")
+        if not 0 <= member < args.ensemble:
+            parser.error(
+                f"--lag member {member} out of range for --ensemble {args.ensemble}"
+            )
+        if ms <= 0:
+            parser.error("--lag MS must be positive")
+        lags.append((member, ms))
 
     stopping = asyncio.Event()
     loop = asyncio.get_running_loop()
@@ -1953,6 +1977,9 @@ async def _amain(argv=None) -> None:
             max_session_timeout_ms=args.max_session_timeout,
         )
         await ens.start()
+        for member, ms in lags:
+            ens.set_lag(member, ms)
+            print(f"member {member} lagging (apply delay {ms} ms)", flush=True)
         hosts = ",".join(f"{h}:{p}" for h, p in ens.addresses)
         print(f"zk test ensemble listening on {hosts}", flush=True)
         try:

@@ -528,9 +528,14 @@ class TestReplicationLag:
                         req.write(jw)
                         w.write(frame(jw.to_bytes()))
                         await w.drain()
-                        hdr = await r.readexactly(4)
+                        # bounded: a refusal closes the conn (EOF ->
+                        # IncompleteReadError); never block the suite on
+                        # a reply that may not come
+                        hdr = await asyncio.wait_for(r.readexactly(4), 10)
                         length = struct.unpack(">i", hdr)[0]
-                        return await r.readexactly(length)
+                        return await asyncio.wait_for(
+                            r.readexactly(length), 10
+                        )
                     finally:
                         w.close()
 

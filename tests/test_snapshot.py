@@ -130,25 +130,39 @@ class TestSnapshotRoundTrip:
             await restored.stop()
 
 
+async def _spawn_server_cli(*cli_args):
+    """Start the server CLI and parse its "... listening on host:port[,...]"
+    banner.  Returns (proc, addrs, banner_lines) — banner_lines holds
+    everything printed before the listening line."""
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "registrar_tpu.testing.server",
+         "--port", "0", *cli_args],
+        cwd=REPO, stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
+        text=True, env={**os.environ, "PYTHONPATH": REPO},
+    )
+    loop = asyncio.get_running_loop()
+    banner = []
+    while True:
+        line = await loop.run_in_executor(None, proc.stdout.readline)
+        assert line, "server exited before listening"
+        if "listening on" in line:
+            addrs = [
+                (h, int(p))
+                for h, p in (
+                    hp.rsplit(":", 1) for hp in line.split()[-1].split(",")
+                )
+            ]
+            return proc, addrs, banner
+        banner.append(line)
+
+
 class TestSnapshotCli:
     async def test_standalone_server_persists_across_restart(self, tmp_path):
         snap = str(tmp_path / "cli.snap")
 
         async def start_server():
-            proc = subprocess.Popen(
-                [sys.executable, "-m", "registrar_tpu.testing.server",
-                 "--port", "0", "--snapshot-file", snap],
-                cwd=REPO, stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
-                text=True, env={**os.environ, "PYTHONPATH": REPO},
-            )
-            # Parse "zk test server listening on host:port" from stdout.
-            loop = asyncio.get_running_loop()
-            while True:
-                line = await loop.run_in_executor(None, proc.stdout.readline)
-                assert line, "server exited before listening"
-                if "listening on" in line:
-                    port = int(line.rsplit(":", 1)[1])
-                    return proc, port
+            proc, addrs, _ = await _spawn_server_cli("--snapshot-file", snap)
+            return proc, addrs[0][1]
 
         proc, port = await start_server()
         try:
@@ -169,3 +183,41 @@ class TestSnapshotCli:
         finally:
             proc.send_signal(signal.SIGTERM)
             proc.wait(timeout=15)
+
+    async def test_ensemble_cli_lag_flag(self):
+        # `--ensemble 2 --lag 1:60000`: member 1 serves stale reads until
+        # a client sync()s through it — the CLI form of ZKEnsemble.set_lag
+        # for rehearsing the read barrier by hand.
+        proc, addrs, banner = await _spawn_server_cli(
+            "--ensemble", "2", "--lag", "1:60000"
+        )
+        try:
+            assert any("member 1 lagging" in line for line in banner)
+            w = await ZKClient([addrs[0]]).connect()
+            r = await ZKClient([addrs[1]]).connect()
+            try:
+                await w.create("/cli-lag", b"old")
+                await r.sync("/")  # catch member 1 up to the create
+                await w.put("/cli-lag", b"new")  # freezes member 1
+                assert (await r.get("/cli-lag"))[0] == b"old"
+                await r.sync("/")
+                assert (await r.get("/cli-lag"))[0] == b"new"
+            finally:
+                await r.close()
+                await w.close()
+        finally:
+            proc.send_signal(signal.SIGTERM)
+            proc.wait(timeout=15)
+
+    async def test_lag_flag_rejected_without_ensemble(self):
+        # Any member index gets the same clear message (the ensemble
+        # check is hoisted above the per-spec range check).
+        for spec in ("0:100", "1:100"):
+            out = subprocess.run(
+                [sys.executable, "-m", "registrar_tpu.testing.server",
+                 "--lag", spec],
+                cwd=REPO, capture_output=True, text=True, timeout=30,
+                env={**os.environ, "PYTHONPATH": REPO},
+            )
+            assert out.returncode == 2
+            assert "--lag requires --ensemble" in out.stderr
