@@ -591,9 +591,11 @@ class ZKServer:
     # -- 4-letter-word admin commands ---------------------------------------
 
     def _count_nodes(self) -> Tuple[int, int]:
-        """(znode count, approximate data size) over the whole tree."""
+        """(znode count, approximate data size) over this member's read
+        view — a lagging follower reports what it has applied, like real
+        ZooKeeper's stats."""
         count, size = 0, 0
-        stack = [self.root]
+        stack = [self._lag_root if self._lag_root is not None else self.root]
         while stack:
             node = stack.pop()
             count += 1
@@ -636,7 +638,10 @@ class ZKServer:
                 f"Sent: {self.packets_sent}",
                 f"Connections: {len(self._conns)}",
                 "Outstanding: 0",
-                f"Zxid: 0x{self.zxid:x}",
+                # a lagging follower reports the zxid it has applied up
+                # to (real ZK's lastProcessedZxid), so `admin srvr`
+                # against each member makes replication lag visible
+                f"Zxid: 0x{self._view_zxid():x}",
                 f"Mode: {self.mode}",
                 f"Node count: {nodes}",
             ]
@@ -914,6 +919,11 @@ class ZKServer:
         self.zxid += 1
         self._state.last_commit = time.monotonic()
         return self.zxid
+
+    def _view_zxid(self) -> int:
+        """The zxid this member's read view corresponds to — the frozen
+        pre-commit zxid while lagging, else the replicated zxid."""
+        return self._lag_zxid if self._lag_root is not None else self.zxid
 
     def _catch_up(self) -> None:
         """Apply the replicated state up to now: drop the stale read view.
@@ -1464,7 +1474,7 @@ class ZKServer:
         # members: accepting such a client would rewind its last_zxid via
         # our stale reply stamps and later re-deliver watch events it
         # already observed.
-        view_zxid = self._lag_zxid if self._lag_root is not None else self.zxid
+        view_zxid = self._view_zxid()
         if req.last_zxid_seen > view_zxid:
             self.refused_count += 1
             log.warning(
@@ -1756,8 +1766,7 @@ class ZKServer:
         # lastProcessedZxid).  Stamping the live shared zxid would make a
         # client's last_zxid overstate what it observed, suppressing the
         # SetWatches reconciliation it is owed after a reconnect.
-        zxid = self._lag_zxid if self._lag_root is not None else self.zxid
-        return proto.encode_reply_payload(xid, zxid, err, body)
+        return proto.encode_reply_payload(xid, self._view_zxid(), err, body)
 
 
 class ZKEnsemble:

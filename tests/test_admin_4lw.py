@@ -143,6 +143,49 @@ class TestFourLetterWords:
             assert "maxSessionTimeout=" in conf
             assert "tickTime=" in conf
 
+    async def test_srvr_zxid_exposes_replication_lag(self):
+        # Real followers report their own lastProcessedZxid: `admin srvr`
+        # against each member is how an operator SEES a lagging follower
+        # (docs/OPERATIONS.md) — the zxid must come from the member's
+        # read view, and the node count from its applied tree.
+        from registrar_tpu.testing.server import ZKEnsemble
+
+        def zxid_of(srvr_text: str) -> int:
+            line = next(
+                ln for ln in srvr_text.splitlines() if ln.startswith("Zxid:")
+            )
+            return int(line.split()[1], 16)
+
+        async with ZKEnsemble(2) as ens:
+            writer = await ZKClient([ens.addresses[0]]).connect()
+            try:
+                await writer.create("/lagstat", b"")
+                ens.set_lag(1, 60_000)
+                await writer.create("/lagstat/extra", b"")  # freezes member 1
+                fresh = await _probe(ens.servers[0], "srvr")
+                stale = await _probe(ens.servers[1], "srvr")
+                assert zxid_of(fresh) > zxid_of(stale)
+                # the laggard's node count is its applied view's
+                fresh_nodes = next(
+                    ln for ln in fresh.splitlines()
+                    if ln.startswith("Node count:")
+                )
+                stale_nodes = next(
+                    ln for ln in stale.splitlines()
+                    if ln.startswith("Node count:")
+                )
+                assert fresh_nodes != stale_nodes
+                # sync through the laggard catches it up; srvr agrees
+                reader = await ZKClient([ens.addresses[1]]).connect()
+                try:
+                    await reader.sync("/")
+                finally:
+                    await reader.close()
+                caught_up = await _probe(ens.servers[1], "srvr")
+                assert zxid_of(caught_up) == zxid_of(fresh)
+            finally:
+                await writer.close()
+
     async def test_admin_probe_does_not_disturb_sessions(self):
         # A 4lw probe is a throwaway connection: existing ZK sessions and
         # the protocol path must be unaffected.
