@@ -41,7 +41,7 @@ from registrar_tpu.registration import register, unregister
 from registrar_tpu.retry import RetryPolicy
 from registrar_tpu.testing.server import ZKEnsemble
 from registrar_tpu.zk.client import SessionExpiredError, ZKClient
-from registrar_tpu.zk.protocol import ZKError
+from registrar_tpu.zk.protocol import CreateFlag, ZKError
 
 DOMAIN = "chaos.prod.us"
 PATH = "/us/prod/chaos"
@@ -124,6 +124,16 @@ class _Worker:
                 # interrupted mid-pipeline; state unknown — the next
                 # register()'s cleanup stage reconciles it
                 self.nodes = None
+                if self.client.closed:
+                    # a force-expired session surfaces as CONNECTION_LOSS
+                    # on ops (SessionExpiredError is only raised from the
+                    # connect path) and self-closes the client: build a
+                    # fresh session NOW so recovery happens under churn,
+                    # not just in the post-storm converge pass
+                    try:
+                        await self.connect()
+                    except Exception:  # noqa: BLE001 - all members down
+                        pass  # retried next iteration
             await asyncio.sleep(self.rng.uniform(0.0, 0.02))
 
     async def converge(self) -> None:
@@ -166,7 +176,7 @@ async def _chaos_task(
             i = rng.choice(dead)
             await ens.restart(i)
             events.append(("restart", i))
-        elif roll < 0.75 and live:
+        elif roll < 0.7 and live:
             # toggle replication lag: stale reads, refused reconnects
             # from ahead-of-view clients, catch-up on writes — all under
             # churn
@@ -174,6 +184,24 @@ async def _chaos_task(
             lagging = ens.servers[i].apply_delay_ms > 0
             ens.set_lag(i, 0 if lagging else 150)
             events.append(("lag-off" if lagging else "lag-on", i))
+        elif roll < 0.85 and live:
+            # force-expire a random session (ZK's worst news for a
+            # registrar): its ephemerals must be swept, the worker must
+            # build a fresh session and re-register.  This is the path
+            # that mints orphans if ephemeral sweeping ever breaks —
+            # without it the storm is too short for natural expiry and
+            # the orphan detector guards nothing (verified by mutation).
+            sids = sorted(
+                s.session_id
+                for s in ens.state.sessions.values()
+                if s.connected
+            )
+            if sids:
+                # record the index, not the (time-seeded) session id, so
+                # fixed-seed schedules compare equal across runs
+                idx = rng.randrange(len(sids))
+                await ens.servers[live[0]].expire_session(sids[idx])
+                events.append(("expire", idx))
         elif live:
             i = rng.choice(live)
             await ens.servers[i].drop_connections()
@@ -214,6 +242,18 @@ async def test_chaos_churn_converges():
         for w in workers:
             await w.connect()
 
+        # A victim ephemeral at a path NO worker ever re-registers: the
+        # workers' own cleanup stage recycles their leaked paths, so
+        # this is the node that stays orphaned if ephemeral sweeping on
+        # session expiry ever breaks (the orphan detector's real teeth —
+        # the mutation probe that leaks ephemerals passes without it).
+        # Set up before the storm starts: its connect/create must not
+        # race the first fault.
+        victim = ZKClient(ens.addresses, timeout_ms=8000,
+                          reconnect_policy=FAST_RECONNECT)
+        await victim.connect()
+        await victim.create("/chaos-victim", b"", CreateFlag.EPHEMERAL)
+
         stop = asyncio.Event()
         events: list = []
         tasks = [asyncio.create_task(w.churn(stop)) for w in workers]
@@ -226,6 +266,14 @@ async def test_chaos_churn_converges():
         assert events, "chaos task injected no faults"
         total_ops = sum(w.ops for w in workers)
         assert total_ops >= N_WORKERS, f"churn barely ran ({total_ops} ops)"
+
+        # The victim's session dies with the storm: its ephemeral must be
+        # swept, not orphaned (read via the shared tree — workers may
+        # still be mid-recovery here).
+        await ens.live[0].expire_session(victim.session_id)
+        assert ens.get_node("/chaos-victim") is None, (
+            "victim ephemeral survived its session's expiry"
+        )
 
         # -- convergence ---------------------------------------------------
         await asyncio.gather(*(w.converge() for w in workers))
@@ -271,6 +319,8 @@ async def test_chaos_churn_converges():
             orphans = _orphan_ephemerals(ens)
             assert not orphans, f"orphans after teardown: {orphans}"
         finally:
+            if not victim.closed:
+                await victim.close()
             for w in workers:
                 if w.client is not None and not w.client.closed:
                     await w.client.close()
