@@ -5,7 +5,10 @@ Rebuild of the reference's default export ``register_plus``
 lifecycle event surface:
 
     register(znodes)           registration (or re-registration) completed
-    unregister(err, znodes)    health check declared down; znodes deleted
+    unregister(err, znodes)    health check declared down; znodes holds what
+                               was actually deleted (a shared service node
+                               with sibling hosts under it stays, and is
+                               not listed)
     heartbeat(znodes)          periodic znode liveness probe succeeded
     heartbeatFailure(err)      probe failed after bounded retries
     ok()                       health check recovered (was down)
@@ -87,6 +90,19 @@ class RegistrarEvents(EventEmitter):
             task.cancel()
         self._tasks.clear()
 
+    def _track(self, task) -> None:
+        """Track a task for stop(); finished tasks drop out so a daemon
+        with a flapping health check doesn't accumulate them forever."""
+        self._tasks.append(task)
+
+        def _prune(t) -> None:
+            try:
+                self._tasks.remove(t)
+            except ValueError:
+                pass  # stop() already cleared the list
+
+        task.add_done_callback(_prune)
+
     @property
     def stopped(self) -> bool:
         return self._stopped
@@ -116,11 +132,11 @@ def register_plus(
     """
     ee = RegistrarEvents()
     loop = asyncio.get_running_loop()
-    ee._tasks.append(loop.create_task(_run(ee, zk, registration, admin_ip,
-                                           health_check, heartbeat_interval,
-                                           hostname, settle_delay,
-                                           heartbeat_retry,
-                                           repair_heartbeat_miss)))
+    ee._track(loop.create_task(_run(ee, zk, registration, admin_ip,
+                                    health_check, heartbeat_interval,
+                                    hostname, settle_delay,
+                                    heartbeat_retry,
+                                    repair_heartbeat_miss)))
     return ee
 
 
@@ -157,7 +173,7 @@ async def _run(
         return
 
     loop = asyncio.get_running_loop()
-    ee._tasks.append(loop.create_task(
+    ee._track(loop.create_task(
         _heartbeat_loop(
             ee, zk, heartbeat_interval, heartbeat_retry,
             do_register if repair_heartbeat_miss else None,
@@ -253,12 +269,12 @@ def _start_health_consumer(
             log.debug("healthcheck failed, deregistering (znodes=%s)", ee.znodes)
             ee.emit("fail", err)
             try:
-                await register_mod.unregister(zk, ee.znodes)
+                deleted = await register_mod.unregister(zk, ee.znodes)
             except Exception as u_err:  # noqa: BLE001
                 log.debug("healthcheck: unregister failed: %r", u_err)
                 ee.emit("error", u_err)
             else:
-                ee.emit("unregister", err, ee.znodes)
+                ee.emit("unregister", err, deleted)
         finally:
             transitioning = False
 
@@ -287,7 +303,7 @@ def _start_health_consumer(
         rtype = record.get("type")
         if rtype == "ok":
             if ee.down:
-                ee._tasks.append(
+                ee._track(
                     asyncio.get_running_loop().create_task(on_recover())
                 )
         elif rtype == "fail":
@@ -296,7 +312,7 @@ def _start_health_consumer(
                 and record.get("isDown")
                 and not ee.down
             ):
-                ee._tasks.append(
+                ee._track(
                     asyncio.get_running_loop().create_task(on_fail(record["err"]))
                 )
         else:

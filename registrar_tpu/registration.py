@@ -41,7 +41,7 @@ from registrar_tpu.records import (
     payload_bytes,
     service_record,
 )
-from registrar_tpu.zk.client import Op, ZKClient
+from registrar_tpu.zk.client import MultiError, Op, ZKClient
 from registrar_tpu.zk.protocol import Err, ZKError
 
 log = logging.getLogger("registrar_tpu.registration")
@@ -160,27 +160,72 @@ async def register(
 
 async def unregister(
     zk: ZKClient, znodes: Sequence[str], atomic: bool = False
-) -> None:
+) -> List[str]:
     """Delete the owned znodes, sequentially (reference lib/register.js:254-295).
+
+    Returns the nodes actually deleted — callers reporting the outcome
+    (e.g. the agent's ``unregister`` event) must not claim a shared
+    service node that was left in place.
 
     Every node is processed before this returns (the reference fires its
     callback after the first delete — fixed, see module docstring).  The
     first error aborts the walk and propagates, matching the reference's
-    forEachPipeline semantics.
+    forEachPipeline semantics — with one deliberate exception: a node that
+    fails with NOT_EMPTY is left in place and the walk continues.  The
+    owned-node list includes the *persistent* service record at the domain
+    node (``register`` appends it, like the reference's registerService);
+    in a multi-instance domain — the normal production shape — sibling
+    hosts' ephemerals still live under it, so it must survive this host's
+    deregistration.  The znode outcome is identical to the reference's
+    (ZooKeeper refuses the delete either way; the reference's early-callback
+    bug merely hid the error), but here "shared node still in use" is
+    success, not failure, so health-driven deregistration in a fleet emits
+    ``unregister`` instead of ``error``.
 
     ``atomic=True`` (beyond the reference's surface) instead deletes all
     nodes in one ZooKeeper multi transaction: observers never see a
-    half-deregistered host.  Default stays off — the sequential walk is the
-    reference's observable behavior.
+    half-deregistered host.  NOT_EMPTY gets the same treatment — the
+    transaction is retried without the still-shared nodes (each retry drops
+    at least one, so the loop terminates).  Default stays off — the
+    sequential walk is the reference's observable behavior.
     """
     if not isinstance(znodes, Sequence) or isinstance(znodes, (str, bytes)):
         raise ValueError("znodes must be a sequence of paths")
     if atomic and znodes:
         log.debug("unregister: atomic delete of %s", list(znodes))
-        await zk.multi([Op.delete(n) for n in znodes])
+        remaining = list(znodes)
+        while remaining:
+            try:
+                await zk.multi([Op.delete(n) for n in remaining])
+                break
+            except MultiError as err:
+                shared = [
+                    n
+                    for n, code in zip(remaining, err.results)
+                    if code == Err.NOT_EMPTY
+                ]
+                if not shared:
+                    raise
+                log.debug(
+                    "unregister: %s still shared (children remain); retrying "
+                    "without them", shared,
+                )
+                remaining = [n for n in remaining if n not in shared]
         log.debug("unregister: done")
-        return
+        return remaining
+    deleted: List[str] = []
     for node in znodes:
         log.debug("unregister: deleting %s", node)
-        await zk.unlink(node)
+        try:
+            await zk.unlink(node)
+        except ZKError as err:
+            if err.code != Err.NOT_EMPTY:
+                raise
+            log.debug(
+                "unregister: %s still has children (shared service node); "
+                "left in place", node,
+            )
+        else:
+            deleted.append(node)
     log.debug("unregister: done")
+    return deleted

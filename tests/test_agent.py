@@ -287,6 +287,111 @@ class TestHealthIntegration:
             await client.close()
             await server.stop()
 
+    async def test_fleet_member_deregisters_cleanly_beside_siblings(self):
+        # The production shape: several instances behind one domain with
+        # a service record.  One instance health-failing must emit
+        # `unregister` (not `error`): its owned-node list includes the
+        # shared persistent service node, whose NOT_EMPTY refusal (the
+        # sibling's ephemeral lives under it) reads as success.
+        from registrar_tpu.registration import register
+
+        server, client = await _pair()
+        sibling = await ZKClient([server.address]).connect()
+        try:
+            import os
+            import tempfile
+
+            svc_registration = {
+                "domain": DOMAIN,
+                "type": "load_balancer",
+                "service": {
+                    "type": "service",
+                    "service": {"srvce": "_http", "proto": "_tcp", "port": 80},
+                },
+            }
+            await register(
+                sibling, svc_registration, admin_ip="10.7.7.8",
+                hostname="sibling", settle_delay=0.01,
+            )
+
+            flag = tempfile.NamedTemporaryFile(delete=False)
+            flag.close()
+            ee = _plus(
+                client,
+                registration=svc_registration,
+                health_check={
+                    "command": f"test -f {flag.name}",
+                    "interval": 0.03,
+                    "timeout": 1.0,
+                    "threshold": 2,
+                },
+            )
+            await ee.wait_for("register", timeout=10)
+            errors = []
+            ee.on("error", errors.append)
+            unregistered = asyncio.Event()
+            payload = []
+            def on_unregister(_err, deleted):
+                payload.append(deleted)
+                unregistered.set()
+            ee.on("unregister", on_unregister)
+            os.unlink(flag.name)  # start failing
+            await asyncio.wait_for(unregistered.wait(), timeout=10)
+            assert errors == []
+            # the event reports what was actually deleted: the host
+            # record only — the shared service node stays and is not
+            # claimed
+            assert payload == [[f"{PATH}/agenthost"]]
+            # my host record gone; sibling + service record intact
+            assert await client.exists(f"{PATH}/agenthost") is None
+            assert await client.exists(f"{PATH}/sibling") is not None
+            svc = await client.exists(PATH)
+            assert svc is not None and svc.ephemeral_owner == 0
+            ee.stop()
+        finally:
+            await sibling.close()
+            await client.close()
+            await server.stop()
+
+    async def test_finished_transition_tasks_are_pruned(self):
+        # A daemon with a flapping health check must not accumulate
+        # completed transition tasks forever.
+        server, client = await _pair()
+        try:
+            import os
+            import tempfile
+
+            flag = tempfile.NamedTemporaryFile(delete=False)
+            flag.close()
+            ee = _plus(
+                client,
+                health_check={
+                    "command": f"test -f {flag.name}",
+                    "interval": 0.02,
+                    "timeout": 1.0,
+                    "threshold": 1,
+                },
+            )
+            await ee.wait_for("register", timeout=10)
+            for _ in range(4):  # flap: down, up, down, up ...
+                unreg = asyncio.Event()
+                ee.on("unregister", lambda *a: unreg.set())
+                os.unlink(flag.name)
+                await asyncio.wait_for(unreg.wait(), timeout=10)
+                rereg = asyncio.Event()
+                ee.on("register", lambda *a: rereg.set())
+                open(flag.name, "w").close()
+                await asyncio.wait_for(rereg.wait(), timeout=10)
+            await asyncio.sleep(0.05)  # let done-callbacks run
+            # only the long-lived loops remain tracked, not one task per
+            # completed transition (4 flaps x 2 transitions would be 8+)
+            assert len(ee._tasks) <= 2
+            ee.stop()
+            os.unlink(flag.name)
+        finally:
+            await client.close()
+            await server.stop()
+
     async def test_flapping_does_not_double_register(self):
         server, client = await _pair()
         try:

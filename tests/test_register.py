@@ -237,6 +237,50 @@ class TestUnregister:
             await client.close()
             await server.stop()
 
+    async def test_unregister_leaves_shared_service_node_for_siblings(self):
+        # The production shape: N instances behind one domain.  One
+        # instance deregistering owns [its host node, the domain node];
+        # the domain node still holds the siblings' ephemerals, so the
+        # delete is refused with NOT_EMPTY — that must read as success
+        # (host record gone, shared service record intact), for both the
+        # sequential walk and the atomic multi path.
+        for atomic in (False, True):
+            server, client = await _pair()
+            sibling = await ZKClient([server.address]).connect()
+            try:
+                registration = {
+                    "domain": DOMAIN,
+                    "type": "load_balancer",
+                    "service": {
+                        "type": "service",
+                        "service": {
+                            "srvce": "_http", "proto": "_tcp", "port": 80,
+                        },
+                    },
+                }
+                mine = await _register(
+                    client, registration, admin_ip="10.1.1.1",
+                    hostname="inst-a",
+                )
+                theirs = await _register(
+                    sibling, registration, admin_ip="10.1.1.2",
+                    hostname="inst-b",
+                )
+                await unregister(client, mine, atomic=atomic)
+                # my host record is gone …
+                assert await client.exists(f"{PATH}/inst-a") is None
+                # … the sibling's host record and the service record stay
+                assert await client.exists(f"{PATH}/inst-b") is not None
+                svc_stat = await client.exists(PATH)
+                assert svc_stat is not None and svc_stat.ephemeral_owner == 0
+                # the last instance out deletes the service record too
+                await unregister(sibling, theirs, atomic=atomic)
+                assert await client.exists(PATH) is None
+            finally:
+                await sibling.close()
+                await client.close()
+                await server.stop()
+
     async def test_unregister_missing_node_raises(self):
         # parity: reference unregister does NOT ignore NO_NODE
         server, client = await _pair()
