@@ -623,6 +623,58 @@ class TestReplicationLag:
                 await writer.close()
 
 
+async def test_lag_reads_are_historical_prefixes_and_monotonic():
+    """Property sweep over random schedules of writes, lag toggles,
+    syncs, and reads: a read through the (possibly lagging) member must
+    always return a value that actually existed (a historical prefix
+    state, never an invention), the member's view must be monotonic
+    (catch-up only moves forward), a read right after sync() must be
+    current, and reads through the never-lagging member are always
+    current.  Failing seed printed for reproduction."""
+    import random
+
+    async def one_schedule(seed: int) -> None:
+        rng = random.Random(seed)
+        async with ZKEnsemble(2) as ens:
+            w = await ZKClient([ens.addresses[0]]).connect()
+            r = await ZKClient([ens.addresses[1]]).connect()
+            try:
+                await w.create("/p", b"0")
+                await r.sync("/")
+                writes = [b"0"]  # every value /p has ever held, in order
+                last_seen = 0  # newest index the reader has observed
+                for _ in range(rng.randrange(8, 16)):
+                    roll = rng.random()
+                    if roll < 0.40:
+                        val = str(len(writes)).encode()
+                        await w.put("/p", val)
+                        writes.append(val)
+                    elif roll < 0.52:
+                        ens.set_lag(1, 60_000)
+                    elif roll < 0.64:
+                        ens.set_lag(1, 0)
+                    elif roll < 0.80:
+                        await r.sync("/")
+                        data = (await r.get("/p"))[0]
+                        assert data == writes[-1], (seed, data, writes)
+                        last_seen = len(writes) - 1
+                    else:
+                        data = (await r.get("/p"))[0]
+                        idx = writes.index(data)  # ValueError = invented
+                        assert idx >= last_seen, (seed, idx, last_seen)
+                        last_seen = idx
+                    # the never-lagging member is always current
+                    assert (await w.get("/p"))[0] == writes[-1], seed
+            finally:
+                await r.close()
+                await w.close()
+
+    base = int(os.environ.get("LAG_PROP_SEED", random.randrange(2**31)))
+    print(f"LAG_PROP_SEED={base}", file=sys.stderr)
+    for i in range(20):
+        await one_schedule(base + i)
+
+
 async def test_dead_member_rejected_as_snapshot_donor():
     # A killed member's state IS the live ensemble's shared state;
     # adopting it as a snapshot donor would alias (and partially wipe)
