@@ -119,6 +119,65 @@ class TestRealZooKeeper:
         finally:
             await client.close()
 
+    async def test_unregister_beside_sibling_against_real_zk(self):
+        """The fleet-deregistration semantics depend on real ZooKeeper's
+        NOT_EMPTY refusal — including the multi abort reporting the
+        failing op's code — so pin them against the real server: one
+        instance out, sibling and service record intact; last one out
+        cleans up.  Both the sequential walk and the atomic multi path."""
+        from registrar_tpu.records import domain_to_path
+
+        for atomic in (False, True):
+            mine_client = await ZKClient(_servers()).connect()
+            sib_client = await ZKClient(_servers()).connect()
+            domain = f"fleet-{uuid.uuid4().hex[:8]}.test.registrar"
+            path = domain_to_path(domain)
+            try:
+                registration = {
+                    "domain": domain,
+                    "type": "load_balancer",
+                    "service": {
+                        "type": "service",
+                        "service": {
+                            "srvce": "_http", "proto": "_tcp", "port": 80,
+                        },
+                    },
+                }
+                mine = await register(
+                    mine_client, registration, admin_ip="10.250.0.3",
+                    hostname="fleet-a", settle_delay=0.05,
+                )
+                theirs = await register(
+                    sib_client, registration, admin_ip="10.250.0.4",
+                    hostname="fleet-b", settle_delay=0.05,
+                )
+                deleted = await unregister(mine_client, mine, atomic=atomic)
+                assert "fleet-a" not in await sib_client.get_children(path)
+                assert path not in deleted  # shared node not claimed
+                svc_stat = await sib_client.stat(path)
+                assert svc_stat.ephemeral_owner == 0  # service record stays
+                deleted = await unregister(sib_client, theirs, atomic=atomic)
+                assert path in deleted  # last one out takes it
+                assert await sib_client.exists(path) is None
+            finally:
+                # clean up even on assertion failure — a long-lived real
+                # server must not accumulate this test's persistent nodes
+                try:
+                    for node in sorted(
+                        await sib_client.get_children(path), reverse=True
+                    ):
+                        await sib_client.unlink(f"{path}/{node}")
+                    await sib_client.unlink(path)
+                except Exception:  # noqa: BLE001 - already gone on success
+                    pass
+                for p in ("/registrar/test", "/registrar"):
+                    try:
+                        await sib_client.unlink(p)
+                    except Exception:  # noqa: BLE001 - shared parents remain
+                        break
+                await sib_client.close()
+                await mine_client.close()
+
     async def test_sync_against_real_zk(self):
         client = await ZKClient(_servers()).connect()
         try:
