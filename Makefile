@@ -7,7 +7,7 @@ NAME = registrar
 RELEASE_TARBALL = $(NAME)-release.tar.gz
 RELSTAGEDIR = /tmp/$(NAME)-release
 
-.PHONY: all check test test-jax bench release publish clean
+.PHONY: all check test test-jax chaos bench release publish clean
 
 all: check test
 
@@ -37,6 +37,11 @@ test:
 test-jax:
 	env -u PALLAS_AXON_POOL_IPS -u PYTHONPATH JAX_PLATFORMS=cpu \
 	    $(PYTHON) -m pytest tests/test_graft_entry.py -m jax -x -q
+
+# Long-form chaos soak: 30 s fault-injection storm (the suite's default
+# run is ~5 s).  CHAOS_SEED=<n> pins a schedule for reproduction.
+chaos:
+	CHAOS_SECONDS=30 $(PYTHON) -m pytest tests/test_chaos.py -x -q
 
 bench:
 	$(PYTHON) bench.py
