@@ -48,6 +48,7 @@ from __future__ import annotations
 
 import json
 import math
+import re
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Mapping, Optional, Tuple
 
@@ -181,16 +182,47 @@ def parse_config(raw: Mapping[str, Any]) -> Config:
             raise ConfigError("config.healthCheck must be an object")
         if not isinstance(hc_raw.get("command"), str) or not hc_raw["command"]:
             raise ConfigError("config.healthCheck.command must be a string")
+        threshold = hc_raw.get("threshold", 5)
+        if (
+            not isinstance(threshold, int)
+            or isinstance(threshold, bool)
+            or threshold < 1
+        ):
+            # Validated here (not only in HealthCheck.__init__) so a typo
+            # like "threshold": "5" fails the -n pre-flight with EX_CONFIG
+            # instead of killing the health consumer task at runtime.
+            raise ConfigError(
+                "config.healthCheck.threshold must be a positive integer"
+            )
         health_check = {
             "command": hc_raw["command"],
             "interval": _ms(hc_raw, "interval", 60000) / 1000.0,
             "timeout": _ms(hc_raw, "timeout", 1000) / 1000.0,
             "period": _ms(hc_raw, "period", 300000) / 1000.0,
-            "threshold": hc_raw.get("threshold", 5),
+            "threshold": threshold,
             "ignore_exit_status": bool(hc_raw.get("ignoreExitStatus", False)),
         }
         if hc_raw.get("stdoutMatch") is not None:
-            health_check["stdout_match"] = hc_raw["stdoutMatch"]
+            sm = hc_raw["stdoutMatch"]
+            # Validate with the exact code the checker runs (pattern
+            # compiles, flags supported, shape right), so a config that
+            # passes -n can never throw when the daemon builds the checker.
+            from registrar_tpu.health import _compile_stdout_match
+
+            if not isinstance(sm, Mapping) or not isinstance(
+                sm.get("pattern"), str
+            ):
+                raise ConfigError(
+                    "config.healthCheck.stdoutMatch must be "
+                    "{pattern, flags?, invert?}"
+                )
+            try:
+                _compile_stdout_match(sm)
+            except (ValueError, TypeError, re.error) as e:
+                raise ConfigError(
+                    f"config.healthCheck.stdoutMatch: {e}"
+                ) from e
+            health_check["stdout_match"] = sm
 
     log_level = raw.get("logLevel")
     if log_level is not None and not isinstance(log_level, str):

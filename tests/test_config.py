@@ -158,6 +158,26 @@ class TestParse:
             lambda c: c.update(adminIp=42),
             lambda c: c.update(healthCheck={"interval": 5}),
             lambda c: c.update(healthCheck={"command": ""}),
+            # a "5" (string) threshold used to pass -n pre-flight and then
+            # kill the health consumer task at runtime
+            lambda c: c.update(healthCheck={"command": "true",
+                                            "threshold": "5"}),
+            lambda c: c.update(healthCheck={"command": "true",
+                                            "threshold": 0}),
+            lambda c: c.update(healthCheck={"command": "true",
+                                            "threshold": True}),
+            lambda c: c.update(healthCheck={"command": "true",
+                                            "stdoutMatch": "ok"}),
+            lambda c: c.update(healthCheck={"command": "true",
+                                            "stdoutMatch": {"pattern": 5}}),
+            lambda c: c.update(healthCheck={
+                "command": "true",
+                "stdoutMatch": {"pattern": "("},  # does not compile
+            }),
+            lambda c: c.update(healthCheck={
+                "command": "true",
+                "stdoutMatch": {"pattern": "ok", "flags": "x"},  # unsupported
+            }),
             lambda c: c.update(logLevel=3),
             lambda c: c.update(maxAttempts=0),
             lambda c: c.update(repairHeartbeatMiss="yes"),
