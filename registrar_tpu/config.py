@@ -209,12 +209,24 @@ def parse_config(raw: Mapping[str, Any]) -> Config:
             # passes -n can never throw when the daemon builds the checker.
             from registrar_tpu.health import _compile_stdout_match
 
-            if not isinstance(sm, Mapping) or not isinstance(
-                sm.get("pattern"), str
+            if (
+                not isinstance(sm, Mapping)
+                or not isinstance(sm.get("pattern"), str)
+                or not sm["pattern"]  # "" would silently disable matching
             ):
                 raise ConfigError(
                     "config.healthCheck.stdoutMatch must be "
-                    "{pattern, flags?, invert?}"
+                    "{pattern, flags?, invert?} with a non-empty pattern"
+                )
+            if "invert" in sm and not isinstance(sm["invert"], bool):
+                # "false" (a string) is truthy — it would silently flip
+                # the match and declare a healthy service down
+                raise ConfigError(
+                    "config.healthCheck.stdoutMatch.invert must be a boolean"
+                )
+            if "flags" in sm and not isinstance(sm["flags"], str):
+                raise ConfigError(
+                    "config.healthCheck.stdoutMatch.flags must be a string"
                 )
             try:
                 _compile_stdout_match(sm)

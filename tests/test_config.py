@@ -178,6 +178,19 @@ class TestParse:
                 "command": "true",
                 "stdoutMatch": {"pattern": "ok", "flags": "x"},  # unsupported
             }),
+            lambda c: c.update(healthCheck={
+                "command": "true",
+                "stdoutMatch": {"pattern": ""},  # would disable matching
+            }),
+            lambda c: c.update(healthCheck={
+                "command": "true",
+                # "false" is truthy: would invert the match at runtime
+                "stdoutMatch": {"pattern": "ok", "invert": "false"},
+            }),
+            lambda c: c.update(healthCheck={
+                "command": "true",
+                "stdoutMatch": {"pattern": "ok", "flags": 3},
+            }),
             lambda c: c.update(logLevel=3),
             lambda c: c.update(maxAttempts=0),
             lambda c: c.update(repairHeartbeatMiss="yes"),
