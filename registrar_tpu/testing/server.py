@@ -208,7 +208,18 @@ class _SharedState:
         #: monotonic time of the newest commit — drives lagging members'
         #: quiescence-based catch-up
         self.last_commit = 0.0
+        #: members currently configured with apply_delay_ms > 0.  Kept as
+        #: a count (recomputed on membership/lag changes, which are rare)
+        #: so the per-commit freeze scan in _next_zxid is skipped entirely
+        #: in the common no-lag case — the write hot path must not pay for
+        #: a feature no member uses (round-5 perf directive).
+        self.lag_members = 0
         ensure_system_nodes(self.root)
+
+    def recount_lag(self) -> None:
+        self.lag_members = sum(
+            1 for m in self.members if m.apply_delay_ms > 0
+        )
 
 
 def ensure_system_nodes(root: ZNode) -> None:
@@ -404,12 +415,14 @@ class ZKServer:
         )
         self.port = self._server.sockets[0].getsockname()[1]
         self._state.members.add(self)
+        self._state.recount_lag()
         self._sweeper = asyncio.create_task(self._sweep_loop())
         log.debug("ZKServer listening on %s:%d", self.host, self.port)
         return self
 
     async def stop(self) -> None:
         self._state.members.discard(self)
+        self._state.recount_lag()
         if self._sweeper:
             self._sweeper.cancel()
             try:
@@ -907,15 +920,19 @@ class ZKServer:
         # live member configured to lag, and currently caught up, freezes
         # its read view at the pre-commit state.  (The committing member
         # itself never freezes — a follower applies a commit before acking
-        # it, preserving read-your-writes.)
-        for member in self._state.members:
-            if (
-                member is not self
-                and member.apply_delay_ms > 0
-                and member._lag_root is None
-            ):
-                member._lag_root = _clone_tree(self._state.root)
-                member._lag_zxid = self._state.zxid
+        # it, preserving read-your-writes.)  Guarded by the shared lag
+        # count so the no-lag configuration — every production-shaped
+        # bench and test — pays nothing for the lag model on its write
+        # hot path.
+        if self._state.lag_members:
+            for member in self._state.members:
+                if (
+                    member is not self
+                    and member.apply_delay_ms > 0
+                    and member._lag_root is None
+                ):
+                    member._lag_root = _clone_tree(self._state.root)
+                    member._lag_zxid = self._state.zxid
         self.zxid += 1
         self._state.last_commit = time.monotonic()
         return self.zxid
@@ -1894,6 +1911,7 @@ class ZKEnsemble:
         if member is None or member._server is None:
             raise ValueError(f"member {i} is not running")
         member.apply_delay_ms = apply_delay_ms
+        self.state.recount_lag()
         if apply_delay_ms <= 0:
             member._catch_up()
 

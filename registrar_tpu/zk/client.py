@@ -638,19 +638,56 @@ class ZKClient(EventEmitter):
         return proto.GetChildren2Response.read(r).children
 
     async def mkdirp(self, path: str) -> None:
-        """Create ``path`` and any missing ancestors (persistent, empty)."""
+        """Create ``path`` and any missing ancestors (persistent, empty).
+
+        Pipelined: one create per ancestor posted back-to-back on the
+        single FIFO connection, one drain, replies collected in order.
+        The server applies them in submission order, so each create sees
+        its parent already made (or NODE_EXISTS) — the znode outcome is
+        identical to the sequential walk at one round trip of latency
+        instead of one per component (the registration pipeline's
+        stage-3 hot path, 4-6 components per domain).  NODE_EXISTS is
+        ignored per component; the first other error propagates (a
+        failed ancestor cascades NO_NODE onto its descendants, so the
+        root cause is the error reported).
+        """
         check_path(path)
         if path == "/":
             return
-        parts = path.strip("/").split("/")
-        current = ""
-        for comp in parts:
-            current += "/" + comp
-            try:
-                await self.create(current, b"", CreateFlag.PERSISTENT)
-            except ZKError as err:
-                if err.code != Err.NODE_EXISTS:
-                    raise
+        futs: List[asyncio.Future] = []
+        post_err: Optional[BaseException] = None
+        try:
+            current = ""
+            for comp in path.strip("/").split("/"):
+                current += "/" + comp
+                futs.append(
+                    self._post(
+                        self._next_xid(),
+                        OpCode.CREATE,
+                        proto.CreateRequest(
+                            path=self._abs(current),
+                            data=b"",
+                            acls=list(OPEN_ACL_UNSAFE),
+                            flags=CreateFlag.PERSISTENT,
+                        ),
+                    )
+                )
+            if futs and self._writer is not None:
+                await self._writer.drain()
+        except (ConnectionError, OSError):
+            await self._teardown(expected=False)
+        except ZKError as e:  # not connected: fail after draining futs
+            post_err = e
+        first_err: Optional[BaseException] = post_err
+        for res in await asyncio.gather(*futs, return_exceptions=True):
+            if (
+                isinstance(res, BaseException)
+                and not (isinstance(res, ZKError) and res.code == Err.NODE_EXISTS)
+                and first_err is None
+            ):
+                first_err = res
+        if first_err is not None:
+            raise first_err
 
     def watch(self, path: str, listener) -> None:
         """Register a listener for one-shot watch events on ``path``."""

@@ -64,7 +64,10 @@ class Writer:
         if value is None:
             return self.write_int(-1)
         self.write_int(len(value))
-        self._chunks.append(bytes(value))
+        # bytes payloads (the common case) are immutable — append as-is;
+        # only mutable buffer types (bytearray/memoryview) need a copy to
+        # pin the encoded frame against later mutation.
+        self._chunks.append(value if type(value) is bytes else bytes(value))
         return self
 
     def write_ustring(self, value: Optional[str]) -> "Writer":
@@ -108,10 +111,21 @@ class Reader:
         return out
 
     def read_int(self) -> int:
-        return _INT.unpack(self._take(4))[0]
+        # unpack_from avoids the intermediate slice _take would allocate;
+        # ints dominate every frame (lengths, xids, versions), so this is
+        # the hottest decode path in the wire stack.
+        pos = self._pos
+        if len(self._data) - pos < 4:
+            self._take(4)  # raises the canonical truncation error
+        self._pos = pos + 4
+        return _INT.unpack_from(self._data, pos)[0]
 
     def read_long(self) -> int:
-        return _LONG.unpack(self._take(8))[0]
+        pos = self._pos
+        if len(self._data) - pos < 8:
+            self._take(8)
+        self._pos = pos + 8
+        return _LONG.unpack_from(self._data, pos)[0]
 
     def read_bool(self) -> bool:
         return self._take(1) != b"\x00"

@@ -8,6 +8,8 @@ down state.
 """
 
 import asyncio
+import time
+import tracemalloc
 
 import pytest
 
@@ -133,6 +135,19 @@ class TestSingleChecks:
         rec = await hc.check_once()
         assert rec["type"] == "fail"
 
+    async def test_grandchild_holding_pipes_cannot_wedge_the_check(self):
+        # A backgrounded grandchild inherits the stdout/stderr pipes and
+        # outlives the SIGTERM/SIGKILL aimed at the shell, so the pipes
+        # never reach EOF.  The drain must be bounded — the check reports
+        # the timeout and health checking continues, instead of blocking
+        # until the grandchild dies.
+        hc = HealthCheck(command="sleep 30 & sleep 30", timeout=0.2)
+        t0 = time.monotonic()
+        rec = await hc.check_once()
+        assert rec["type"] == "fail"
+        assert "timed out" in str(rec["err"])
+        assert time.monotonic() - t0 < 5
+
 
 class TestThreshold:
     async def test_threshold_crossing_sets_down(self):
@@ -168,6 +183,107 @@ class TestThreshold:
         rec = await hc.check_once()
         assert rec["failures"] == 1  # fresh window, not instant re-down
         assert rec["isDown"] is False
+
+
+class TestOutputCap:
+    """The 1 MiB cap is enforced *while streaming* (reference
+    lib/health.js:45-52 exec maxBuffer): the child is killed the moment
+    its output crosses the cap, and the daemon never retains more than
+    the cap in memory — a runaway writer cannot OOM the sidecar."""
+
+    async def test_runaway_writer_killed_at_cap(self):
+        # 16 MiB burst then a long sleep: without the streaming kill the
+        # check would buffer the burst and sit out the sleep until the
+        # timeout; with it, the SIGTERM lands as the cap is crossed and
+        # the sleep never runs.
+        hc = HealthCheck(
+            command="head -c 16777216 /dev/zero; sleep 5", timeout=10
+        )
+        tracemalloc.start()
+        t0 = time.monotonic()
+        rec = await hc.check_once()
+        elapsed = time.monotonic() - t0
+        _, peak = tracemalloc.get_traced_memory()
+        tracemalloc.stop()
+        assert rec["type"] == "fail"
+        assert "exceeded output limit" in str(rec["err"])
+        assert elapsed < 4, "child was not killed at the cap"
+        # Bounded memory: the 16 MiB burst must not be accumulated —
+        # only up to the 1 MiB cap (plus small read buffers) is retained.
+        assert peak < 4 * 1024 * 1024, f"peak {peak} bytes: output buffered"
+
+    async def test_stderr_counts_against_cap(self):
+        hc = HealthCheck(
+            command="head -c 16777216 /dev/zero 1>&2; sleep 5", timeout=10
+        )
+        t0 = time.monotonic()
+        rec = await hc.check_once()
+        assert rec["type"] == "fail"
+        assert "exceeded output limit" in str(rec["err"])
+        assert time.monotonic() - t0 < 4
+
+    async def test_output_at_exactly_cap_passes(self):
+        # Boundary parity with the pre-streaming behavior: the check
+        # fails only when output *exceeds* the cap.
+        hc = HealthCheck(command="head -c 1048576 /dev/zero", timeout=10)
+        assert (await hc.check_once())["type"] == "ok"
+
+    async def test_capped_stdout_still_matched(self):
+        # stdoutMatch sees the retained prefix even on a capped run —
+        # but the cap failure wins, like Node's maxBuffer error.
+        hc = HealthCheck(
+            command="echo hello; head -c 2097152 /dev/zero",
+            timeout=10,
+            stdout_match={"pattern": "hello"},
+        )
+        rec = await hc.check_once()
+        assert rec["type"] == "fail"
+        assert "exceeded output limit" in str(rec["err"])
+
+
+class TestLoopCrashRestart:
+    """An unexpected exception in the check loop must never silently end
+    health checking while the host stays registered (round-4 verdict):
+    the crash counts as a failed check and the loop restarts with
+    backoff."""
+
+    async def test_crash_restarts_and_counts_as_failure(self):
+        hc = HealthCheck(
+            command="true", interval=0.01, threshold=2, period=10
+        )
+        hc.CRASH_BACKOFF_INITIAL_S = 0.01
+        calls = {"n": 0}
+        real_check_once = hc.check_once
+
+        async def flaky_check_once():
+            calls["n"] += 1
+            if calls["n"] <= 2:
+                raise RuntimeError("injected crash")
+            return await real_check_once()
+
+        hc.check_once = flaky_check_once
+        records, errors = [], []
+        hc.on("data", records.append)
+        hc.on("error", errors.append)
+        hc.start()
+        try:
+            deadline = time.monotonic() + 5
+            while time.monotonic() < deadline:
+                if any(r["type"] == "ok" for r in records):
+                    break
+                await asyncio.sleep(0.01)
+        finally:
+            hc.stop()
+        # Both crashes surfaced and counted toward the threshold...
+        assert len(errors) == 2
+        fails = [r for r in records if r["type"] == "fail"]
+        assert len(fails) == 2
+        assert [f["isDown"] for f in fails] == [False, True]
+        assert all("crashed" in str(f["err"]) for f in fails)
+        # ...and checking resumed: real checks ran again after the
+        # crashes and recovery cleared the down state.
+        assert any(r["type"] == "ok" for r in records)
+        assert not hc.is_down
 
 
 class TestLoop:
