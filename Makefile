@@ -18,6 +18,7 @@ all: check test
 check:
 	$(PYTHON) -m compileall -q registrar_tpu tests tools bench.py __graft_entry__.py
 	$(PYTHON) tools/check.py
+	$(PYTHON) bench.py --check-baseline
 	$(PYTHON) -X dev -W error -c "import registrar_tpu, registrar_tpu.main, \
 	    registrar_tpu.testing.server, registrar_tpu.config, \
 	    registrar_tpu.tools.zkcli, registrar_tpu.binderview, \

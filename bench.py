@@ -333,6 +333,86 @@ BASELINE_PATH = os.environ.get(
                  "BENCH_BASELINE.json"),
 )
 
+HISTORY_PATH = os.environ.get(
+    "BENCH_HISTORY_PATH",
+    os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                 "BENCH_HISTORY.json"),
+)
+
+
+def baseline_from_history(history: dict) -> dict:
+    """Apply the governance rule: baseline = per-metric best across all
+    recorded rounds, with ``headroom_pct`` of slack away from the best.
+
+    Round-4 verdict #6: the baseline must be *generated* from the
+    append-only history by rule, never hand-edited — `--repin` writes
+    it, `--check-baseline` (run by `make check`) and the gate tests
+    fail on any divergence.  Best-of (not worst-of) so a best-to-worst
+    slide moves the measured value toward the floor instead of being
+    absorbed by a floor pinned at the historical worst.
+    """
+    h = float(history["headroom_pct"]) / 100.0
+    directions = history["directions"]
+    metrics = {}
+    for name, direction in directions.items():
+        values = [
+            r["metrics"][name]
+            for r in history["rounds"]
+            if name in r.get("metrics", {})
+        ]
+        if not values:
+            raise ValueError(f"history has no values for metric {name!r}")
+        best = min(values) if direction == "lower" else max(values)
+        pinned = best * (1 + h) if direction == "lower" else best * (1 - h)
+        metrics[name] = {"value": round(pinned, 4), "direction": direction}
+    return {
+        "comment": "GENERATED from BENCH_HISTORY.json by `python bench.py "
+        "--repin` — do not hand-edit (make check verifies this file "
+        "matches the history rule; record new results in the history "
+        "instead). Rule: per-metric best across recorded rounds with "
+        f"{history['headroom_pct']}% headroom away from the best; the "
+        "gate then allows tolerance_pct beyond these values at runtime "
+        "(BENCH_TOLERANCE_PCT to widen on slower hardware, BENCH_GATE=0 "
+        "to disable, BENCH_BASELINE_PATH / BENCH_HISTORY_PATH to "
+        "relocate).",
+        "tolerance_pct": history["tolerance_pct"],
+        "metrics": metrics,
+    }
+
+
+def load_history(path: str = None) -> dict:
+    with open(path or HISTORY_PATH, encoding="utf-8") as f:
+        return json.load(f)
+
+
+def repin(history_path: str = None, baseline_path: str = None) -> None:
+    baseline = baseline_from_history(load_history(history_path))
+    with open(baseline_path or BASELINE_PATH, "w", encoding="utf-8") as f:
+        json.dump(baseline, f, indent=2)
+        f.write("\n")
+
+
+def check_baseline(history_path: str = None, baseline_path: str = None) -> list:
+    """Divergences between the checked-in baseline and rule(history)."""
+    expected = baseline_from_history(load_history(history_path))
+    actual = load_baseline(baseline_path)
+    if actual is None:
+        return ["BENCH_BASELINE.json is missing; run `python bench.py --repin`"]
+    problems = []
+    if actual.get("tolerance_pct") != expected["tolerance_pct"]:
+        problems.append(
+            f"tolerance_pct {actual.get('tolerance_pct')} != history's "
+            f"{expected['tolerance_pct']}"
+        )
+    for name, spec in expected["metrics"].items():
+        got = actual.get("metrics", {}).get(name)
+        if got != spec:
+            problems.append(f"{name}: baseline {got} != rule(history) {spec}")
+    for name in actual.get("metrics", {}):
+        if name not in expected["metrics"]:
+            problems.append(f"{name}: in baseline but not in history")
+    return problems
+
 
 def flat_metrics(result: dict) -> dict:
     """Headline value + every numeric extra, as one {name: value} map."""
@@ -422,6 +502,23 @@ def best_of(a: dict, b: dict, baseline: dict) -> dict:
 
 
 def main() -> int:
+    if "--repin" in sys.argv[1:]:
+        repin()
+        print(f"bench: wrote {BASELINE_PATH} from {HISTORY_PATH}",
+              file=sys.stderr)
+        return 0
+    if "--check-baseline" in sys.argv[1:]:
+        problems = check_baseline()
+        for p in problems:
+            print(f"bench: baseline drift: {p}", file=sys.stderr)
+        if problems:
+            print(
+                "bench: BENCH_BASELINE.json does not match the history "
+                "rule — record results in BENCH_HISTORY.json and run "
+                "`python bench.py --repin` (never hand-edit the baseline)",
+                file=sys.stderr,
+            )
+        return 1 if problems else 0
     result = asyncio.run(_bench())
     baseline = load_baseline()
     gate_on = os.environ.get("BENCH_GATE", "1") != "0" and baseline is not None

@@ -13,6 +13,8 @@ import os
 import subprocess
 import sys
 
+import pytest
+
 import bench
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -111,6 +113,76 @@ class TestGateLogic:
         for name, spec in baseline["metrics"].items():
             assert spec["direction"] in ("lower", "higher"), name
             assert isinstance(spec["value"], (int, float)), name
+
+
+class TestBaselineGovernance:
+    """Round-4 verdict #6: the baseline is GENERATED from the append-only
+    BENCH_HISTORY.json by rule (per-metric best across rounds, fixed
+    headroom) — a hand-nudged baseline without a matching history entry
+    fails `make check` (bench.py --check-baseline) and these tests."""
+
+    HISTORY = {
+        "headroom_pct": 15,
+        "tolerance_pct": 10,
+        "directions": {
+            "pipeline_ms_no_settle": "lower",
+            "concurrent_registrations_per_s": "higher",
+        },
+        "rounds": [
+            {"round": "a", "metrics": {"pipeline_ms_no_settle": 0.9,
+                                       "concurrent_registrations_per_s": 2000}},
+            {"round": "b", "metrics": {"pipeline_ms_no_settle": 0.8,
+                                       "concurrent_registrations_per_s": 2500}},
+            {"round": "c", "metrics": {"pipeline_ms_no_settle": 1.1}},
+        ],
+    }
+
+    def test_rule_is_best_of_rounds_with_headroom(self):
+        out = bench.baseline_from_history(self.HISTORY)
+        # lower-is-better: best 0.8 * 1.15; higher: best 2500 * 0.85.
+        assert out["metrics"]["pipeline_ms_no_settle"] == {
+            "value": 0.92, "direction": "lower",
+        }
+        assert out["metrics"]["concurrent_registrations_per_s"] == {
+            "value": 2125.0, "direction": "higher",
+        }
+        assert out["tolerance_pct"] == 10
+
+    def test_metric_missing_from_every_round_is_an_error(self):
+        bad = {**self.HISTORY, "directions": {"ghost_metric": "lower"}}
+        with pytest.raises(ValueError, match="ghost_metric"):
+            bench.baseline_from_history(bad)
+
+    def test_checked_in_baseline_matches_rule_of_history(self):
+        # THE governance assertion: the shipped baseline is exactly
+        # rule(shipped history) — any hand edit diverges and fails here.
+        assert bench.check_baseline() == []
+
+    def test_hand_nudged_baseline_is_detected(self, tmp_path):
+        nudged = bench.baseline_from_history(bench.load_history())
+        nudged["metrics"]["concurrent_registrations_per_s"]["value"] -= 200
+        p = tmp_path / "baseline.json"
+        p.write_text(json.dumps(nudged))
+        problems = bench.check_baseline(baseline_path=str(p))
+        assert len(problems) == 1
+        assert problems[0].startswith("concurrent_registrations_per_s:")
+
+    def test_repin_writes_rule_output(self, tmp_path):
+        hist = tmp_path / "history.json"
+        hist.write_text(json.dumps(self.HISTORY))
+        bl = tmp_path / "baseline.json"
+        bench.repin(history_path=str(hist), baseline_path=str(bl))
+        assert bench.check_baseline(
+            history_path=str(hist), baseline_path=str(bl)
+        ) == []
+
+    def test_history_rounds_cover_every_gated_metric(self):
+        # The shipped history must produce a baseline covering the same
+        # metric set the gate relies on — losing a metric from the
+        # history silently ungates it.
+        history = bench.load_history()
+        baseline = bench.load_baseline()
+        assert set(history["directions"]) == set(baseline["metrics"])
 
 
 class TestGateExitWiring:
