@@ -214,6 +214,13 @@ class _SharedState:
         #: in the common no-lag case — the write hot path must not pay for
         #: a feature no member uses (round-5 perf directive).
         self.lag_members = 0
+        #: path -> zxid of its newest create, recorded only while a member
+        #: is configured to lag and cleared once every member has caught
+        #: up.  Lets _catch_up detect a node created *and deleted* within
+        #: a lag window: the stale/live diff shows nothing, but a real
+        #: follower applying the backlog would still fire the armed
+        #: exists watch's NODE_CREATED (round-4 advisor finding).
+        self.lag_creates: Dict[str, int] = {}
         ensure_system_nodes(self.root)
 
     def recount_lag(self) -> None:
@@ -956,6 +963,7 @@ class ZKServer:
         if self._lag_root is None:
             return
         stale_root, self._lag_root = self._lag_root, None
+        frozen_zxid = self._lag_zxid
         pending, self._lag_watches = self._lag_watches, []
         for kind, path, conn in pending:
             if conn.closed:
@@ -979,6 +987,11 @@ class ZKServer:
             if kind == _WATCH_EXIST:
                 if live is not None:
                     ev = EventType.NODE_CREATED
+                elif self._state.lag_creates.get(path, -1) > frozen_zxid:
+                    # Created then deleted entirely inside the lag
+                    # window: the stale/live diff is empty, but the
+                    # backlog contains the create this watch is owed.
+                    ev = EventType.NODE_CREATED
             elif kind == _WATCH_DATA:
                 if live is None:
                     ev = EventType.NODE_DELETED
@@ -999,6 +1012,11 @@ class ZKServer:
             asyncio.ensure_future(
                 self._send_watch_events({conn}, ev, path)
             )
+        # The create log only serves members still behind; once everyone
+        # has applied the backlog it is dead weight — clear it so it
+        # cannot grow across lag windows.
+        if not any(m._lag_root is not None for m in self._state.members):
+            self._state.lag_creates.clear()
 
     async def _fire_watches(self, kind: str, path: str, ev_type: int) -> None:
         conns = self._watches[kind].pop(path, set())
@@ -1163,6 +1181,8 @@ class ZKServer:
             raise proto.ZKError(Err.NODE_EXISTS, path)
 
         zxid = self._next_zxid()
+        if self._state.lag_members:
+            self._state.lag_creates[path] = zxid
         now = _now_ms()
         ephemeral = flags in (
             proto.CreateFlag.EPHEMERAL,
@@ -1757,9 +1777,15 @@ class ZKServer:
                 )
             if op == OpCode.MULTI:
                 req = proto.MultiRequest.read(r)
-                reply = self._reply(hdr.xid, Err.OK, await self._multi(req, sess))
+                results = await self._multi(req, sess)
+                # Catch up BEFORE encoding, like the other write ops: a
+                # write multi served by a lagging member must stamp its
+                # reply with the applied zxid, not the frozen one —
+                # otherwise the client's last_zxid understates its own
+                # commit and the connect-time zxid-refusal guard cannot
+                # protect its read-your-writes across a reconnect.
                 self._catch_up()
-                return reply
+                return self._reply(hdr.xid, Err.OK, results)
             if op == OpCode.CHECK:
                 req = proto.CheckVersionRequest.read(r)
                 proto.check_path(req.path)

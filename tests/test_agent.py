@@ -214,6 +214,66 @@ class TestHeartbeatRepair:
             await client.close()
             await server.stop()
 
+    async def test_real_threshold_crossing_mid_settle_ends_deregistered(
+        self, monkeypatch, tmp_path
+    ):
+        # Round-4 verdict #8: the rollback race driven through the REAL
+        # health checker instead of poking ee.down.  Interleaving, pinned
+        # by construction: the heartbeat probe (20 ms cadence) hits
+        # NO_NODE and starts the repair pipeline (500 ms settle) well
+        # before the checker (80 ms cadence, threshold 2) can cross —
+        # the crossing then lands ~160 ms into the settle window.  The
+        # host must end deregistered; removing the rollback branch in
+        # _heartbeat_loop re-registers it and fails every assertion
+        # below.
+        import registrar_tpu.agent as agent_mod
+        from registrar_tpu.retry import RetryPolicy
+
+        monkeypatch.setattr(agent_mod, "HEARTBEAT_FAILURE_BACKOFF_S", 0.05)
+        flag = tmp_path / "healthy"
+        flag.write_text("")
+        server, client = await _pair()
+        try:
+            ee = _plus(
+                client,
+                heartbeat_interval=0.02,
+                heartbeat_retry=RetryPolicy(
+                    max_attempts=1, initial_delay=0.01, max_delay=0.01
+                ),
+                repair_heartbeat_miss=True,
+                settle_delay=0.5,
+                health_check={
+                    "command": f"test -f {flag}",
+                    "interval": 0.08,
+                    "timeout": 1.0,
+                    "threshold": 2,
+                },
+            )
+            (znodes,) = await ee.wait_for("register", timeout=10)
+            registers, fails = [], []
+            ee.on("register", registers.append)
+            ee.on("fail", fails.append)
+
+            # One tick breaks both worlds: the znode vanishes (operator
+            # delete) and the health command starts failing.
+            flag.unlink()
+            await client.unlink(znodes[0])
+            # The repair is in flight (its NO_NODE probe surfaced) ...
+            await ee.wait_for("heartbeatFailure", timeout=10)
+            # ... and the checker crosses its threshold inside the
+            # repair's settle window.
+            await ee.wait_for("fail", timeout=10)
+            assert ee.down
+            # Let the settle finish and the rollback land.
+            await asyncio.sleep(1.0)
+            assert registers == [], "repair resurrected a down host"
+            assert await client.exists(znodes[0]) is None
+            assert ee.down
+            ee.stop()
+        finally:
+            await client.close()
+            await server.stop()
+
     async def test_repair_respects_health_down(self, monkeypatch):
         # While the health checker holds the host deregistered, a NO_NODE
         # heartbeat must NOT resurrect the znodes.

@@ -21,7 +21,8 @@ import pytest
 
 from registrar_tpu.registration import register
 from registrar_tpu.testing.server import ZKEnsemble, ZKServer
-from registrar_tpu.zk.client import ZKClient
+from registrar_tpu.zk.client import Op, ZKClient
+from registrar_tpu.zk.protocol import EventType
 from registrar_tpu.zk.protocol import CreateFlag, ZKError
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -434,6 +435,52 @@ class TestReplicationLag:
                 await reader.sync("/")  # catch-up must not re-deliver
                 await asyncio.sleep(0.2)
                 assert len(events) == 1
+            finally:
+                await reader.close()
+                await writer.close()
+
+    async def test_exists_watch_owed_a_create_that_was_already_deleted(self):
+        # A node created AND deleted inside the lag window, with the
+        # exists watch armed afterwards against the stale view: the
+        # stale/live diff shows nothing, but a real follower applying
+        # the backlog fires NODE_CREATED for the armed watch (round-4
+        # advisor finding — the create log closes the gap).
+        async with ZKEnsemble(2) as ens:
+            writer = await ZKClient([ens.addresses[0]]).connect()
+            reader = await ZKClient([ens.addresses[1]]).connect()
+            try:
+                ens.set_lag(1, 60_000)
+                await writer.put("/seed", b"freeze")  # member 1 freezes
+                await writer.create("/ctd", b"")  # both transitions land
+                await writer.unlink("/ctd")  # inside the backlog
+                events = []
+                reader.watch("/ctd", events.append)
+                # Stale view: never saw /ctd; arms an exist watch.
+                assert await reader.exists("/ctd", watch=True) is None
+                await reader.sync("/")  # catch-up owes the create event
+                for _ in range(100):
+                    if events:
+                        break
+                    await asyncio.sleep(0.02)
+                assert [e.type for e in events] == [EventType.NODE_CREATED]
+            finally:
+                await reader.close()
+                await writer.close()
+
+    async def test_write_multi_via_lagging_member_stamps_applied_zxid(self):
+        # Like CREATE/DELETE/SETDATA, a write multi served by a lagging
+        # member catches the member up BEFORE the reply is encoded: the
+        # client's last_zxid must cover its own commit, or the
+        # connect-time zxid-refusal guard cannot protect read-your-writes
+        # across a reconnect (round-4 advisor finding).
+        async with ZKEnsemble(2) as ens:
+            writer = await ZKClient([ens.addresses[0]]).connect()
+            reader = await ZKClient([ens.addresses[1]]).connect()
+            try:
+                ens.set_lag(1, 60_000)
+                await writer.put("/seed", b"freeze")  # member 1 freezes
+                await reader.multi([Op.create("/via-multi", b"")])
+                assert reader.last_zxid == ens.state.zxid
             finally:
                 await reader.close()
                 await writer.close()

@@ -57,6 +57,18 @@ class TestReleaseArtifact:
         license_text = (root / "LICENSE").read_text()
         assert "Mozilla Public License Version 2.0" in license_text
 
+        # Both console scripts are declared for pip installs: the daemon
+        # and the operator CLI (the zkCli.sh workflow, reference
+        # README.md:785-807) — and each target is importable/callable.
+        pyproject = (root / "pyproject.toml").read_text()
+        assert 'registrar = "registrar_tpu.main:main"' in pyproject
+        assert (
+            'registrar-zkcli = "registrar_tpu.tools.zkcli:main"' in pyproject
+        )
+        from registrar_tpu.tools.zkcli import main as zkcli_main
+
+        assert callable(zkcli_main)
+
         # The shipped SMF manifest is generated from the .xml.in template
         # (reference Makefile:19): valid XML, fully substituted, and its
         # paths point into the install prefix.
