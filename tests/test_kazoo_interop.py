@@ -1,0 +1,239 @@
+"""Independent client-oracle interop: kazoo <-> this repo's ZK client.
+
+Round-4 verdict #4: the golden wire frames and the hermetic server are
+both authored by this repo, so they can only prove self-consistency.
+kazoo — the de-facto Python ZooKeeper client, with its own independent
+jute implementation — is an oracle this repo did not write.  Each test
+here drives one side with kazoo and the other side with
+``registrar_tpu.zk.client`` against a *real* ZooKeeper (the reference's
+own test dependency, reference test/helper.js:57-62), so any wire-format
+or semantics divergence surfaces as a byte-level mismatch.
+
+Requires both a live ZooKeeper (``ZK_HOST``/``ZK_PORT``) and kazoo
+installed; skipped otherwise.  The ``real-zk`` CI job provides both.
+"""
+
+import asyncio
+import os
+import threading
+import uuid
+
+import pytest
+
+kazoo_client_mod = pytest.importorskip(
+    "kazoo.client", reason="kazoo not installed (pip install kazoo)"
+)
+from kazoo.client import KazooClient  # noqa: E402
+
+from registrar_tpu.records import parse_payload  # noqa: E402
+from registrar_tpu.registration import register, unregister  # noqa: E402
+from registrar_tpu.zk.client import Op, ZKClient  # noqa: E402
+from registrar_tpu.zk.protocol import (  # noqa: E402
+    Err,
+    ZKError,
+    creator_all_acl,
+)
+
+pytestmark = pytest.mark.skipif(
+    not os.environ.get("ZK_HOST"),
+    reason="set ZK_HOST (and optionally ZK_PORT) to run kazoo interop tests",
+)
+
+
+def _servers():
+    return [(os.environ["ZK_HOST"], int(os.environ.get("ZK_PORT", "2181")))]
+
+
+def _hosts_str():
+    host, port = _servers()[0]
+    return f"{host}:{port}"
+
+
+@pytest.fixture
+def kz():
+    client = KazooClient(hosts=_hosts_str())
+    client.start(timeout=20)
+    yield client
+    try:
+        client.stop()
+    finally:
+        client.close()
+
+
+class TestKazooInterop:
+    async def test_kazoo_writes_our_client_reads(self, kz):
+        base = f"/kazoo-interop-{uuid.uuid4().hex[:8]}"
+        payload = b'{"written-by":"kazoo","n":1}'
+        await asyncio.to_thread(kz.create, base, b"parent")
+        await asyncio.to_thread(kz.create, f"{base}/eph", payload,
+                                ephemeral=True)
+        ours = await ZKClient(_servers()).connect()
+        try:
+            # Payload byte-equality through our decoder.
+            data, stat = await ours.get(f"{base}/eph")
+            assert data == payload
+            # The ephemeral owner is kazoo's session, decoded by us.
+            assert stat.ephemeral_owner == kz.client_id[0]
+            assert await ours.get_children(base) == ["eph"]
+            parent, pstat = await ours.get(base)
+            assert parent == b"parent"
+            assert pstat.ephemeral_owner == 0
+        finally:
+            await ours.close()
+        await asyncio.to_thread(kz.delete, base, recursive=True)
+
+    async def test_our_registration_read_by_kazoo(self, kz):
+        # The full registration pipeline's znodes, read back through the
+        # independent client: payloads byte-identical, ephemerals owned
+        # by our session.
+        domain = f"kz-{uuid.uuid4().hex[:8]}.interop.registrar"
+        ours = await ZKClient(_servers()).connect()
+        try:
+            nodes = await register(
+                ours,
+                {
+                    "domain": domain,
+                    "type": "load_balancer",
+                    "service": {
+                        "type": "service",
+                        "service": {
+                            "srvce": "_http", "proto": "_tcp", "port": 80,
+                        },
+                    },
+                },
+                admin_ip="10.250.1.1",
+                hostname="kazoohost",
+                settle_delay=0.05,
+            )
+            for n in nodes:
+                our_data, our_stat = await ours.get(n)
+                kz_data, kz_stat = await asyncio.to_thread(kz.get, n)
+                assert kz_data == our_data  # byte equality across clients
+                assert kz_stat.ephemeralOwner == our_stat.ephemeral_owner
+                assert kz_stat.mzxid == our_stat.mzxid
+                payload = parse_payload(kz_data)
+                assert payload["type"] in ("load_balancer", "service")
+            await unregister(ours, nodes)
+            for n in nodes:
+                assert await asyncio.to_thread(kz.exists, n) is None
+            # clean the persistent parent chain
+            for p in sorted({n.rsplit("/", 1)[0] for n in nodes},
+                            key=len, reverse=True):
+                while p and p != "/":
+                    try:
+                        await ours.unlink(p)
+                    except Exception:  # noqa: BLE001 - shared parents stay
+                        break
+                    p = p.rsplit("/", 1)[0]
+        finally:
+            await ours.close()
+
+    async def test_watch_delivery_both_directions(self, kz):
+        path = f"/kazoo-interop-watch-{uuid.uuid4().hex[:8]}"
+        ours = await ZKClient(_servers()).connect()
+        try:
+            await ours.create(path, b"v0")
+
+            # kazoo writes -> our watch fires.
+            our_event = asyncio.Event()
+            loop = asyncio.get_running_loop()
+            ours.watch(
+                path,
+                lambda ev: loop.call_soon_threadsafe(our_event.set),
+            )
+            await ours.stat(path, watch=True)
+            await asyncio.to_thread(kz.set, path, b"v1")
+            await asyncio.wait_for(our_event.wait(), timeout=10)
+
+            # our client writes -> kazoo's watch fires.
+            kz_event = threading.Event()
+            await asyncio.to_thread(
+                kz.get, path, lambda ev: kz_event.set()
+            )
+            await ours.set_data(path, b"v2")
+            assert await asyncio.to_thread(kz_event.wait, 10)
+
+            await ours.unlink(path)
+        finally:
+            await ours.close()
+
+    async def test_acl_round_trip_across_clients(self, kz):
+        from kazoo.exceptions import NoAuthError
+        from kazoo.security import make_digest_acl
+
+        path = f"/kazoo-interop-acl-{uuid.uuid4().hex[:8]}"
+        ours = await ZKClient(_servers()).connect()
+        try:
+            # Our digest formula must be accepted by real ZK *and* match
+            # what kazoo computes for the same user:password.
+            await ours.add_auth("digest", b"oracle:secret")
+            await ours.create(
+                path, b"locked", acls=creator_all_acl("oracle", "secret")
+            )
+
+            with pytest.raises(NoAuthError):
+                await asyncio.to_thread(kz.get, path)
+
+            await asyncio.to_thread(kz.add_auth, "digest", "oracle:secret")
+            data, _ = await asyncio.to_thread(kz.get, path)
+            assert data == b"locked"
+
+            kz_acls, _ = await asyncio.to_thread(kz.get_acl, path)
+            expected = make_digest_acl("oracle", "secret", all=True)
+            assert len(kz_acls) == 1
+            assert kz_acls[0].id == expected.id  # identical digest bytes
+            assert kz_acls[0].perms == expected.perms
+
+            # Reverse direction: kazoo-created ACL node, our auth reads.
+            path2 = f"{path}-rev"
+            await asyncio.to_thread(
+                kz.create, path2, b"kz-locked",
+                [make_digest_acl("oracle", "secret", all=True)],
+            )
+            stranger = await ZKClient(_servers()).connect()
+            try:
+                with pytest.raises(ZKError) as exc:
+                    await stranger.get(path2)
+                assert exc.value.code == Err.NO_AUTH
+                await stranger.add_auth("digest", b"oracle:secret")
+                assert (await stranger.get(path2))[0] == b"kz-locked"
+                await stranger.unlink(path2)
+            finally:
+                await stranger.close()
+            await ours.unlink(path)
+        finally:
+            await ours.close()
+
+    async def test_multi_both_directions(self, kz):
+        base = f"/kazoo-interop-multi-{uuid.uuid4().hex[:8]}"
+        ours = await ZKClient(_servers()).connect()
+        try:
+            # Our multi, observed by kazoo.
+            await ours.multi([
+                Op.create(base, b""),
+                Op.create(f"{base}/a", b"one"),
+                Op.set_data(f"{base}/a", b"two"),
+            ])
+            data, _ = await asyncio.to_thread(kz.get, f"{base}/a")
+            assert data == b"two"
+
+            # kazoo's transaction, observed by us.
+            def kz_txn():
+                t = kz.transaction()
+                t.create(f"{base}/b", b"three")
+                t.set_data(f"{base}/a", b"four")
+                return t.commit()
+
+            results = await asyncio.to_thread(kz_txn)
+            assert not any(isinstance(r, Exception) for r in results)
+            assert (await ours.get(f"{base}/b"))[0] == b"three"
+            assert (await ours.get(f"{base}/a"))[0] == b"four"
+
+            await ours.multi([
+                Op.delete(f"{base}/a"),
+                Op.delete(f"{base}/b"),
+                Op.delete(base),
+            ])
+            assert await asyncio.to_thread(kz.exists, base) is None
+        finally:
+            await ours.close()
