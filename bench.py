@@ -267,28 +267,38 @@ async def _bench() -> dict:
             )
 
         # Watch fan-out: 50 sessions watching one node; time from a
-        # write to the last notification arriving.
+        # write to the last notification arriving.  Median of 5 rounds —
+        # a single ~1.5 ms shot is scheduler-noise-dominated the same way
+        # the concurrency burst was (docs/PERF.md), and the gate pins
+        # this metric.
         watchers = [
             await ZKClient([server.address]).connect() for _ in range(50)
         ]
         try:
             await client.put("/fanout", b"v0")
-            notified = asyncio.Event()
-            pending = len(watchers)
+            # One persistent listener per watcher (client listeners are
+            # not one-shot); each round re-arms the server-side watch and
+            # resets the shared countdown.
+            state = {"pending": 0, "notified": None}
 
             def on_event(_ev):
-                nonlocal pending
-                pending -= 1
-                if pending == 0:
-                    notified.set()
+                state["pending"] -= 1
+                if state["pending"] == 0:
+                    state["notified"].set()
 
             for wcl in watchers:
                 wcl.watch("/fanout", on_event)
-                await wcl.get("/fanout", watch=True)
-            t0 = time.perf_counter()
-            await client.set_data("/fanout", b"v1")
-            await asyncio.wait_for(notified.wait(), timeout=10)
-            fanout_ms = (time.perf_counter() - t0) * 1000.0
+            fanout_rounds = []
+            for rnd in range(5):
+                state["pending"] = len(watchers)
+                state["notified"] = asyncio.Event()
+                for wcl in watchers:
+                    await wcl.get("/fanout", watch=True)
+                t0 = time.perf_counter()
+                await client.set_data("/fanout", f"v{rnd + 1}".encode())
+                await asyncio.wait_for(state["notified"].wait(), timeout=10)
+                fanout_rounds.append((time.perf_counter() - t0) * 1000.0)
+            fanout_ms = sorted(fanout_rounds)[len(fanout_rounds) // 2]
         finally:
             for wcl in watchers:
                 await wcl.close()

@@ -104,6 +104,26 @@ class TestPrimitives:
         with pytest.raises(JuteError):
             Reader(b"\xff\xff\xff\xfe").read_vector(Reader.read_int)
 
+    def test_truncation_mid_stream_consumes_nothing(self):
+        # The unpack_from fast path must behave exactly like the slicing
+        # one at the boundary: a failed primitive read raises without
+        # advancing the cursor.
+        r = Reader(b"\x00\x00\x00\x01\x00\x00")
+        assert r.read_int() == 1
+        with pytest.raises(JuteError):
+            r.read_int()
+        assert r.pos == 4
+        with pytest.raises(JuteError):
+            Reader(b"\x00" * 7).read_long()
+
+    def test_mutable_buffer_payload_is_pinned(self):
+        # bytes are appended without copying; mutable payloads must still
+        # be snapshotted at write time.
+        buf = bytearray(b"abc")
+        w = Writer().write_buffer(buf)
+        buf[0] = ord("z")
+        assert w.to_bytes() == b"\x00\x00\x00\x03abc"
+
 
 class TestRecords:
     def test_connect_request_golden(self):
