@@ -1958,6 +1958,45 @@ class ZKEnsemble:
         return node
 
 
+async def _ctl_conn(ens: "ZKEnsemble", size: int, reader, writer) -> None:
+    """One ensemble-control connection (see --ctl-port): line-oriented
+    'stop N' / 'start N' / 'lag N MS' commands, N 1-based to match the
+    CI zkctl convention (tests/test_real_zk_ensemble.py)."""
+    try:
+        while True:
+            line = await reader.readline()
+            if not line:
+                return
+            parts = line.decode("ascii", errors="replace").split()
+            try:
+                action = parts[0]
+                member = int(parts[1]) - 1
+                if not 0 <= member < size:
+                    raise ValueError(f"member {parts[1]} out of range")
+                if action == "stop":
+                    await ens.kill(member)
+                elif action == "start":
+                    await ens.restart(member)
+                elif action == "lag":
+                    ens.set_lag(member, int(parts[2]))
+                else:
+                    raise ValueError(f"unknown action {action!r}")
+            except (IndexError, ValueError) as e:
+                writer.write(f"err {e}\n".encode())
+            except Exception as e:  # noqa: BLE001 - report, keep serving
+                writer.write(f"err {e!r}\n".encode())
+            else:
+                writer.write(b"ok\n")
+            await writer.drain()
+    except (ConnectionError, OSError):
+        pass
+    finally:
+        try:
+            writer.close()
+        except Exception:  # noqa: BLE001
+            pass
+
+
 async def _amain(argv=None) -> None:
     parser = argparse.ArgumentParser(
         description="standalone in-process ZooKeeper test server"
@@ -1992,6 +2031,15 @@ async def _amain(argv=None) -> None:
         "--ensemble > 1).  Reads through that member return stale data "
         "until a client issues sync() on it — rehearses ZKClient.sync's "
         "read barrier from the command line",
+    )
+    parser.add_argument(
+        "--ctl-port", type=int, default=0, metavar="PORT",
+        help="(ensemble only) listen on PORT for line-oriented member "
+        "control: 'stop N' / 'start N' / 'lag N MS' with N 1-based, "
+        "answered with 'ok' or 'err <reason>'.  Lets the real-ensemble "
+        "interop suite (tests/test_real_zk_ensemble.py, "
+        "ZK_ENSEMBLE_CTL=host:port) drive failover against this hermetic "
+        "ensemble exactly as CI drives it against Apache ZooKeeper",
     )
     args = parser.parse_args(argv)
     logging.basicConfig(level=logging.DEBUG)
@@ -2035,9 +2083,23 @@ async def _amain(argv=None) -> None:
             print(f"member {member} lagging (apply delay {ms} ms)", flush=True)
         hosts = ",".join(f"{h}:{p}" for h, p in ens.addresses)
         print(f"zk test ensemble listening on {hosts}", flush=True)
+        ctl_server = None
+        if args.ctl_port:
+            ctl_server = await asyncio.start_server(
+                lambda r, w: _ctl_conn(ens, args.ensemble, r, w),
+                args.host,
+                args.ctl_port,
+            )
+            print(
+                f"ensemble control listening on {args.host}:{args.ctl_port}",
+                flush=True,
+            )
         try:
             await stopping.wait()
         finally:
+            if ctl_server is not None:
+                ctl_server.close()
+                await ctl_server.wait_closed()
             await ens.stop()
         return
 

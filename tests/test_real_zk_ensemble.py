@@ -15,8 +15,13 @@ Configuration (the ``real-zk`` CI job provides all of it):
     (e.g. ``127.0.0.1:2181,127.0.0.1:2182,127.0.0.1:2183``).
     Unset -> the whole module skips.
 ``ZK_ENSEMBLE_CTL``
-    Optional path to a control script accepting ``<start|stop> <n>``
-    (1-based member index) so tests can kill and revive members.
+    Optional member-control endpoint so tests can kill and revive
+    members.  Either a path to an executable accepting
+    ``<start|stop> <n>`` (1-based member index — CI's ``zkctl`` script
+    over Apache ZooKeeper), or ``host:port`` of the hermetic ensemble's
+    ``--ctl-port`` listener (``python -m registrar_tpu.testing.server
+    --ensemble 3 --ctl-port ...``), which speaks the same commands as
+    newline-terminated lines answered with ``ok``/``err``.
     Unset -> only the member-killing tests skip.
 """
 
@@ -48,6 +53,18 @@ def _hosts():
 
 async def _ctl(action: str, index_1based: int) -> None:
     ctl = os.environ["ZK_ENSEMBLE_CTL"]
+    if ":" in ctl and "/" not in ctl:
+        # host:port of a --ctl-port listener (hermetic ensemble).
+        host, _, port = ctl.rpartition(":")
+        reader, writer = await asyncio.open_connection(host, int(port))
+        try:
+            writer.write(f"{action} {index_1based}\n".encode())
+            await writer.drain()
+            line = await asyncio.wait_for(reader.readline(), timeout=60)
+            assert line.strip() == b"ok", (action, index_1based, line)
+        finally:
+            writer.close()
+        return
     proc = await asyncio.to_thread(
         subprocess.run,
         [ctl, action, str(index_1based)],
