@@ -983,6 +983,15 @@ class ZKServer:
                 stale: Optional[ZNode] = self._resolve(path, stale_root)
             except KeyError:
                 stale = None
+            # A create logged after the freeze while the stale view had
+            # the node means a delete+recreate happened inside the lag
+            # window: the first backlog event an armed watch is owed is
+            # the NODE_DELETED (one-shot watches consume it), not the
+            # net data/children diff.
+            recreated = (
+                stale is not None
+                and self._state.lag_creates.get(path, -1) > frozen_zxid
+            )
             ev: Optional[int] = None
             if kind == _WATCH_EXIST:
                 if live is not None:
@@ -993,12 +1002,12 @@ class ZKServer:
                     # backlog contains the create this watch is owed.
                     ev = EventType.NODE_CREATED
             elif kind == _WATCH_DATA:
-                if live is None:
+                if live is None or recreated:
                     ev = EventType.NODE_DELETED
                 elif stale is not None and live.mzxid != stale.mzxid:
                     ev = EventType.NODE_DATA_CHANGED
             elif kind == _WATCH_CHILD:
-                if live is None:
+                if live is None or recreated:
                     ev = EventType.NODE_DELETED
                 elif stale is not None and live.cversion != stale.cversion:
                     ev = EventType.NODE_CHILDREN_CHANGED

@@ -467,6 +467,36 @@ class TestReplicationLag:
                 await reader.close()
                 await writer.close()
 
+    async def test_delete_and_recreate_in_lag_window_fires_deleted(self):
+        # The node existed in the frozen view, then was deleted AND
+        # recreated inside the lag window.  The first backlog event the
+        # armed (one-shot) data watch is owed is NODE_DELETED — a plain
+        # mzxid diff would mislabel it NODE_DATA_CHANGED and promise the
+        # node still exists at a moment the real history had it gone.
+        async with ZKEnsemble(2) as ens:
+            writer = await ZKClient([ens.addresses[0]]).connect()
+            reader = await ZKClient([ens.addresses[1]]).connect()
+            try:
+                await writer.create("/dr", b"v0")
+                ens.set_lag(1, 60_000)
+                await writer.put("/seed", b"freeze")  # member 1 freezes
+                await writer.unlink("/dr")
+                await writer.create("/dr", b"v1")  # same path, new node
+                events = []
+                reader.watch("/dr", events.append)
+                # Stale view still shows the original node; arms a data
+                # watch whose guarded transitions already committed.
+                assert (await reader.get("/dr", watch=True))[0] == b"v0"
+                await reader.sync("/")
+                for _ in range(100):
+                    if events:
+                        break
+                    await asyncio.sleep(0.02)
+                assert [e.type for e in events] == [EventType.NODE_DELETED]
+            finally:
+                await reader.close()
+                await writer.close()
+
     async def test_write_multi_via_lagging_member_stamps_applied_zxid(self):
         # Like CREATE/DELETE/SETDATA, a write multi served by a lagging
         # member catches the member up BEFORE the reply is encoded: the
