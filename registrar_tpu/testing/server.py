@@ -2042,11 +2042,11 @@ async def _amain(argv=None) -> None:
         "read barrier from the command line",
     )
     parser.add_argument(
-        "--ctl-port", type=int, default=0, metavar="PORT",
-        help="(ensemble only) listen on PORT for line-oriented member "
-        "control: 'stop N' / 'start N' / 'lag N MS' with N 1-based, "
-        "answered with 'ok' or 'err <reason>'.  Lets the real-ensemble "
-        "interop suite (tests/test_real_zk_ensemble.py, "
+        "--ctl-port", type=int, default=None, metavar="PORT",
+        help="(ensemble only) listen on PORT (0 = pick a free one) for "
+        "line-oriented member control: 'stop N' / 'start N' / 'lag N MS' "
+        "with N 1-based, answered with 'ok' or 'err <reason>'.  Lets the "
+        "real-ensemble interop suite (tests/test_real_zk_ensemble.py, "
         "ZK_ENSEMBLE_CTL=host:port) drive failover against this hermetic "
         "ensemble exactly as CI drives it against Apache ZooKeeper",
     )
@@ -2056,6 +2056,8 @@ async def _amain(argv=None) -> None:
         parser.error("--snapshot-file is standalone-only (use --ensemble 1)")
     if args.lag and args.ensemble <= 1:
         parser.error("--lag requires --ensemble > 1")
+    if args.ctl_port is not None and args.ensemble <= 1:
+        parser.error("--ctl-port requires --ensemble > 1")
     lags = []
     for spec in args.lag:
         member_s, _, ms_s = spec.partition(":")
@@ -2093,22 +2095,26 @@ async def _amain(argv=None) -> None:
         hosts = ",".join(f"{h}:{p}" for h, p in ens.addresses)
         print(f"zk test ensemble listening on {hosts}", flush=True)
         ctl_server = None
-        if args.ctl_port:
+        if args.ctl_port is not None:
             ctl_server = await asyncio.start_server(
                 lambda r, w: _ctl_conn(ens, args.ensemble, r, w),
                 args.host,
                 args.ctl_port,
             )
+            ctl_port = ctl_server.sockets[0].getsockname()[1]
             print(
-                f"ensemble control listening on {args.host}:{args.ctl_port}",
+                f"ensemble control listening on {args.host}:{ctl_port}",
                 flush=True,
             )
         try:
             await stopping.wait()
         finally:
+            # close() only — on 3.12 Server.wait_closed() blocks until
+            # every handler transport reports closed, which can outlive
+            # a ctl client that already disconnected; this is process
+            # shutdown, there is nothing to flush.
             if ctl_server is not None:
                 ctl_server.close()
-                await ctl_server.wait_closed()
             await ens.stop()
         return
 

@@ -209,6 +209,53 @@ class TestSnapshotCli:
             proc.send_signal(signal.SIGTERM)
             proc.wait(timeout=15)
 
+    async def test_ensemble_cli_ctl_port_controls_members(self):
+        # `--ctl-port`: the line protocol the real-ensemble interop suite
+        # uses (ZK_ENSEMBLE_CTL=host:port) to kill/revive members —
+        # 'stop N' / 'start N' 1-based, 'ok'/'err' replies, bad input
+        # answered without dropping the connection.
+        proc, addrs, _ = await _spawn_server_cli(
+            "--ensemble", "2", "--ctl-port", "0"
+        )
+        try:
+            loop = asyncio.get_running_loop()
+            line = await loop.run_in_executor(None, proc.stdout.readline)
+            assert "ensemble control listening on" in line
+            host, _, port = line.split()[-1].rpartition(":")
+            reader, writer = await asyncio.open_connection(host, int(port))
+            try:
+                async def ctl(cmd: str) -> bytes:
+                    writer.write(cmd.encode() + b"\n")
+                    await writer.drain()
+                    return await asyncio.wait_for(reader.readline(), 10)
+
+                assert await ctl("stop 2") == b"ok\n"
+                with pytest.raises((ConnectionError, OSError)):
+                    await ZKClient([addrs[1]], reconnect=False).connect()
+                assert await ctl("start 2") == b"ok\n"
+                c = await ZKClient([addrs[1]]).connect()
+                await c.close()
+                # Errors are reported, and the connection keeps serving.
+                assert (await ctl("flip 1")).startswith(b"err")
+                assert (await ctl("stop 99")).startswith(b"err")
+                assert (await ctl("stop")).startswith(b"err")
+                assert await ctl("stop 1") == b"ok\n"
+            finally:
+                writer.close()
+        finally:
+            proc.send_signal(signal.SIGTERM)
+            proc.wait(timeout=15)
+
+    async def test_ctl_port_rejected_without_ensemble(self):
+        out = subprocess.run(
+            [sys.executable, "-m", "registrar_tpu.testing.server",
+             "--ctl-port", "0"],
+            cwd=REPO, capture_output=True, text=True, timeout=30,
+            env={**os.environ, "PYTHONPATH": REPO},
+        )
+        assert out.returncode == 2
+        assert "--ctl-port requires --ensemble" in out.stderr
+
     async def test_lag_flag_rejected_without_ensemble(self):
         # Any member index gets the same clear message (the ensemble
         # check is hoisted above the per-spec range check).
