@@ -235,6 +235,9 @@ class TestSnapshotCli:
                 assert await ctl("start 2") == b"ok\n"
                 c = await ZKClient([addrs[1]]).connect()
                 await c.close()
+                # lag N MS: the set_lag surface over the same protocol.
+                assert await ctl("lag 2 60000") == b"ok\n"
+                assert await ctl("lag 2 0") == b"ok\n"
                 # Errors are reported, and the connection keeps serving.
                 assert (await ctl("flip 1")).startswith(b"err")
                 assert (await ctl("stop 99")).startswith(b"err")
