@@ -152,6 +152,55 @@ class TestOps:
             await client.close()
             await server.stop()
 
+    async def test_mkdirp_pipelined_edge_shapes(self):
+        # The pipelined mkdirp (one drain for all ancestor creates) must
+        # keep the sequential walk's outcome across the shapes that
+        # matter: depth 1, deep chains, shared prefixes, repeats, and
+        # many clients racing overlapping paths.
+        server, client = await _pair()
+        try:
+            await client.mkdirp("/solo")
+            assert (await client.stat("/solo")).ephemeral_owner == 0
+            deep = "/" + "/".join(f"d{i}" for i in range(8))
+            await client.mkdirp(deep)
+            assert await client.exists(deep) is not None
+            # Shared prefix: only the new suffix is created, prefix stats
+            # (cversion bumps aside) are untouched.
+            before = await client.stat("/d0/d1")
+            await client.mkdirp("/d0/d1/other/branch")
+            after = await client.stat("/d0/d1")
+            assert after.version == before.version  # data untouched
+            assert await client.exists("/d0/d1/other/branch") is not None
+
+            # Concurrent overlapping mkdirps from independent sessions:
+            # every NODE_EXISTS race inside the fan-out must be absorbed.
+            racers = [
+                await ZKClient([server.address]).connect() for _ in range(8)
+            ]
+            try:
+                await asyncio.gather(
+                    *(
+                        c.mkdirp(f"/race/shared/deep/c{i % 3}")
+                        for i, c in enumerate(racers)
+                    )
+                )
+            finally:
+                for c in racers:
+                    await c.close()
+            kids = sorted(await client.get_children("/race/shared/deep"))
+            assert kids == ["c0", "c1", "c2"]
+
+            # A mid-chain failure reports the root cause: the parent is
+            # an ephemeral node, so the child create under it fails with
+            # NO_CHILDREN_FOR_EPHEMERALS (not the cascaded NO_NODE).
+            await client.create("/eph", b"", CreateFlag.EPHEMERAL)
+            with pytest.raises(ZKError) as ei:
+                await client.mkdirp("/eph/below/further")
+            assert ei.value.code == Err.NO_CHILDREN_FOR_EPHEMERALS
+        finally:
+            await client.close()
+            await server.stop()
+
     async def test_ephemeral_plus_creates_missing_parent(self):
         server, client = await _pair()
         try:
