@@ -36,6 +36,7 @@ from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Set, Tuple
 
 from registrar_tpu.zk import protocol as proto
+from registrar_tpu.zk.framing import FrameReader
 from registrar_tpu.zk.jute import Reader, Writer
 from registrar_tpu.zk.protocol import Err, EventType, KeeperState, OpCode, Stat
 from registrar_tpu.zk.quota import (
@@ -105,6 +106,11 @@ class Session:
         return self.conn is not None
 
 
+#: Reply-batching cap: flush at least every this-many queued replies even
+#: mid-burst (see the request loop in ZKServer._serve).
+_MAX_QUEUED = 256
+
+
 class _Connection:
     """One client TCP connection (carries at most one session)."""
 
@@ -116,16 +122,36 @@ class _Connection:
         self.closed = False
         peer = writer.get_extra_info("peername")
         self.peer_ip: Optional[str] = peer[0] if peer else None
+        self._outbuf: List[bytes] = []
+
+    def queue(self, payload: bytes) -> None:
+        """Stage a reply for the next :meth:`flush`.
+
+        The request loop queues replies while more pipelined requests
+        are already buffered and flushes once per input burst — one
+        send() syscall for a whole heartbeat sweep instead of one per
+        reply.  Order with watch events is preserved because every path
+        that emits a frame (send, send_event) drains this queue first.
+        """
+        self._outbuf.append(proto.frame(payload))
+
+    async def flush(self) -> None:
+        if self.closed or not self._outbuf:
+            self._outbuf.clear()
+            return
+        chunks, self._outbuf = self._outbuf, []
+        try:
+            self.writer.write(b"".join(chunks))
+            await self.writer.drain()
+            self.server.packets_sent += len(chunks)
+        except (ConnectionError, OSError):
+            await self.close()
 
     async def send(self, payload: bytes) -> None:
         if self.closed:
             return
-        try:
-            self.writer.write(proto.frame(payload))
-            await self.writer.drain()
-            self.server.packets_sent += 1
-        except (ConnectionError, OSError):
-            await self.close()
+        self.queue(payload)
+        await self.flush()
 
     async def send_event(self, ev_type: int, path: str) -> None:
         w = Writer()
@@ -1460,21 +1486,6 @@ class ZKServer:
 
     # -- connection handling ------------------------------------------------
 
-    async def _read_frame(
-        self, reader, header: Optional[bytes] = None
-    ) -> Optional[bytes]:
-        try:
-            hdr = header if header is not None else await reader.readexactly(4)
-        except (asyncio.IncompleteReadError, ConnectionError, OSError):
-            return None
-        length = int.from_bytes(hdr, "big", signed=True)
-        if length < 0 or length > 4 * 1024 * 1024:
-            return None
-        try:
-            return await reader.readexactly(length)
-        except (asyncio.IncompleteReadError, ConnectionError, OSError):
-            return None
-
     async def _handle_conn(self, reader, writer) -> None:
         conn = _Connection(self, reader, writer)
         self._conns.add(conn)
@@ -1497,9 +1508,9 @@ class ZKServer:
         # length-prefixed frame.  A genuine frame header is a small
         # big-endian length (<16 MiB), so its first byte is 0x00 — ASCII
         # command bytes are unambiguous.
-        try:
-            first4 = await conn.reader.readexactly(4)
-        except (asyncio.IncompleteReadError, ConnectionError, OSError):
+        frames = FrameReader(conn.reader)
+        first4 = await frames.read4()
+        if first4 is None:
             return
         if first4 in _FOUR_LETTER_WORDS:
             text = self._four_letter(first4.decode("ascii"))
@@ -1509,7 +1520,7 @@ class ZKServer:
             except (ConnectionError, OSError):
                 pass
             return
-        payload = await self._read_frame(conn.reader, header=first4)
+        payload = await frames.frame(header=first4)
         if payload is None:
             return
         req = proto.ConnectRequest.read(Reader(payload))
@@ -1560,7 +1571,7 @@ class ZKServer:
 
         # --- request loop ---
         while not conn.closed:
-            payload = await self._read_frame(conn.reader)
+            payload = await frames.frame()
             if payload is None:
                 return
             self.packets_received += 1
@@ -1574,7 +1585,12 @@ class ZKServer:
                 await conn.send(w.to_bytes())
                 return
             if self.freeze:
-                continue  # swallow the request: wedged-server simulation
+                # Swallow the request: wedged-server simulation.  Replies
+                # already generated for earlier requests in this burst
+                # predate the wedge — deliver them first, matching the
+                # pre-batching behavior where each was sent immediately.
+                await conn.flush()
+                continue
             if hdr.type == OpCode.AUTH:
                 req = proto.AuthPacket.read(r)
                 ok = self._handle_auth(req, sess)
@@ -1587,7 +1603,14 @@ class ZKServer:
                 continue
             reply = await self._dispatch(conn, sess, hdr, r)
             if reply is not None:
-                await conn.send(reply)
+                conn.queue(reply)
+            # Flush once per input burst — but also every _MAX_QUEUED
+            # replies, so a client that streams requests continuously
+            # (keeping a complete frame buffered at all times) still
+            # receives replies and the queue stays bounded; the per-reply
+            # drain this batching replaced was also the backpressure.
+            if len(conn._outbuf) >= _MAX_QUEUED or not frames.pending():
+                await conn.flush()
 
     def _establish_session(self, req: proto.ConnectRequest) -> Optional[Session]:
         if req.session_id:

@@ -45,6 +45,7 @@ from registrar_tpu.retry import (
     call_with_backoff,
 )
 from registrar_tpu.zk import protocol as proto
+from registrar_tpu.zk.framing import FrameReader
 from registrar_tpu.zk.jute import Reader, Writer
 from registrar_tpu.zk.protocol import (
     CreateFlag,
@@ -112,6 +113,7 @@ class ZKClient(EventEmitter):
         self._writer: Optional[asyncio.StreamWriter] = None
         self._xid = 0
         self._pending: Deque[Tuple[int, asyncio.Future]] = deque()
+        self._corked: Optional[List[bytes]] = None
         self._read_task: Optional[asyncio.Task] = None
         self._ping_task: Optional[asyncio.Task] = None
         self._reconnect_task: Optional[asyncio.Task] = None
@@ -363,15 +365,16 @@ class ZKClient(EventEmitter):
         return self._xid
 
     async def _read_loop(self) -> None:
-        reader = self._reader
+        # Bulk-buffered framing (registrar_tpu/zk/framing.py): one
+        # transport read per TCP burst, then dispatch every complete
+        # frame carved from the buffer.
+        frames = FrameReader(self._reader)
         try:
             while True:
-                hdr = await reader.readexactly(4)
-                length = int.from_bytes(hdr, "big", signed=True)
-                if length < 0 or length > 4 * 1024 * 1024:
-                    raise ConnectionError(f"bad frame length {length}")
-                payload = await reader.readexactly(length)
-                self._dispatch_frame(payload)
+                if not await frames.fill():
+                    raise ConnectionError("connection closed by server")
+                for payload in frames.carve():
+                    self._dispatch_frame(payload)
         except asyncio.CancelledError:
             raise
         except (asyncio.IncompleteReadError, ConnectionError, OSError) as err:
@@ -453,8 +456,28 @@ class ZKClient(EventEmitter):
             raise ZKError(Err.CONNECTION_LOSS)
         fut: asyncio.Future = asyncio.get_running_loop().create_future()
         self._pending.append((xid, fut))
-        self._writer.write(proto.encode_request(xid, op, body))
+        encoded = proto.encode_request(xid, op, body)
+        if self._corked is not None:
+            self._corked.append(encoded)
+        else:
+            self._writer.write(encoded)
         return fut
+
+    def _cork(self) -> None:
+        """Hold posted frames in a local list instead of writing each one.
+
+        ``transport.write`` eagerly issues a send() syscall per call when
+        its buffer is empty, so a 1000-post pipeline burst costs a
+        thousand syscalls; corking joins the burst into one write (one
+        or a few segments).  Callers must :meth:`_uncork` in a finally.
+        """
+        if self._corked is None:
+            self._corked = []
+
+    def _uncork(self) -> None:
+        chunks, self._corked = self._corked, None
+        if chunks and self._writer is not None:
+            self._writer.write(b"".join(chunks))
 
     async def _submit(self, xid: int, op: int, body) -> Optional[Reader]:
         fut = self._post(xid, op, body)
@@ -657,21 +680,25 @@ class ZKClient(EventEmitter):
         futs: List[asyncio.Future] = []
         post_err: Optional[BaseException] = None
         try:
-            current = ""
-            for comp in path.strip("/").split("/"):
-                current += "/" + comp
-                futs.append(
-                    self._post(
-                        self._next_xid(),
-                        OpCode.CREATE,
-                        proto.CreateRequest(
-                            path=self._abs(current),
-                            data=b"",
-                            acls=list(OPEN_ACL_UNSAFE),
-                            flags=CreateFlag.PERSISTENT,
-                        ),
+            self._cork()
+            try:
+                current = ""
+                for comp in path.strip("/").split("/"):
+                    current += "/" + comp
+                    futs.append(
+                        self._post(
+                            self._next_xid(),
+                            OpCode.CREATE,
+                            proto.CreateRequest(
+                                path=self._abs(current),
+                                data=b"",
+                                acls=list(OPEN_ACL_UNSAFE),
+                                flags=CreateFlag.PERSISTENT,
+                            ),
+                        )
                     )
-                )
+            finally:
+                self._uncork()
             if futs and self._writer is not None:
                 await self._writer.drain()
         except (ConnectionError, OSError):
@@ -819,14 +846,20 @@ class ZKClient(EventEmitter):
             futs: List[asyncio.Future] = []
             post_err: Optional[BaseException] = None
             try:
-                for n in nodes:
-                    futs.append(
-                        self._post(
-                            self._next_xid(),
-                            OpCode.EXISTS,
-                            proto.ExistsRequest(path=self._abs(n), watch=False),
+                self._cork()
+                try:
+                    for n in nodes:
+                        futs.append(
+                            self._post(
+                                self._next_xid(),
+                                OpCode.EXISTS,
+                                proto.ExistsRequest(
+                                    path=self._abs(n), watch=False
+                                ),
+                            )
                         )
-                    )
+                finally:
+                    self._uncork()
                 if futs and self._writer is not None:
                     await self._writer.drain()
             except (ConnectionError, OSError):

@@ -1,0 +1,103 @@
+"""Length-prefixed frame buffering shared by the client and the server.
+
+ZooKeeper's wire protocol frames every packet with a 4-byte big-endian
+length (reference counterpart: the zkplus stack's socket framing; the
+Apache client's ClientCnxnSocket does the same).  Both ends of this
+rebuild read in bulk — one transport ``read()`` per TCP burst — and
+carve complete frames out of a local buffer, instead of issuing two
+awaited ``readexactly()`` calls per frame.  Pipelined storms (mkdirp,
+heartbeat sweeps, registration fan-outs) land hundreds of frames per
+segment, where the per-frame await overhead was a measurable slice of
+the hot loops (docs/PERF.md).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+MAX_FRAME = 4 * 1024 * 1024  # matches real ZK's default jute.maxbuffer
+_READ_SIZE = 65536
+
+
+class FrameReader:
+    """Buffered frame carving over an ``asyncio.StreamReader``."""
+
+    __slots__ = ("_reader", "_buf")
+
+    def __init__(self, reader) -> None:
+        self._reader = reader
+        self._buf = bytearray()
+
+    async def fill(self) -> bool:
+        """One transport read into the buffer; False on EOF/conn error."""
+        try:
+            chunk = await self._reader.read(_READ_SIZE)
+        except (ConnectionError, OSError):
+            return False
+        if not chunk:
+            return False
+        self._buf += chunk
+        return True
+
+    def carve(self) -> List[bytes]:
+        """Every complete frame payload currently buffered, in order.
+
+        Raises ConnectionError on a corrupt length prefix — the stream
+        has lost framing and cannot be resynchronized.
+        """
+        buf = self._buf
+        pos, end = 0, len(buf)
+        out: List[bytes] = []
+        while end - pos >= 4:
+            length = int.from_bytes(buf[pos:pos + 4], "big", signed=True)
+            if length < 0 or length > MAX_FRAME:
+                raise ConnectionError(f"bad frame length {length}")
+            if end - pos - 4 < length:
+                break
+            out.append(bytes(buf[pos + 4:pos + 4 + length]))
+            pos += 4 + length
+        if pos:
+            del buf[:pos]
+        return out
+
+    def pending(self) -> bool:
+        """True when a complete frame is already buffered (reply batchers
+        hold their flush until the input burst is exhausted)."""
+        buf = self._buf
+        if len(buf) < 4:
+            return False
+        length = int.from_bytes(buf[:4], "big", signed=True)
+        return 0 <= length <= len(buf) - 4
+
+    async def read4(self) -> Optional[bytes]:
+        """The stream's next 4 bytes (a frame length — or a 4lw command)."""
+        while len(self._buf) < 4:
+            if not await self.fill():
+                return None
+        out = bytes(self._buf[:4])
+        del self._buf[:4]
+        return out
+
+    async def frame(self, header: Optional[bytes] = None) -> Optional[bytes]:
+        """The next complete frame payload; None on EOF or bad length.
+
+        ``header`` supplies a 4-byte length already consumed via
+        :meth:`read4` (the server handshake peeks it to disambiguate
+        4lw admin commands from the ConnectRequest frame).
+        """
+        if header is not None:
+            length = int.from_bytes(header, "big", signed=True)
+        else:
+            while len(self._buf) < 4:
+                if not await self.fill():
+                    return None
+            length = int.from_bytes(self._buf[:4], "big", signed=True)
+            del self._buf[:4]
+        if length < 0 or length > MAX_FRAME:
+            return None
+        while len(self._buf) < length:
+            if not await self.fill():
+                return None
+        out = bytes(self._buf[:length])
+        del self._buf[:length]
+        return out

@@ -44,6 +44,15 @@ class Writer:
     def to_bytes(self) -> bytes:
         return b"".join(self._chunks)
 
+    def append_packed(self, encoded: bytes) -> "Writer":
+        """Append pre-packed big-endian bytes (a `struct.Struct.pack` of
+        several primitives at once).  The fixed-layout records (Stat, the
+        request/reply headers) pack their whole field list in one call —
+        the per-field write_int/write_long walk was the hottest encode
+        path in the wire stack."""
+        self._chunks.append(encoded)
+        return self
+
     def write_int(self, value: int) -> "Writer":
         if not INT_MIN <= value <= INT_MAX:
             raise JuteError(f"int out of range: {value}")
@@ -109,6 +118,15 @@ class Reader:
         out = self._data[self._pos : self._pos + n]
         self._pos += n
         return out
+
+    def read_struct(self, st: struct.Struct) -> tuple:
+        """Unpack a fixed-layout run of primitives in one call (the decode
+        twin of :meth:`Writer.append_packed`)."""
+        pos = self._pos
+        if len(self._data) - pos < st.size:
+            self._take(st.size)  # raises the canonical truncation error
+        self._pos = pos + st.size
+        return st.unpack_from(self._data, pos)
 
     def read_int(self) -> int:
         # unpack_from avoids the intermediate slice _take would allocate;

@@ -17,7 +17,17 @@ from __future__ import annotations
 from dataclasses import dataclass, field
 from typing import List, Optional
 
-from registrar_tpu.zk.jute import Reader, Writer
+import struct
+
+from registrar_tpu.zk.jute import JuteError, Reader, Writer
+
+# Fixed-layout records pack/unpack their whole field list in one struct
+# call — the per-field jute walk was the hottest encode/decode path in
+# the wire stack (a Stat rides every exists/getData/setData reply).
+_REQ_HDR = struct.Struct(">ii")    # xid, type
+_REPLY_HDR = struct.Struct(">iqi")  # xid, zxid, err
+_STAT = struct.Struct(">qqqqiiiqiiq")
+_LEN = struct.Struct(">i")
 
 
 # --- opcodes ---------------------------------------------------------------
@@ -232,12 +242,15 @@ class RequestHeader:
     type: int
 
     def write(self, w: Writer) -> None:
-        w.write_int(self.xid)
-        w.write_int(self.type)
+        try:
+            w.append_packed(_REQ_HDR.pack(self.xid, self.type))
+        except struct.error as e:
+            raise JuteError(str(e)) from None
 
     @classmethod
     def read(cls, r: Reader) -> "RequestHeader":
-        return cls(xid=r.read_int(), type=r.read_int())
+        xid, type_ = r.read_struct(_REQ_HDR)
+        return cls(xid=xid, type=type_)
 
 
 @dataclass
@@ -247,13 +260,15 @@ class ReplyHeader:
     err: int
 
     def write(self, w: Writer) -> None:
-        w.write_int(self.xid)
-        w.write_long(self.zxid)
-        w.write_int(self.err)
+        try:
+            w.append_packed(_REPLY_HDR.pack(self.xid, self.zxid, self.err))
+        except struct.error as e:
+            raise JuteError(str(e)) from None
 
     @classmethod
     def read(cls, r: Reader) -> "ReplyHeader":
-        return cls(xid=r.read_int(), zxid=r.read_long(), err=r.read_int())
+        xid, zxid, err = r.read_struct(_REPLY_HDR)
+        return cls(xid=xid, zxid=zxid, err=err)
 
 
 @dataclass
@@ -271,32 +286,52 @@ class Stat:
     pzxid: int = 0
 
     def write(self, w: Writer) -> None:
-        w.write_long(self.czxid)
-        w.write_long(self.mzxid)
-        w.write_long(self.ctime)
-        w.write_long(self.mtime)
-        w.write_int(self.version)
-        w.write_int(self.cversion)
-        w.write_int(self.aversion)
-        w.write_long(self.ephemeral_owner)
-        w.write_int(self.data_length)
-        w.write_int(self.num_children)
-        w.write_long(self.pzxid)
+        try:
+            w.append_packed(
+                _STAT.pack(
+                    self.czxid,
+                    self.mzxid,
+                    self.ctime,
+                    self.mtime,
+                    self.version,
+                    self.cversion,
+                    self.aversion,
+                    self.ephemeral_owner,
+                    self.data_length,
+                    self.num_children,
+                    self.pzxid,
+                )
+            )
+        except struct.error as e:
+            raise JuteError(str(e)) from None
 
     @classmethod
     def read(cls, r: Reader) -> "Stat":
+        (
+            czxid,
+            mzxid,
+            ctime,
+            mtime,
+            version,
+            cversion,
+            aversion,
+            ephemeral_owner,
+            data_length,
+            num_children,
+            pzxid,
+        ) = r.read_struct(_STAT)
         return cls(
-            czxid=r.read_long(),
-            mzxid=r.read_long(),
-            ctime=r.read_long(),
-            mtime=r.read_long(),
-            version=r.read_int(),
-            cversion=r.read_int(),
-            aversion=r.read_int(),
-            ephemeral_owner=r.read_long(),
-            data_length=r.read_int(),
-            num_children=r.read_int(),
-            pzxid=r.read_long(),
+            czxid=czxid,
+            mzxid=mzxid,
+            ctime=ctime,
+            mtime=mtime,
+            version=version,
+            cversion=cversion,
+            aversion=aversion,
+            ephemeral_owner=ephemeral_owner,
+            data_length=data_length,
+            num_children=num_children,
+            pzxid=pzxid,
         )
 
 
@@ -775,7 +810,7 @@ class _CheckResult:
 
 def frame(payload: bytes) -> bytes:
     """Prefix a payload with its 4-byte big-endian length."""
-    return Writer().write_int(len(payload)).to_bytes() + payload
+    return _LEN.pack(len(payload)) + payload
 
 
 def encode_request(xid: int, op: int, body=None) -> bytes:
@@ -816,8 +851,22 @@ class ZKError(Exception):
         super().__init__(f"{self.name} ({code})" + (f": {path}" if path else ""))
 
 
+#: Paths already validated by check_path.  The daemon's hot loops
+#: (heartbeat sweeps, the registration pipeline) re-validate the same
+#: handful of paths every pass; membership here short-circuits the
+#: per-component walk.  Bounded in count AND entry size (a wire frame
+#: can carry a multi-MiB path, and the server validates client-supplied
+#: paths — an unbounded-bytes cache would let a hostile stream pin
+#: gigabytes); validation is pure, so caching is safe.
+_VALID_PATHS: set = set()
+_VALID_PATHS_MAX = 4096
+_VALID_PATH_MAX_LEN = 256
+
+
 def check_path(path: str) -> str:
     """Validate a znode path the way ZooKeeper's PathUtils does."""
+    if type(path) is str and path in _VALID_PATHS:
+        return path
     if not isinstance(path, str) or not path:
         raise ValueError("path must be a non-empty string")
     if not path.startswith("/"):
@@ -831,4 +880,6 @@ def check_path(path: str) -> str:
             raise ValueError(f"relative path component: {path!r}")
         if "\x00" in comp:
             raise ValueError(f"null byte in path component: {path!r}")
+    if len(path) <= _VALID_PATH_MAX_LEN and len(_VALID_PATHS) < _VALID_PATHS_MAX:
+        _VALID_PATHS.add(path)
     return path

@@ -1,0 +1,163 @@
+"""Unit tests for the shared length-prefixed frame buffering.
+
+`registrar_tpu/zk/framing.py` is used by both the client's read loop and
+the server's request loop; these tests pin the carving semantics the two
+hot paths rely on (burst carving, split frames, corrupt lengths, the 4lw
+header peek, and the reply-batching `pending()` probe).
+"""
+
+import asyncio
+
+import pytest
+
+from registrar_tpu.zk.framing import MAX_FRAME, FrameReader
+
+
+def _frame(payload: bytes) -> bytes:
+    return len(payload).to_bytes(4, "big") + payload
+
+
+class _FakeReader:
+    """StreamReader stand-in serving a scripted sequence of read() chunks."""
+
+    def __init__(self, chunks):
+        self._chunks = list(chunks)
+
+    async def read(self, _n):
+        if not self._chunks:
+            return b""  # EOF
+        chunk = self._chunks.pop(0)
+        if isinstance(chunk, Exception):
+            raise chunk
+        return chunk
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+class TestCarve:
+    def test_carves_every_complete_frame_in_a_burst(self):
+        burst = _frame(b"one") + _frame(b"two") + _frame(b"")
+        fr = FrameReader(_FakeReader([burst]))
+
+        async def go():
+            assert await fr.fill()
+            return fr.carve()
+
+        assert run(go()) == [b"one", b"two", b""]
+
+    def test_frame_split_across_fills(self):
+        whole = _frame(b"split-payload")
+        fr = FrameReader(_FakeReader([whole[:5], whole[5:]]))
+
+        async def go():
+            assert await fr.fill()
+            first = fr.carve()
+            assert await fr.fill()
+            return first, fr.carve()
+
+        first, second = run(go())
+        assert first == []
+        assert second == [b"split-payload"]
+
+    def test_partial_trailing_frame_stays_buffered(self):
+        tail = _frame(b"whole") + _frame(b"partial")[:6]
+        fr = FrameReader(_FakeReader([tail]))
+
+        async def go():
+            assert await fr.fill()
+            return fr.carve(), fr.pending()
+
+        carved, pending = run(go())
+        assert carved == [b"whole"]
+        assert pending is False  # remainder is incomplete
+
+    def test_negative_length_raises_connection_error(self):
+        fr = FrameReader(_FakeReader([(-1).to_bytes(4, "big", signed=True)]))
+
+        async def go():
+            assert await fr.fill()
+            fr.carve()
+
+        with pytest.raises(ConnectionError):
+            run(go())
+
+    def test_oversized_length_raises_connection_error(self):
+        fr = FrameReader(
+            _FakeReader([(MAX_FRAME + 1).to_bytes(4, "big", signed=True)])
+        )
+
+        async def go():
+            assert await fr.fill()
+            fr.carve()
+
+        with pytest.raises(ConnectionError):
+            run(go())
+
+
+class TestPending:
+    def test_pending_only_when_complete(self):
+        whole = _frame(b"abc")
+        fr = FrameReader(_FakeReader([whole[:4], whole[4:]]))
+
+        async def go():
+            assert await fr.fill()
+            before = fr.pending()
+            assert await fr.fill()
+            return before, fr.pending()
+
+        before, after = run(go())
+        assert before is False
+        assert after is True
+
+    def test_pending_false_on_empty(self):
+        assert FrameReader(_FakeReader([])).pending() is False
+
+
+class TestFill:
+    def test_eof_returns_false(self):
+        fr = FrameReader(_FakeReader([]))
+        assert run(fr.fill()) is False
+
+    def test_connection_error_returns_false(self):
+        fr = FrameReader(_FakeReader([ConnectionResetError()]))
+        assert run(fr.fill()) is False
+
+
+class TestHandshakeHelpers:
+    def test_read4_then_frame_with_header(self):
+        # The server peeks 4 bytes to detect 4lw commands, then hands the
+        # peeked length back to frame() for the ConnectRequest.
+        payload = b"connect-record"
+        fr = FrameReader(_FakeReader([_frame(payload)]))
+
+        async def go():
+            hdr = await fr.read4()
+            return hdr, await fr.frame(header=hdr)
+
+        hdr, got = run(go())
+        assert hdr == len(payload).to_bytes(4, "big")
+        assert got == payload
+
+    def test_read4_sees_ascii_command_bytes(self):
+        fr = FrameReader(_FakeReader([b"ruok"]))
+        assert run(fr.read4()) == b"ruok"
+
+    def test_frame_returns_none_on_bad_length(self):
+        fr = FrameReader(
+            _FakeReader([(-2).to_bytes(4, "big", signed=True) + b"xx"])
+        )
+        assert run(fr.frame()) is None
+
+    def test_frame_returns_none_on_eof_mid_payload(self):
+        fr = FrameReader(_FakeReader([_frame(b"full-payload")[:7]]))
+        assert run(fr.frame()) is None
+
+    def test_sequential_frames(self):
+        fr = FrameReader(_FakeReader([_frame(b"a") + _frame(b"bb")]))
+
+        async def go():
+            return await fr.frame(), await fr.frame(), await fr.frame()
+
+        assert run(go()) == (b"a", b"bb", None)
