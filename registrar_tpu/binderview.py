@@ -24,7 +24,6 @@ tests/test_binderview.py (which pins the README's worked dig examples).
 
 from __future__ import annotations
 
-import asyncio
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional
 
@@ -96,13 +95,7 @@ def _service_ttl(record: Dict[str, Any]) -> int:
     return DEFAULT_TTL
 
 
-async def _get_record(zk: ZKClient, path: str) -> Optional[Dict[str, Any]]:
-    try:
-        data, _ = await zk.get(path)
-    except ZKError as err:
-        if err.code == Err.NO_NODE:
-            return None
-        raise
+def _record_from_bytes(data: bytes) -> Optional[Dict[str, Any]]:
     if not data:
         return None
     try:
@@ -110,6 +103,16 @@ async def _get_record(zk: ZKClient, path: str) -> Optional[Dict[str, Any]]:
     except ValueError:
         return None
     return record if isinstance(record, dict) else None
+
+
+async def _get_record(zk: ZKClient, path: str) -> Optional[Dict[str, Any]]:
+    try:
+        data, _ = await zk.get(path)
+    except ZKError as err:
+        if err.code == Err.NO_NODE:
+            return None
+        raise
+    return _record_from_bytes(data)
 
 
 def _queryable_directly(rtype: str) -> bool:
@@ -130,12 +133,15 @@ def _host_address(record: Dict[str, Any]) -> Optional[str]:
 
 
 async def _service_instances(zk: ZKClient, path: str):
-    """Fetch the usable child host records of a service node (children
-    fetched concurrently — one ZK round-trip of gets, not N)."""
+    """Fetch the usable child host records of a service node (one
+    pipelined getData burst — one write and one reply sweep, not N
+    task-scheduled round-trips)."""
     children = await zk.get_children(path)
-    records = await asyncio.gather(
-        *(_get_record(zk, f"{path}/{child}") for child in children)
-    )
+    replies = await zk.get_many(f"{path}/{child}" for child in children)
+    records = [
+        None if reply is None else _record_from_bytes(reply[0])
+        for reply in replies
+    ]
     instances = []
     for child, rec in zip(children, records):
         if rec is None or rec.get("type") == "service":

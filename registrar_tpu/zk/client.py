@@ -479,6 +479,36 @@ class ZKClient(EventEmitter):
         if chunks and self._writer is not None:
             self._writer.write(b"".join(chunks))
 
+    async def _post_pipeline(
+        self, requests: Iterable[Tuple[int, object]]
+    ) -> Tuple[List[asyncio.Future], Optional[BaseException]]:
+        """Cork-post a burst of ``(op, record)`` requests with one drain.
+
+        The pipelining skeleton shared by :meth:`mkdirp`,
+        :meth:`get_many`, and the heartbeat sweep.  Returns the reply
+        futures (FIFO, one per request) plus the not-connected ZKError
+        raised while posting, if any — by then earlier posts hold
+        pending futures the read loop will resolve (to CONNECTION_LOSS
+        on teardown), so callers must gather the futures first and then
+        decide how to rank the returned error against gathered ones.
+        """
+        futs: List[asyncio.Future] = []
+        post_err: Optional[BaseException] = None
+        try:
+            self._cork()
+            try:
+                for op, body in requests:
+                    futs.append(self._post(self._next_xid(), op, body))
+            finally:
+                self._uncork()
+            if futs and self._writer is not None:
+                await self._writer.drain()
+        except (ConnectionError, OSError):
+            await self._teardown(expected=False)
+        except ZKError as e:  # not connected: fail after draining futs
+            post_err = e
+        return futs, post_err
+
     async def _submit(self, xid: int, op: int, body) -> Optional[Reader]:
         fut = self._post(xid, op, body)
         try:
@@ -650,6 +680,40 @@ class ZKClient(EventEmitter):
         resp = proto.GetDataResponse.read(r)
         return (resp.data or b"", resp.stat)
 
+    async def get_many(
+        self, paths: Iterable[str]
+    ) -> List[Optional[Tuple[bytes, Stat]]]:
+        """Pipelined getData fan-out: one corked write, one drain, replies
+        collected in order.  Returns one entry per path — ``(data, stat)``,
+        or None where the node does not exist (NO_NODE is an expected
+        answer for a fan-out over a changing tree, e.g. the Binder-view
+        resolver reading a service's instances while members churn); any
+        other error propagates.
+        """
+        paths = list(paths)
+        for p in paths:
+            check_path(p)
+        futs, post_err = await self._post_pipeline(
+            (
+                OpCode.GET_DATA,
+                proto.GetDataRequest(path=self._abs(p), watch=False),
+            )
+            for p in paths
+        )
+        results = await asyncio.gather(*futs, return_exceptions=True)
+        out: List[Optional[Tuple[bytes, Stat]]] = []
+        for res in results:
+            if isinstance(res, ZKError) and res.code == Err.NO_NODE:
+                out.append(None)
+                continue
+            if isinstance(res, BaseException):
+                raise res
+            resp = proto.GetDataResponse.read(res)
+            out.append((resp.data or b"", resp.stat))
+        if post_err is not None:
+            raise post_err
+        return out
+
     async def get_children(self, path: str, watch: bool = False) -> List[str]:
         check_path(path)
         r = await self._call(
@@ -677,34 +741,22 @@ class ZKClient(EventEmitter):
         check_path(path)
         if path == "/":
             return
-        futs: List[asyncio.Future] = []
-        post_err: Optional[BaseException] = None
-        try:
-            self._cork()
-            try:
-                current = ""
-                for comp in path.strip("/").split("/"):
-                    current += "/" + comp
-                    futs.append(
-                        self._post(
-                            self._next_xid(),
-                            OpCode.CREATE,
-                            proto.CreateRequest(
-                                path=self._abs(current),
-                                data=b"",
-                                acls=list(OPEN_ACL_UNSAFE),
-                                flags=CreateFlag.PERSISTENT,
-                            ),
-                        )
-                    )
-            finally:
-                self._uncork()
-            if futs and self._writer is not None:
-                await self._writer.drain()
-        except (ConnectionError, OSError):
-            await self._teardown(expected=False)
-        except ZKError as e:  # not connected: fail after draining futs
-            post_err = e
+
+        def requests():
+            current = ""
+            for comp in path.strip("/").split("/"):
+                current += "/" + comp
+                yield (
+                    OpCode.CREATE,
+                    proto.CreateRequest(
+                        path=self._abs(current),
+                        data=b"",
+                        acls=list(OPEN_ACL_UNSAFE),
+                        flags=CreateFlag.PERSISTENT,
+                    ),
+                )
+
+        futs, post_err = await self._post_pipeline(requests())
         first_err: Optional[BaseException] = post_err
         for res in await asyncio.gather(*futs, return_exceptions=True):
             if (
@@ -843,29 +895,13 @@ class ZKClient(EventEmitter):
             # Pipelined: post every exists request (buffered writes), one
             # drain, then collect replies in order — no per-node Task, so
             # a 1000-znode sweep is one scheduling round, not a thousand.
-            futs: List[asyncio.Future] = []
-            post_err: Optional[BaseException] = None
-            try:
-                self._cork()
-                try:
-                    for n in nodes:
-                        futs.append(
-                            self._post(
-                                self._next_xid(),
-                                OpCode.EXISTS,
-                                proto.ExistsRequest(
-                                    path=self._abs(n), watch=False
-                                ),
-                            )
-                        )
-                finally:
-                    self._uncork()
-                if futs and self._writer is not None:
-                    await self._writer.drain()
-            except (ConnectionError, OSError):
-                await self._teardown(expected=False)
-            except ZKError as e:  # not connected: fail after draining futs
-                post_err = e
+            futs, post_err = await self._post_pipeline(
+                (
+                    OpCode.EXISTS,
+                    proto.ExistsRequest(path=self._abs(n), watch=False),
+                )
+                for n in nodes
+            )
             results = await asyncio.gather(*futs, return_exceptions=True)
             for res in results:
                 if isinstance(res, BaseException):

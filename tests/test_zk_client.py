@@ -273,6 +273,57 @@ class TestOps:
             await client.close()
             await server.stop()
 
+    async def test_get_many_aligns_results_with_paths(self):
+        server, client = await _pair()
+        try:
+            await client.create("/gm1", b"one")
+            await client.create("/gm2", b"two")
+            results = await client.get_many(["/gm1", "/absent", "/gm2"])
+            assert results[0][0] == b"one"
+            assert results[1] is None  # NO_NODE is an expected answer
+            assert results[2][0] == b"two"
+            assert results[0][1].data_length == 3
+        finally:
+            await client.close()
+            await server.stop()
+
+    async def test_get_many_rejects_malformed_paths_upfront(self):
+        server, client = await _pair()
+        try:
+            with pytest.raises(ValueError):
+                await client.get_many(["/ok", "not-absolute"])
+        finally:
+            await client.close()
+            await server.stop()
+
+    async def test_get_many_propagates_server_errors(self):
+        # Only NO_NODE maps to None; a real server error (here NO_AUTH
+        # from an ACL-protected node) must raise, not be swallowed.
+        from registrar_tpu.zk.protocol import ACL, Perms, digest_auth_id
+
+        server, client = await _pair()
+        try:
+            await client.create("/gmopen", b"x")
+            await client.create(
+                "/gmlocked",
+                b"y",
+                acls=[ACL(Perms.ALL, "digest", digest_auth_id("u", "pw"))],
+            )
+            with pytest.raises(ZKError) as ei:
+                await client.get_many(["/gmopen", "/gmlocked"])
+            assert ei.value.name == "NO_AUTH"
+        finally:
+            await client.close()
+            await server.stop()
+
+    async def test_get_many_empty(self):
+        server, client = await _pair()
+        try:
+            assert await client.get_many([]) == []
+        finally:
+            await client.close()
+            await server.stop()
+
 
 class TestHeartbeat:
     async def test_heartbeat_ok(self):
