@@ -111,6 +111,19 @@ class Session:
 _MAX_QUEUED = 256
 
 
+def _event_frame(ev_type: int, path: str) -> bytes:
+    """The framed watcher-notification packet — the ONE encoder for both
+    single-target sends and the fan-out path."""
+    w = Writer()
+    proto.ReplyHeader(
+        xid=proto.XID_NOTIFICATION, zxid=-1, err=Err.OK
+    ).write(w)
+    proto.WatcherEvent(
+        type=ev_type, state=KeeperState.SYNC_CONNECTED, path=path
+    ).write(w)
+    return proto.frame(w.to_bytes())
+
+
 class _Connection:
     """One client TCP connection (carries at most one session)."""
 
@@ -153,15 +166,33 @@ class _Connection:
         self.queue(payload)
         await self.flush()
 
+    def post_framed(self, framed: bytes) -> None:
+        """Synchronously write an already-framed packet (plus any queued
+        replies, joined in front to preserve per-connection order); the
+        caller awaits :meth:`drain` afterwards.  Lets a watch-event
+        fan-out write every watcher back-to-back without interleaved
+        awaits."""
+        if self.closed:
+            return
+        chunks, self._outbuf = self._outbuf, []
+        chunks.append(framed)
+        try:
+            self.writer.write(b"".join(chunks))
+            self.server.packets_sent += len(chunks)
+        except (ConnectionError, OSError):
+            pass  # the follow-up drain() surfaces the loss and closes
+
+    async def drain(self) -> None:
+        if self.closed:
+            return
+        try:
+            await self.writer.drain()
+        except (ConnectionError, OSError):
+            await self.close()
+
     async def send_event(self, ev_type: int, path: str) -> None:
-        w = Writer()
-        proto.ReplyHeader(
-            xid=proto.XID_NOTIFICATION, zxid=-1, err=Err.OK
-        ).write(w)
-        proto.WatcherEvent(
-            type=ev_type, state=KeeperState.SYNC_CONNECTED, path=path
-        ).write(w)
-        await self.send(w.to_bytes())
+        self.post_framed(_event_frame(ev_type, path))
+        await self.drain()
 
     async def close(self) -> None:
         if self.closed:
@@ -1061,9 +1092,18 @@ class ZKServer:
         await self._send_watch_events(conns, ev_type, path)
 
     async def _send_watch_events(self, conns, ev_type: int, path: str) -> None:
-        for conn in conns:
-            if not conn.closed:
-                await conn.send_event(ev_type, path)
+        # Fan-out shape: encode the event once, write every watcher's
+        # socket back-to-back without interleaved awaits, then drain.
+        # The serialized per-watcher send_event walk made delivery to
+        # the last of N watchers O(N) awaited round-trips.
+        targets = [c for c in conns if not c.closed]
+        if not targets:
+            return
+        framed = _event_frame(ev_type, path)
+        for conn in targets:
+            conn.post_framed(framed)
+        for conn in targets:
+            await conn.drain()
 
     def _add_watch(
         self, kind: str, path: str, conn: _Connection, stale_view: bool = False

@@ -19,7 +19,14 @@ from registrar_tpu.zk.client import (
     ZKClient,
     create_zk_client,
 )
-from registrar_tpu.zk.protocol import CreateFlag, Err, ZKError
+from registrar_tpu.zk import protocol as proto
+from registrar_tpu.zk.protocol import (
+    OPEN_ACL_UNSAFE,
+    CreateFlag,
+    Err,
+    OpCode,
+    ZKError,
+)
 
 
 async def _pair(**kw):
@@ -439,6 +446,63 @@ class TestSessions:
             await asyncio.wait_for(reconnected.wait(), timeout=10)
             assert await client.exists("/alive") is not None
         finally:
+            await client.close()
+            await server.stop()
+
+    async def test_freeze_mid_burst_delivers_pre_wedge_replies(self):
+        # Reply batching must not let a wedge (freeze) retroactively
+        # withhold replies already generated for earlier requests in the
+        # same pipelined burst: those predate the wedge and are flushed.
+        from registrar_tpu.testing.server import ZKServer
+
+        class FreezeAfterFirst(ZKServer):
+            def __init__(self, **kw):
+                super().__init__(**kw)
+                self.froze = False
+
+            async def _dispatch(self, conn, sess, hdr, r):
+                reply = await super()._dispatch(conn, sess, hdr, r)
+                if not self.froze:
+                    self.froze = True
+                    self.freeze = True
+                return reply
+
+        server = await FreezeAfterFirst().start()
+        client = await ZKClient([server.address]).connect()
+        try:
+            server.froze = False  # the handshake/connect ops don't count
+            server.freeze = False
+            # One corked burst of two creates: the server dispatches the
+            # first, wedges itself, then swallows the second.
+            client._cork()
+            try:
+                f1 = client._post(
+                    client._next_xid(), OpCode.CREATE,
+                    proto.CreateRequest(
+                        path="/pre-wedge", data=b"",
+                        acls=list(OPEN_ACL_UNSAFE),
+                        flags=CreateFlag.PERSISTENT,
+                    ),
+                )
+                f2 = client._post(
+                    client._next_xid(), OpCode.CREATE,
+                    proto.CreateRequest(
+                        path="/post-wedge", data=b"",
+                        acls=list(OPEN_ACL_UNSAFE),
+                        flags=CreateFlag.PERSISTENT,
+                    ),
+                )
+            finally:
+                client._uncork()
+            await client._writer.drain()
+            # the pre-wedge reply arrives...
+            r1 = await asyncio.wait_for(f1, timeout=5)
+            assert proto.CreateResponse.read(r1).path == "/pre-wedge"
+            # ...while the post-wedge one is swallowed by the frozen server
+            done, _pending = await asyncio.wait({f2}, timeout=0.3)
+            assert not done
+        finally:
+            server.freeze = False
             await client.close()
             await server.stop()
 
