@@ -136,6 +136,7 @@ class _Connection:
         peer = writer.get_extra_info("peername")
         self.peer_ip: Optional[str] = peer[0] if peer else None
         self._outbuf: List[bytes] = []
+        self._inflight = 0  # frames written but not yet drained/counted
 
     def queue(self, payload: bytes) -> None:
         """Stage a reply for the next :meth:`flush`.
@@ -148,17 +149,23 @@ class _Connection:
         """
         self._outbuf.append(proto.frame(payload))
 
-    async def flush(self) -> None:
-        if self.closed or not self._outbuf:
-            self._outbuf.clear()
-            return
+    def _write_out(self) -> None:
+        """Join and write everything queued; counted at the next drain."""
         chunks, self._outbuf = self._outbuf, []
+        if not chunks:
+            return
         try:
             self.writer.write(b"".join(chunks))
-            await self.writer.drain()
-            self.server.packets_sent += len(chunks)
+            self._inflight += len(chunks)
         except (ConnectionError, OSError):
-            await self.close()
+            pass  # the follow-up drain() surfaces the loss and closes
+
+    async def flush(self) -> None:
+        if self.closed:
+            self._outbuf.clear()
+            return
+        self._write_out()
+        await self.drain()
 
     async def send(self, payload: bytes) -> None:
         if self.closed:
@@ -167,28 +174,29 @@ class _Connection:
         await self.flush()
 
     def post_framed(self, framed: bytes) -> None:
-        """Synchronously write an already-framed packet (plus any queued
-        replies, joined in front to preserve per-connection order); the
-        caller awaits :meth:`drain` afterwards.  Lets a watch-event
-        fan-out write every watcher back-to-back without interleaved
-        awaits."""
+        """Synchronously write an already-framed packet (behind any queued
+        replies, preserving per-connection order); the caller awaits
+        :meth:`drain` afterwards.  Lets a watch-event fan-out write every
+        watcher back-to-back without interleaved awaits."""
         if self.closed:
             return
-        chunks, self._outbuf = self._outbuf, []
-        chunks.append(framed)
-        try:
-            self.writer.write(b"".join(chunks))
-            self.server.packets_sent += len(chunks)
-        except (ConnectionError, OSError):
-            pass  # the follow-up drain() surfaces the loss and closes
+        self._outbuf.append(framed)
+        self._write_out()
 
     async def drain(self) -> None:
+        """Await transport flow control, then account the delivered
+        frames — packets_sent counts only after a successful drain, the
+        single accounting point for both the flush and fan-out paths."""
         if self.closed:
             return
         try:
             await self.writer.drain()
         except (ConnectionError, OSError):
+            self._inflight = 0
             await self.close()
+            return
+        self.server.packets_sent += self._inflight
+        self._inflight = 0
 
     async def send_event(self, ev_type: int, path: str) -> None:
         self.post_framed(_event_frame(ev_type, path))

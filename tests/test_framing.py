@@ -161,3 +161,55 @@ class TestHandshakeHelpers:
             return await fr.frame(), await fr.frame(), await fr.frame()
 
         assert run(go()) == (b"a", b"bb", None)
+
+
+class TestReplyEventOrdering:
+    """The server-side batching invariant: an event emitted while replies
+    sit queued must drain those replies first — a watch notification may
+    never overtake the reply to an earlier request on the same
+    connection (real ZooKeeper's single outgoing queue gives the same
+    guarantee)."""
+
+    def test_send_event_drains_queued_replies_first(self):
+        from types import SimpleNamespace
+
+        from registrar_tpu.testing.server import _Connection
+        from registrar_tpu.zk.protocol import EventType
+
+        class _FakeWriter:
+            def __init__(self):
+                self.data = bytearray()
+
+            def write(self, b):
+                self.data += b
+
+            async def drain(self):
+                pass
+
+            def get_extra_info(self, _name):
+                return ("127.0.0.1", 1)
+
+        async def go():
+            writer = _FakeWriter()
+            server = SimpleNamespace(packets_sent=0)
+            conn = _Connection(server, reader=None, writer=writer)
+            conn.queue(b"reply-1")
+            conn.queue(b"reply-2")
+            await conn.send_event(EventType.NODE_DATA_CHANGED, "/watched")
+            return bytes(writer.data), server.packets_sent
+
+        data, sent = run(go())
+        # Carve the concatenated frames and check the order on the wire.
+        frames = []
+        pos = 0
+        while pos < len(data):
+            length = int.from_bytes(data[pos:pos + 4], "big")
+            frames.append(data[pos + 4:pos + 4 + length])
+            pos += 4 + length
+        assert frames[0] == b"reply-1"
+        assert frames[1] == b"reply-2"
+        # Frame 3 is the notification: ReplyHeader xid -1 (0xffffffff).
+        assert len(frames) == 3
+        assert frames[2][:4] == (-1).to_bytes(4, "big", signed=True)
+        assert b"/watched" in frames[2]
+        assert sent == 3

@@ -355,6 +355,28 @@ class TestHeartbeat:
             await server.stop()
 
 
+class TestBurstInterruption:
+    async def test_server_stop_mid_sweep_fails_cleanly(self):
+        # A 500-frame pipelined heartbeat interrupted by server death must
+        # fail with a clean error (every posted future resolved), not hang.
+        server, client = await _pair()
+        try:
+            paths = [f"/sw{i}" for i in range(500)]
+            await asyncio.gather(
+                *(client.create(p, b"", CreateFlag.EPHEMERAL) for p in paths)
+            )
+            fast = RetryPolicy(max_attempts=1, initial_delay=0.01, max_delay=0.02)
+            hb = asyncio.ensure_future(client.heartbeat(paths, retry=fast))
+            stop = asyncio.ensure_future(server.stop())
+            with pytest.raises((ZKError, ConnectionError, OSError)):
+                await asyncio.wait_for(hb, timeout=10)
+            await stop
+            assert not client._pending  # no zombie futures left behind
+        finally:
+            await client.close()
+            await server.stop()
+
+
 class TestSessions:
     async def test_ephemerals_vanish_on_close(self):
         server, client = await _pair()
