@@ -91,6 +91,17 @@ def znode_paths(
     return nodes
 
 
+async def _fanout(coros) -> None:
+    """Await a stage's parallel ops; a single op (the common host-type
+    registration: one znode, one parent) runs inline without the Task +
+    gather machinery."""
+    coros = list(coros)
+    if len(coros) == 1:
+        await coros[0]
+    elif coros:
+        await asyncio.gather(*coros)
+
+
 async def register(
     zk: ZKClient,
     registration: Mapping[str, Any],
@@ -133,7 +144,7 @@ async def register(
             if err.code != Err.NO_NODE:
                 raise
 
-    await asyncio.gather(*(_cleanup(n) for n in nodes))
+    await _fanout(_cleanup(n) for n in nodes)
 
     # Stage 2: be nice to watchers and wait for them to catch up.
     if settle_delay > 0:
@@ -141,12 +152,10 @@ async def register(
 
     # Stage 3: parent directories (parallel mkdirp).
     parents = {n.rsplit("/", 1)[0] or "/" for n in nodes}
-    await asyncio.gather(*(zk.mkdirp(p) for p in parents if p != "/"))
+    await _fanout(zk.mkdirp(p) for p in parents if p != "/")
 
     # Stage 4: ephemeral host records (parallel).
-    await asyncio.gather(
-        *(zk.create_ephemeral_plus(n, record_payload) for n in nodes)
-    )
+    await _fanout(zk.create_ephemeral_plus(n, record_payload) for n in nodes)
 
     # Stage 5: persistent service record at the domain node.
     if service_payload is not None:
