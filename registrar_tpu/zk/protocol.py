@@ -28,6 +28,7 @@ _REQ_HDR = struct.Struct(">ii")    # xid, type
 _REPLY_HDR = struct.Struct(">iqi")  # xid, zxid, err
 _STAT = struct.Struct(">qqqqiiiqiiq")
 _LEN = struct.Struct(">i")
+_PW_HDR = struct.Struct(">iiii")   # frame len, xid, type, path len
 
 
 # --- opcodes ---------------------------------------------------------------
@@ -814,7 +815,22 @@ def frame(payload: bytes) -> bytes:
 
 
 def encode_request(xid: int, op: int, body=None) -> bytes:
-    """Encode a framed request: RequestHeader + optional body record."""
+    """Encode a framed request: RequestHeader + optional body record.
+
+    The (path, watch) request shapes — EXISTS is hot loop #1's op (the
+    heartbeat sweep, SURVEY §3.2), GET_DATA the resolver's — encode in a
+    single struct pack; byte-equality with the general path is pinned by
+    tests/test_wire_golden.py.
+    """
+    t = type(body)
+    if t is ExistsRequest or t is GetDataRequest:
+        b = body.path.encode("utf-8")
+        n = len(b)
+        try:
+            head = _PW_HDR.pack(n + 13, xid, op, n)
+        except struct.error as e:
+            raise JuteError(str(e)) from None
+        return head + b + (b"\x01" if body.watch else b"\x00")
     w = Writer()
     RequestHeader(xid=xid, type=op).write(w)
     if body is not None:
@@ -823,7 +839,32 @@ def encode_request(xid: int, op: int, body=None) -> bytes:
 
 
 def encode_reply_payload(xid: int, zxid: int, err: int, body=None) -> bytes:
-    """Encode an unframed reply: ReplyHeader + body (body suppressed on error)."""
+    """Encode an unframed reply: ReplyHeader + body (body suppressed on error).
+
+    Stat-only reply bodies (exists — the heartbeat answer — and setData)
+    encode in two struct packs; byte-equality with the general path is
+    pinned by tests/test_wire_golden.py.
+    """
+    if err == Err.OK:
+        t = type(body)
+        if t is ExistsResponse or t is SetDataResponse:
+            s = body.stat
+            try:
+                return _REPLY_HDR.pack(xid, zxid, err) + _STAT.pack(
+                    s.czxid,
+                    s.mzxid,
+                    s.ctime,
+                    s.mtime,
+                    s.version,
+                    s.cversion,
+                    s.aversion,
+                    s.ephemeral_owner,
+                    s.data_length,
+                    s.num_children,
+                    s.pzxid,
+                )
+            except struct.error as e:
+                raise JuteError(str(e)) from None
     w = Writer()
     ReplyHeader(xid=xid, zxid=zxid, err=err).write(w)
     if body is not None and err == Err.OK:
