@@ -286,25 +286,28 @@ class Stat:
     num_children: int = 0
     pzxid: int = 0
 
-    def write(self, w: Writer) -> None:
+    def _packed(self) -> bytes:
+        """The wire bytes — the ONE copy of the Stat field order, shared
+        by the jute walk and the stat-only reply fast path."""
         try:
-            w.append_packed(
-                _STAT.pack(
-                    self.czxid,
-                    self.mzxid,
-                    self.ctime,
-                    self.mtime,
-                    self.version,
-                    self.cversion,
-                    self.aversion,
-                    self.ephemeral_owner,
-                    self.data_length,
-                    self.num_children,
-                    self.pzxid,
-                )
+            return _STAT.pack(
+                self.czxid,
+                self.mzxid,
+                self.ctime,
+                self.mtime,
+                self.version,
+                self.cversion,
+                self.aversion,
+                self.ephemeral_owner,
+                self.data_length,
+                self.num_children,
+                self.pzxid,
             )
         except struct.error as e:
             raise JuteError(str(e)) from None
+
+    def write(self, w: Writer) -> None:
+        w.append_packed(self._packed())
 
     @classmethod
     def read(cls, r: Reader) -> "Stat":
@@ -848,23 +851,11 @@ def encode_reply_payload(xid: int, zxid: int, err: int, body=None) -> bytes:
     if err == Err.OK:
         t = type(body)
         if t is ExistsResponse or t is SetDataResponse:
-            s = body.stat
             try:
-                return _REPLY_HDR.pack(xid, zxid, err) + _STAT.pack(
-                    s.czxid,
-                    s.mzxid,
-                    s.ctime,
-                    s.mtime,
-                    s.version,
-                    s.cversion,
-                    s.aversion,
-                    s.ephemeral_owner,
-                    s.data_length,
-                    s.num_children,
-                    s.pzxid,
-                )
+                head = _REPLY_HDR.pack(xid, zxid, err)
             except struct.error as e:
                 raise JuteError(str(e)) from None
+            return head + body.stat._packed()
     w = Writer()
     ReplyHeader(xid=xid, zxid=zxid, err=err).write(w)
     if body is not None and err == Err.OK:

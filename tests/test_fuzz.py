@@ -263,6 +263,53 @@ class TestServerFuzz:
             await server.stop()
 
 
+class TestClientFuzz:
+    async def test_client_survives_garbage_from_server(self):
+        # The inverse of the server fuzz: a server that completes the
+        # handshake then spews corrupt framing must produce a clean
+        # client teardown (close event), never a hang or a crash.
+        from registrar_tpu.zk.jute import Writer
+
+        garbage_cases = [
+            b"\xff" * 64,                      # negative frame length
+            (2**31 - 1).to_bytes(4, "big"),    # absurd length, no payload
+            bytes(random.Random(0xDEAD).randrange(256) for _ in range(48)),
+        ]
+        for garbage in garbage_cases:
+            async def handler(reader, writer, g=garbage):
+                try:
+                    hdr = await reader.readexactly(4)
+                    await reader.readexactly(int.from_bytes(hdr, "big"))
+                    w = Writer()
+                    proto.ConnectResponse(
+                        timeout_ms=6000, session_id=1, passwd=b"\x00" * 16
+                    ).write(w)
+                    writer.write(proto.frame(w.to_bytes()))
+                    await writer.drain()
+                    writer.write(g)
+                    await writer.drain()
+                    # EOF after the garbage: random bytes can form a
+                    # plausible length prefix, and waiting for the rest
+                    # of that frame is then the CORRECT client behavior —
+                    # the close is what turns it into a dead connection.
+                    writer.close()
+                except (ConnectionError, OSError, asyncio.IncompleteReadError):
+                    pass
+
+            srv = await asyncio.start_server(handler, "127.0.0.1", 0)
+            port = srv.sockets[0].getsockname()[1]
+            client = ZKClient([("127.0.0.1", port)], reconnect=False)
+            closed = asyncio.Event()
+            client.on("close", lambda *a: closed.set())  # before connect:
+            # the teardown can fire between connect() returning and any
+            # later registration, so the listener must already be armed
+            await client.connect()
+            await asyncio.wait_for(closed.wait(), timeout=5)
+            await client.close()
+            srv.close()
+            await srv.wait_closed()
+
+
 class TestChrootMapping:
     """_abs/_rel are exact inverses for any chroot and any client path."""
 
