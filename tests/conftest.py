@@ -51,8 +51,14 @@ def pytest_pyfunc_call(pyfuncitem):
             name: pyfuncitem.funcargs[name]
             for name in pyfuncitem._fixtureinfo.argnames
         }
+        # Hang guard.  The chaos storm runs CHAOS_SECONDS of churn plus a
+        # convergence pass, so its budget must scale with the requested
+        # storm length (a fixed 60 s cap silently forbids `CHAOS_SECONDS`
+        # beyond ~55) — same slack for every test, chaos just starts later.
+        budget = 60 + float(os.environ.get("CHAOS_SECONDS", 0) or 0)
+
         async def _run():
-            await asyncio.wait_for(func(**kwargs), timeout=60)
+            await asyncio.wait_for(func(**kwargs), timeout=budget)
             # One extra tick so subprocess/socket transports finish closing
             # before asyncio.run tears the loop down (avoids GC warnings).
             await asyncio.sleep(0.01)

@@ -268,8 +268,6 @@ class TestClientFuzz:
         # The inverse of the server fuzz: a server that completes the
         # handshake then spews corrupt framing must produce a clean
         # client teardown (close event), never a hang or a crash.
-        from registrar_tpu.zk.jute import Writer
-
         garbage_cases = [
             b"\xff" * 64,                      # negative frame length
             (2**31 - 1).to_bytes(4, "big"),    # absurd length, no payload
