@@ -135,6 +135,21 @@ class ACL:
 #: uses for every node it creates.
 OPEN_ACL_UNSAFE = [ACL(Perms.ALL, "world", "anyone")]
 
+
+def _encode_acl_vector(acls) -> bytes:
+    w = Writer()
+    w.write_vector(acls, lambda ww, a: a.write(ww))
+    return w.to_bytes()
+
+
+#: The default ACL vector's wire bytes — constant, so the CREATE fast
+#: path in encode_request can append it without re-encoding.  The gate
+#: compares against a snapshot taken at the same moment the blob was
+#: encoded: if anything ever mutated OPEN_ACL_UNSAFE in place, creates
+#: would fall back to the general path and still encode correctly.
+_OPEN_ACLS_SNAPSHOT = [ACL(a.perms, a.scheme, a.id) for a in OPEN_ACL_UNSAFE]
+_OPEN_ACL_BLOB = _encode_acl_vector(_OPEN_ACLS_SNAPSHOT)
+
 #: read-only for everyone (ZooKeeper's ZooDefs.Ids.READ_ACL_UNSAFE).
 READ_ACL_UNSAFE = [ACL(Perms.READ, "world", "anyone")]
 
@@ -834,6 +849,26 @@ def encode_request(xid: int, op: int, body=None) -> bytes:
         except struct.error as e:
             raise JuteError(str(e)) from None
         return head + b + (b"\x01" if body.watch else b"\x00")
+    if t is CreateRequest and body.acls == _OPEN_ACLS_SNAPSHOT:
+        # The registration pipeline's op (mkdirp components + ephemeral
+        # host records) always carries the default world:anyone ACL,
+        # whose encoded vector is the precomputed _OPEN_ACL_BLOB.
+        b = body.path.encode("utf-8")
+        d = body.data
+        n = len(b)
+        m = -1 if d is None else len(d)
+        # body = xid 4 + type 4 + pathlen 4 + path n + datalen 4 +
+        #        data max(m,0) + acl blob + flags 4
+        try:
+            head = _PW_HDR.pack(
+                20 + n + (0 if m < 0 else m) + len(_OPEN_ACL_BLOB),
+                xid, op, n,
+            )
+            datalen = _LEN.pack(m)
+            flags = _LEN.pack(body.flags)
+        except struct.error as e:
+            raise JuteError(str(e)) from None
+        return head + b + datalen + (d or b"") + _OPEN_ACL_BLOB + flags
     w = Writer()
     RequestHeader(xid=xid, type=op).write(w)
     if body is not None:
