@@ -54,6 +54,24 @@ class TestChrootOps:
             await observer.close()
             await server.stop()
 
+    async def test_get_many_under_chroot(self):
+        # get_many posts its own pipelined frames (it does not go
+        # through get()), so its _abs translation needs its own pin.
+        server, client, observer = await _trio()
+        try:
+            await client.create("/gm1", b"one")
+            await client.create("/gm2", b"two")
+            results = await client.get_many(["/gm1", "/missing", "/gm2"])
+            assert results[0][0] == b"one"
+            assert results[1] is None
+            assert results[2][0] == b"two"
+            # the frames really carried the chroot-prefixed paths
+            assert (await observer.get("/app/gm1"))[0] == b"one"
+        finally:
+            await client.close()
+            await observer.close()
+            await server.stop()
+
     async def test_ephemeral_and_acl_ops_under_chroot(self):
         from registrar_tpu.zk.protocol import OPEN_ACL_UNSAFE
 
