@@ -347,6 +347,116 @@ class TestHealthIntegration:
             await client.close()
             await server.stop()
 
+    async def test_reregister_failure_on_recovery_emits_error(self):
+        # Recovery fires while ZK is unreachable: on_recover's re-register
+        # must surface the failure as an `error` event, not die silently.
+        server, client = await _pair()
+        try:
+            import os
+            import tempfile
+
+            flag = tempfile.NamedTemporaryFile(delete=False)
+            flag.close()
+            ee = _plus(
+                client,
+                heartbeat_interval=60,  # keep the heartbeat loop out of it
+                health_check={
+                    "command": f"test -f {flag.name}",
+                    "interval": 0.03,
+                    "timeout": 1.0,
+                    "threshold": 2,
+                },
+            )
+            await ee.wait_for("register", timeout=10)
+            unregistered = asyncio.Event()
+            ee.on("unregister", lambda *a: unregistered.set())
+            os.unlink(flag.name)
+            await asyncio.wait_for(unregistered.wait(), timeout=10)
+
+            errors = []
+            ee.on("error", errors.append)
+            await server.stop()  # ZK gone
+            recovered = asyncio.Event()
+            ee.on("ok", lambda *a: recovered.set())
+            open(flag.name, "w").close()  # health recovers
+            await asyncio.wait_for(recovered.wait(), timeout=10)
+            for _ in range(100):
+                if errors:
+                    break
+                await asyncio.sleep(0.05)
+            assert errors, "re-register failure must emit 'error'"
+            assert ee.down  # still down: recovery did not complete
+            ee.stop()
+            os.unlink(flag.name)
+        finally:
+            await client.close()
+            await server.stop()
+
+    async def test_unregister_failure_on_fail_emits_error(self):
+        # The deregistration itself fails (ZK unreachable): `fail` is
+        # emitted, then `error` — never a silent half-transition.
+        server, client = await _pair()
+        try:
+            import os
+            import tempfile
+
+            flag = tempfile.NamedTemporaryFile(delete=False)
+            flag.close()
+            ee = _plus(
+                client,
+                heartbeat_interval=60,
+                health_check={
+                    "command": f"test -f {flag.name}",
+                    "interval": 0.03,
+                    "timeout": 1.0,
+                    "threshold": 2,
+                },
+            )
+            await ee.wait_for("register", timeout=10)
+            errors, unregisters = [], []
+            ee.on("error", errors.append)
+            ee.on("unregister", lambda *a: unregisters.append(a))
+            failed = asyncio.Event()
+            ee.on("fail", lambda *a: failed.set())
+            await server.stop()  # ZK gone before the health flip
+            os.unlink(flag.name)
+            await asyncio.wait_for(failed.wait(), timeout=10)
+            for _ in range(100):
+                if errors:
+                    break
+                await asyncio.sleep(0.05)
+            assert errors, "failed unregister must emit 'error'"
+            assert not unregisters  # the success event must NOT fire
+            ee.stop()
+        finally:
+            await client.close()
+            await server.stop()
+
+    async def test_unknown_health_record_type_emits_error(self):
+        server, client = await _pair()
+        try:
+            ee = _plus(
+                client,
+                heartbeat_interval=60,
+                health_check={
+                    "command": "true",
+                    "interval": 0.05,
+                    "timeout": 1.0,
+                    "threshold": 2,
+                },
+            )
+            await ee.wait_for("register", timeout=10)
+            errors = []
+            ee.on("error", errors.append)
+            # emit dispatches the sync listener chain inline, so the
+            # error is observable immediately
+            ee._health.emit("data", {"type": "weird"})
+            assert errors and "weird" in str(errors[0])
+            ee.stop()
+        finally:
+            await client.close()
+            await server.stop()
+
     async def test_fleet_member_deregisters_cleanly_beside_siblings(self):
         # The production shape: several instances behind one domain with
         # a service record.  One instance health-failing must emit

@@ -1,0 +1,100 @@
+"""Unit tests for the EventEmitter backbone.
+
+`registrar_tpu/events.py` carries every daemon-facing signal (the 7-event
+orchestrator surface, client connect/close/watch events), so its full
+API — including off(), listener_count(), async listener dispatch, and
+the raise guards — is pinned here.
+"""
+
+import asyncio
+import logging
+
+from registrar_tpu.events import EventEmitter
+
+
+class TestRegistry:
+    def test_on_returns_listener_and_emit_counts(self):
+        ee = EventEmitter()
+        seen = []
+        listener = ee.on("ev", lambda *a: seen.append(a))
+        assert callable(listener)
+        assert ee.emit("ev", 1, 2) == 1
+        assert seen == [(1, 2)]
+
+    def test_once_fires_exactly_once(self):
+        ee = EventEmitter()
+        seen = []
+        ee.once("ev", lambda: seen.append("x"))
+        assert ee.emit("ev") == 1
+        assert ee.emit("ev") == 0
+        assert seen == ["x"]
+
+    def test_off_removes_from_both_registries(self):
+        ee = EventEmitter()
+
+        def listener():
+            raise AssertionError("removed listener must not fire")
+
+        ee.on("ev", listener)
+        ee.off("ev", listener)
+        assert ee.emit("ev") == 0
+
+        ee.once("ev", listener)
+        ee.off("ev", listener)
+        assert ee.emit("ev") == 0
+
+    def test_off_unknown_listener_is_noop(self):
+        EventEmitter().off("ev", lambda: None)  # must not raise
+
+    def test_listener_count_spans_both_registries(self):
+        ee = EventEmitter()
+        ee.on("ev", lambda: None)
+        ee.once("ev", lambda: None)
+        assert ee.listener_count("ev") == 2
+        assert ee.listener_count("other") == 0
+
+
+class TestDispatchGuards:
+    def test_raising_listener_does_not_break_the_rest(self, caplog):
+        ee = EventEmitter()
+        seen = []
+
+        def bad():
+            raise RuntimeError("boom")
+
+        ee.on("ev", bad)
+        ee.on("ev", lambda: seen.append("ok"))
+        with caplog.at_level(logging.ERROR, logger="registrar_tpu.events"):
+            assert ee.emit("ev") == 2
+        assert seen == ["ok"]
+        assert any("listener for" in r.message for r in caplog.records)
+
+    async def test_async_listener_runs_as_task(self):
+        ee = EventEmitter()
+        done = asyncio.Event()
+
+        async def listener(val):
+            assert val == 42
+            done.set()
+
+        ee.on("ev", listener)
+        ee.emit("ev", 42)
+        await asyncio.wait_for(done.wait(), timeout=5)
+
+    async def test_async_listener_raise_is_guarded(self, caplog):
+        ee = EventEmitter()
+
+        async def bad():
+            raise RuntimeError("async boom")
+
+        ee.on("ev", bad)
+        with caplog.at_level(logging.ERROR, logger="registrar_tpu.events"):
+            ee.emit("ev")
+            await asyncio.sleep(0.05)  # let the guard task run
+        assert any("async listener" in r.message for r in caplog.records)
+
+    async def test_wait_for_returns_emitted_args(self):
+        ee = EventEmitter()
+        loop = asyncio.get_running_loop()
+        loop.call_soon(lambda: ee.emit("ev", "a", 3))
+        assert await ee.wait_for("ev", timeout=5) == ("a", 3)

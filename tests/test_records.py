@@ -174,6 +174,67 @@ class TestServiceRecord:
             service_record(bad)
 
 
+class TestDefaultAddressFallbacks:
+    """The route-probe -> gethostbyname -> refuse ladder
+    (reference lib/register.js:22-31; the reference crashes where this
+    raises)."""
+
+    class _FailingSocket:
+        def __init__(self, *a, **kw):
+            pass
+
+        def __enter__(self):
+            return self
+
+        def __exit__(self, *a):
+            return False
+
+        def connect(self, _addr):
+            raise OSError("no route")
+
+    def test_falls_back_to_hostname_resolution(self, monkeypatch):
+        import registrar_tpu.records as records
+
+        monkeypatch.setattr(records.socket, "socket", self._FailingSocket)
+        monkeypatch.setattr(
+            records.socket, "gethostbyname", lambda _h: "198.51.100.7"
+        )
+        assert default_address() == "198.51.100.7"
+
+    def test_refuses_loopback_everywhere(self, monkeypatch):
+        import registrar_tpu.records as records
+
+        monkeypatch.setattr(records.socket, "socket", self._FailingSocket)
+        monkeypatch.setattr(
+            records.socket, "gethostbyname", lambda _h: "127.0.1.1"
+        )
+        with pytest.raises(RuntimeError):
+            default_address()
+
+    def test_raises_when_resolution_fails_too(self, monkeypatch):
+        import registrar_tpu.records as records
+
+        def boom(_h):
+            raise OSError("no resolver")
+
+        monkeypatch.setattr(records.socket, "socket", self._FailingSocket)
+        monkeypatch.setattr(records.socket, "gethostbyname", boom)
+        with pytest.raises(RuntimeError):
+            default_address()
+
+
+class TestInputTypeRejection:
+    def test_domain_must_be_str(self):
+        with pytest.raises(ValueError):
+            domain_to_path(None)
+
+    def test_host_record_type_must_be_nonempty_str(self):
+        with pytest.raises(ValueError):
+            host_record("", "10.0.0.1")
+        with pytest.raises(ValueError):
+            host_record(None, "10.0.0.1")
+
+
 class TestDefaultAddress:
     def test_returns_non_loopback_ipv4_or_raises(self):
         # In an environment with no non-loopback interface this must raise

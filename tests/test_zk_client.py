@@ -355,6 +355,28 @@ class TestHeartbeat:
             await server.stop()
 
 
+class TestConstructorValidation:
+    def test_empty_server_list_rejected(self):
+        with pytest.raises(ValueError):
+            ZKClient([])
+
+    def test_malformed_server_entries_rejected(self):
+        # A 2-tuple with the wrong field types reaches the isinstance
+        # guard itself (a "host:port" string would fail earlier, at
+        # tuple unpacking, leaving the guard uncovered).
+        with pytest.raises(ValueError):
+            ZKClient([("127.0.0.1", "2181")])  # port must be an int
+
+    async def test_add_auth_scheme_must_be_nonempty(self):
+        server, client = await _pair()
+        try:
+            with pytest.raises(ValueError):
+                await client.add_auth("", b"cred")
+        finally:
+            await client.close()
+            await server.stop()
+
+
 class TestBurstInterruption:
     async def test_server_stop_mid_sweep_fails_cleanly(self):
         # A 500-frame pipelined heartbeat interrupted by server death must
