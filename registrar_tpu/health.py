@@ -208,12 +208,22 @@ class HealthCheck(EventEmitter):
                 self._drain_capped(proc), timeout=self.timeout
             )
         except asyncio.CancelledError:
-            # stop() mid-check: don't orphan the child process.
+            # stop() mid-check: don't orphan the child process — and
+            # don't let a pipe-holder wedge the stop either.  A plain
+            # proc.wait() blocks until the stdout/stderr transports see
+            # EOF, so anything still holding the inherited pipes (the
+            # killed shell's own child, for instance) stalls cancellation
+            # for its whole lifetime; bound it exactly like the timeout
+            # path below.
             try:
                 proc.kill()
             except ProcessLookupError:
                 pass  # already exited
-            await proc.wait()
+            try:
+                await asyncio.wait_for(proc.wait(), timeout=1.0)
+            except asyncio.TimeoutError:
+                proc._transport.close()
+                await proc.wait()
             raise
         except asyncio.TimeoutError:
             # SIGTERM, matching the reference's killSignal

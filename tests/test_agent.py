@@ -373,18 +373,13 @@ class TestHealthIntegration:
             os.unlink(flag.name)
             await asyncio.wait_for(unregistered.wait(), timeout=10)
 
-            errors = []
-            ee.on("error", errors.append)
+            # arm the waiter BEFORE triggering: the error fires from the
+            # recovery task and must not be missed in between awaits
+            err_fut = asyncio.ensure_future(ee.wait_for("error", timeout=10))
             await server.stop()  # ZK gone
-            recovered = asyncio.Event()
-            ee.on("ok", lambda *a: recovered.set())
             open(flag.name, "w").close()  # health recovers
-            await asyncio.wait_for(recovered.wait(), timeout=10)
-            for _ in range(100):
-                if errors:
-                    break
-                await asyncio.sleep(0.05)
-            assert errors, "re-register failure must emit 'error'"
+            (err,) = await err_fut
+            assert err is not None, "re-register failure must emit 'error'"
             assert ee.down  # still down: recovery did not complete
             ee.stop()
             os.unlink(flag.name)
@@ -413,19 +408,13 @@ class TestHealthIntegration:
                 },
             )
             await ee.wait_for("register", timeout=10)
-            errors, unregisters = [], []
-            ee.on("error", errors.append)
+            unregisters = []
             ee.on("unregister", lambda *a: unregisters.append(a))
-            failed = asyncio.Event()
-            ee.on("fail", lambda *a: failed.set())
+            err_fut = asyncio.ensure_future(ee.wait_for("error", timeout=10))
             await server.stop()  # ZK gone before the health flip
             os.unlink(flag.name)
-            await asyncio.wait_for(failed.wait(), timeout=10)
-            for _ in range(100):
-                if errors:
-                    break
-                await asyncio.sleep(0.05)
-            assert errors, "failed unregister must emit 'error'"
+            (err,) = await err_fut
+            assert err is not None, "failed unregister must emit 'error'"
             assert not unregisters  # the success event must NOT fire
             ee.stop()
         finally:

@@ -149,6 +149,72 @@ class TestSingleChecks:
         assert time.monotonic() - t0 < 5
 
 
+class TestSingleCheckEdges:
+    async def test_stdout_match_s_flag_spans_newlines(self):
+        # JS "s" (dotAll) maps to re.DOTALL; without it the same pattern
+        # must fail across a newline.
+        dotall = HealthCheck(
+            command="printf 'a\\nb'", stdout_match={"pattern": "a.b", "flags": "s"}
+        )
+        assert (await dotall.check_once())["type"] == "ok"
+        plain = HealthCheck(
+            command="printf 'a\\nb'", stdout_match={"pattern": "a.b"}
+        )
+        assert (await plain.check_once())["type"] == "fail"
+
+    async def test_stateful_js_flags_are_ignored(self):
+        # "g"/"u"/"y" have no Python equivalent and must be tolerated
+        # (real configs carry them; the reference passes them to RegExp).
+        hc = HealthCheck(
+            command="echo hello", stdout_match={"pattern": "hell", "flags": "guy"}
+        )
+        assert (await hc.check_once())["type"] == "ok"
+
+    async def test_spawn_failure_is_a_fail_record(self, monkeypatch):
+        # OSError from process creation (fd exhaustion, fork failure)
+        # must surface as a normal fail record, not an exception.
+        import registrar_tpu.health as health_mod
+
+        async def boom(*a, **kw):
+            raise OSError("out of file descriptors")
+
+        monkeypatch.setattr(
+            health_mod.asyncio, "create_subprocess_shell", boom
+        )
+        hc = HealthCheck(command="true")
+        rec = await hc.check_once()
+        assert rec["type"] == "fail"
+        assert "failed to spawn" in str(rec["err"])
+
+    async def test_cancel_mid_check_is_prompt(self):
+        # stop() mid-check: the CancelledError must propagate PROMPTLY.
+        # A naive proc.wait() blocks until the stdout/stderr pipes see
+        # EOF, so a pipe-holder (the killed shell's own child) wedged
+        # the stop for the child's whole 30 s lifetime before the
+        # bounded wait.  The direct child is SIGKILLed; a grandchild
+        # orphaned by the dying shell can survive — the same semantics
+        # as the reference's child_process.exec kill, which also signals
+        # only the shell (lib/health.js:45-52).
+        import subprocess
+        import time
+
+        # A duration unique to this test, so the cleanup pkill cannot
+        # match anything else on a shared machine.
+        marker = "sleep 30.731897"
+        hc = HealthCheck(command=marker, timeout=60)
+        task = asyncio.ensure_future(hc.check_once())
+        await asyncio.sleep(0.3)  # let the child spawn
+        t0 = time.monotonic()
+        task.cancel()
+        with pytest.raises(asyncio.CancelledError):
+            await task
+        assert time.monotonic() - t0 < 5, "cancellation was wedged"
+        # good citizenship: reap any orphaned sleep before the next test
+        await asyncio.to_thread(
+            subprocess.run, ["pkill", "-f", marker], capture_output=True
+        )
+
+
 class TestThreshold:
     async def test_threshold_crossing_sets_down(self):
         # reference test/health.test.js:183-225 (interval 5ms, threshold 3)
