@@ -6,6 +6,9 @@ these are true end-to-end contract tests: register -> ZooKeeper -> resolve
 exactly as Binder would.
 """
 
+import json
+
+import pytest
 
 from registrar_tpu import binderview
 from registrar_tpu.records import host_record, payload_bytes
@@ -283,4 +286,149 @@ class TestConvergence:
             assert [a.data for a in res.answers] == ["10.1.0.1"]
         finally:
             await c1.close()
+            await server.stop()
+
+
+class TestTtlPrecedence:
+    """The TTL precedence ladders (reference README.md:680-757): host
+    records prefer the inner <type>.ttl over the top-level ttl; service
+    records prefer service.service.ttl, then service.ttl, then the
+    record's top-level ttl; absent everywhere falls to the default."""
+
+    async def test_host_inner_ttl_beats_top_level(self):
+        server, client = await _pair()
+        try:
+            rec = host_record("host", "10.0.0.1", ttl=111)
+            rec["host"]["ttl"] = 222  # inner wins
+            await client.mkdirp("/us/ttl/h")
+            await client.create(
+                "/us/ttl/h/vm", payload_bytes(rec), CreateFlag.EPHEMERAL
+            )
+            res = await binderview.resolve(client, "vm.h.ttl.us", "A")
+            assert [a.ttl for a in res.answers] == [222]
+        finally:
+            await client.close()
+            await server.stop()
+
+    async def test_host_top_level_ttl_fallback(self):
+        server, client = await _pair()
+        try:
+            await _put_host(client, "/us/ttl2/h/vm", "host", "10.0.0.2", ttl=333)
+            res = await binderview.resolve(client, "vm.h.ttl2.us", "A")
+            assert [a.ttl for a in res.answers] == [333]
+        finally:
+            await client.close()
+            await server.stop()
+
+    async def test_service_ttl_ladder(self):
+        server, client = await _pair()
+        try:
+            path = "/us/ttl3/svc"
+            await client.mkdirp(path)
+            # top rung: service.service.ttl beats service.ttl AND the
+            # record's top-level ttl
+            svc0 = {
+                "type": "service",
+                "ttl": 999,
+                "service": {
+                    "type": "service", "ttl": 444,
+                    "service": {"srvce": "_http", "proto": "_tcp",
+                                "port": 80, "ttl": 111},
+                },
+            }
+            await client.put(path, json.dumps(svc0).encode())
+            await _put_host(
+                client, f"{path}/i0", "load_balancer", "10.1.1.1", ports=[80]
+            )
+            res = await binderview.resolve(
+                client, "_http._tcp.svc.ttl3.us", "SRV"
+            )
+            assert [a.ttl for a in res.answers] == [111]
+            await client.unlink(f"{path}/i0")
+
+            # service.ttl (middle rung): inner ttl absent
+            svc = {
+                "type": "service",
+                "ttl": 999,
+                "service": {
+                    "type": "service", "ttl": 444,
+                    "service": {"srvce": "_http", "proto": "_tcp", "port": 80},
+                },
+            }
+            await client.put(path, json.dumps(svc).encode())
+            await _put_host(
+                client, f"{path}/i0", "load_balancer", "10.1.1.1", ports=[80]
+            )
+            res = await binderview.resolve(
+                client, "_http._tcp.svc.ttl3.us", "SRV"
+            )
+            assert [a.ttl for a in res.answers] == [444]
+
+            # top-level rung: no ttl inside service at all
+            svc2 = {
+                "type": "service",
+                "ttl": 555,
+                "service": {
+                    "type": "service",
+                    "service": {"srvce": "_http", "proto": "_tcp", "port": 80},
+                },
+            }
+            await client.put(path, json.dumps(svc2).encode())
+            res = await binderview.resolve(
+                client, "_http._tcp.svc.ttl3.us", "SRV"
+            )
+            assert [a.ttl for a in res.answers] == [555]
+        finally:
+            await client.close()
+            await server.stop()
+
+
+class TestResolveEdges:
+    async def test_unsupported_qtype_rejected(self):
+        server, client = await _pair()
+        try:
+            with pytest.raises(ValueError):
+                await binderview.resolve(client, "x.us", "AAAA")
+        finally:
+            await client.close()
+            await server.stop()
+
+    async def test_answer_renders_like_dig(self):
+        server, client = await _pair()
+        try:
+            await _put_host(client, "/us/fmt/h/vm", "host", "10.9.9.9")
+            res = await binderview.resolve(client, "vm.h.fmt.us", "A")
+            assert str(res.answers[0]) == "vm.h.fmt.us. 30 IN A 10.9.9.9"
+        finally:
+            await client.close()
+            await server.stop()
+
+    async def test_instance_missing_address_is_skipped(self):
+        server, client = await _pair()
+        try:
+            path = "/us/noaddr/svc"
+            await client.mkdirp(path)
+            await client.put(
+                path,
+                payload_bytes(
+                    {"type": "service",
+                     "service": {"type": "service",
+                                 "service": {"srvce": "_http", "proto": "_tcp",
+                                             "port": 80, "ttl": 60}}}
+                ),
+            )
+            # a child whose inner object carries no address string
+            await client.create(
+                f"{path}/bad",
+                json.dumps({"type": "load_balancer",
+                            "load_balancer": {"ports": [80]}}).encode(),
+                CreateFlag.EPHEMERAL,
+            )
+            await _put_host(
+                client, f"{path}/ok", "load_balancer", "10.2.2.2", ports=[80]
+            )
+            res = await binderview.resolve(client, "svc.noaddr.us", "A")
+            assert [a.data for a in res.answers] == ["10.2.2.2"]
+        finally:
+            await client.close()
             await server.stop()
