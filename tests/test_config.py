@@ -194,6 +194,14 @@ class TestParse:
             lambda c: c.update(logLevel=3),
             lambda c: c.update(maxAttempts=0),
             lambda c: c.update(repairHeartbeatMiss="yes"),
+            lambda c: c.update(healthCheck="true"),  # not an object
+            lambda c: c.update(metrics="on"),        # not an object
+            lambda c: c.update(metrics={"port": "9090"}),
+            lambda c: c.update(metrics={"port": 0}),
+            lambda c: c.update(metrics={"port": 65536}),
+            lambda c: c.update(metrics={"port": 9090, "host": 7}),
+            lambda c: c.update(zookeeper={"servers": [
+                {"host": "h", "port": 2181}], "chroot": "no-slash"}),
         ],
     )
     def test_invalid(self, mutate):
@@ -201,6 +209,10 @@ class TestParse:
         mutate(raw)
         with pytest.raises(ConfigError):
             parse_config(raw)
+
+    def test_whole_config_must_be_object(self):
+        with pytest.raises(ConfigError):
+            parse_config(["not", "an", "object"])
 
 
 class TestLoad:

@@ -94,3 +94,9 @@ class TestLevels:
         assert logging.getLogger().level == jlog.TRACE
         jlog.escalate(5)  # clamped at TRACE
         assert logging.getLogger().level == jlog.TRACE
+
+
+def test_levels_below_debug_map_to_bunyan_trace():
+    from registrar_tpu.jlog import _bunyan_level
+
+    assert _bunyan_level(5) == 10  # bunyan TRACE

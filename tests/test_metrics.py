@@ -262,3 +262,13 @@ class TestInstrumentation:
             except asyncio.CancelledError:
                 pass
             await zk_server.stop()
+
+
+def test_metric_value_defaults_to_zero_for_unsampled_labels():
+    from registrar_tpu.metrics import MetricsRegistry
+
+    reg = MetricsRegistry()
+    c = reg.counter("zero_test_total", "help")
+    assert c.value({"never": "sampled"}) == 0.0
+    assert reg.get("zero_test_total") is c
+    assert reg.get("no_such_metric") is None

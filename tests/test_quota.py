@@ -194,3 +194,11 @@ async def test_registration_traffic_unaffected_by_quota_machinery():
             assert server.quota_warnings > 0  # soft-flagged, not blocked
         finally:
             await client.close()
+
+
+def test_parse_quota_garbled_fields_read_as_unlimited():
+    from registrar_tpu.zk.quota import parse_quota
+
+    assert parse_quota(b"count=abc,bytes=") == {"count": -1, "bytes": -1}
+    assert parse_quota(b"") == {"count": -1, "bytes": -1}
+    assert parse_quota(b"count=3,junk=9,bytes=7") == {"count": 3, "bytes": 7}
