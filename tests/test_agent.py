@@ -141,8 +141,6 @@ class TestHeartbeatRepair:
     """Opt-in repair_heartbeat_miss (SURVEY.md §3.2's flagged improvement —
     off by default; TestHeartbeatFailure above pins the default)."""
 
-    _FAST_RETRY = None  # set in _fast_ee
-
     def _fast_ee(self, client, **kw):
         from registrar_tpu.retry import RetryPolicy
 
@@ -269,6 +267,43 @@ class TestHeartbeatRepair:
             assert registers == [], "repair resurrected a down host"
             assert await client.exists(znodes[0]) is None
             assert ee.down
+            ee.stop()
+        finally:
+            await client.close()
+            await server.stop()
+
+    async def test_repair_failure_emits_error_and_retries_later(
+        self, monkeypatch
+    ):
+        # The repair pipeline itself fails (ZK hiccup mid-repair): the
+        # failure surfaces as `error`, and once the fault clears a later
+        # heartbeat miss repairs successfully.
+        import registrar_tpu.agent as agent_mod
+        import registrar_tpu.registration as register_mod
+
+        monkeypatch.setattr(agent_mod, "HEARTBEAT_FAILURE_BACKOFF_S", 0.05)
+        server, client = await _pair()
+        try:
+            ee = self._fast_ee(client, repair_heartbeat_miss=True)
+            (znodes,) = await ee.wait_for("register", timeout=10)
+
+            real_register = register_mod.register
+            fail_once = {"armed": True}
+
+            async def flaky_register(*a, **kw):
+                if fail_once["armed"]:
+                    fail_once["armed"] = False
+                    raise RuntimeError("repair hiccup")
+                return await real_register(*a, **kw)
+
+            monkeypatch.setattr(register_mod, "register", flaky_register)
+            err_fut = asyncio.ensure_future(ee.wait_for("error", timeout=10))
+            reg_fut = asyncio.ensure_future(ee.wait_for("register", timeout=10))
+            await client.unlink(znodes[0])  # trigger the miss
+            (err,) = await err_fut
+            assert "repair hiccup" in str(err)
+            await reg_fut  # the NEXT miss repairs through the real pipeline
+            assert await client.exists(znodes[0]) is not None
             ee.stop()
         finally:
             await client.close()
