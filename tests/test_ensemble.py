@@ -411,6 +411,66 @@ class TestReplicationLag:
                 await reader.close()
                 await writer.close()
 
+    async def test_data_and_child_watches_owed_changes_fire_on_catch_up(self):
+        # The remaining two reconciliation shapes: a data watch armed on
+        # the stale view whose node changed (mzxid diff -> DATA_CHANGED)
+        # and a child watch whose node gained a child (cversion diff ->
+        # CHILDREN_CHANGED).
+        async with ZKEnsemble(2) as ens:
+            writer = await ZKClient([ens.addresses[0]]).connect()
+            reader = await ZKClient([ens.addresses[1]]).connect()
+            try:
+                await writer.create("/d", b"v0")
+                await writer.mkdirp("/p")
+                ens.set_lag(1, 60_000)
+                await writer.set_data("/d", b"v1")  # freezes member 1
+                await writer.create("/p/kid", b"")
+
+                data_ev, child_ev = [], []
+                reader.watch("/d", lambda ev: data_ev.append(ev.type))
+                # stale read arms the data watch (still sees v0)
+                assert (await reader.get("/d", watch=True))[0] == b"v0"
+                reader.watch("/p", lambda ev: child_ev.append(ev.type))
+                assert await reader.get_children("/p", watch=True) == []
+
+                await reader.sync("/")  # catch-up reconciles both
+                for _ in range(200):
+                    if data_ev and child_ev:
+                        break
+                    await asyncio.sleep(0.01)
+                assert data_ev == [EventType.NODE_DATA_CHANGED]
+                assert child_ev == [EventType.NODE_CHILDREN_CHANGED]
+                # post-catch-up reads are current
+                assert (await reader.get("/d"))[0] == b"v1"
+                assert await reader.get_children("/p") == ["kid"]
+            finally:
+                await reader.close()
+                await writer.close()
+
+    async def test_child_watch_on_deleted_parent_fires_deleted_on_catch_up(self):
+        async with ZKEnsemble(2) as ens:
+            writer = await ZKClient([ens.addresses[0]]).connect()
+            reader = await ZKClient([ens.addresses[1]]).connect()
+            try:
+                await writer.mkdirp("/gone")
+                ens.set_lag(1, 60_000)
+                await writer.unlink("/gone")  # freezes member 1
+
+                events = []
+                reader.watch("/gone", lambda ev: events.append(ev.type))
+                # stale view still shows the node; arms a child watch
+                assert await reader.get_children("/gone", watch=True) == []
+
+                await reader.sync("/")
+                for _ in range(200):
+                    if events:
+                        break
+                    await asyncio.sleep(0.01)
+                assert events == [EventType.NODE_DELETED]
+            finally:
+                await reader.close()
+                await writer.close()
+
     async def test_watch_fired_live_is_not_redelivered_on_catch_up(self):
         # One-shot semantics: a watch armed while lagging that the live
         # commit path already fired must not fire a second time when the
