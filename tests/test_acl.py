@@ -319,6 +319,20 @@ class TestAuthScheme:
             await client.close()
             await server.stop()
 
+    async def test_malformed_digest_credential_auth_failed(self):
+        # Credentials without a colon, with an empty user, or that are
+        # not UTF-8 must answer AUTH_FAILED (real ZK's
+        # DigestAuthenticationProvider rejects them the same way).
+        for cred in (b"no-colon", b":pw-only", b"\xff\xfe:pw"):
+            server, client = await _pair(reconnect=False)
+            try:
+                with pytest.raises(ZKError) as exc:
+                    await client.add_auth("digest", cred)
+                assert exc.value.code == Err.AUTH_FAILED, cred
+            finally:
+                await client.close()
+                await server.stop()
+
     async def test_unknown_scheme_auth_failed_drops_connection(self):
         server, client = await _pair(reconnect=False)
         try:

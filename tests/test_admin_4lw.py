@@ -227,3 +227,27 @@ class TestZkCliAdmin:
             env={**os.environ, "PYTHONPATH": REPO},
         )
         assert out.returncode == 1
+
+
+class TestConsAndDumpTree:
+    async def test_cons_lists_connections(self):
+        async with ZKServer() as server:
+            client = await ZKClient([server.address]).connect()
+            try:
+                out = await _probe(server, "cons")
+                assert f"sid=0x{client.session_id:x}" in out
+            finally:
+                await client.close()
+
+    async def test_dump_tree_helper_maps_subtree(self):
+        async with ZKServer() as server:
+            client = await ZKClient([server.address]).connect()
+            try:
+                await client.mkdirp("/a/b")
+                await client.put("/a/b/leaf", b"v")
+                tree = server.dump_tree("/a")
+                assert tree["/a/b/leaf"] == b"v"
+                assert "/a/b" in tree
+                assert server.dump_tree("/absent") == {}
+            finally:
+                await client.close()
