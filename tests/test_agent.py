@@ -136,6 +136,41 @@ class TestHeartbeatFailure:
             await client.close()
             await server.stop()
 
+    async def test_consecutive_failures_are_backoff_spaced(self, monkeypatch):
+        # After a failed heartbeat the loop reschedules at
+        # max(interval, HEARTBEAT_FAILURE_BACKOFF_S), not at the normal
+        # cadence (reference lib/index.js:131-159) — consecutive failure
+        # events must be backoff-spaced, not interval-spaced.
+        import time
+
+        import registrar_tpu.agent as agent_mod
+        from registrar_tpu.retry import RetryPolicy
+
+        monkeypatch.setattr(agent_mod, "HEARTBEAT_FAILURE_BACKOFF_S", 0.6)
+        server, client = await _pair()
+        try:
+            ee = _plus(
+                client, heartbeat_interval=0.03,
+                heartbeat_retry=RetryPolicy(
+                    max_attempts=1, initial_delay=0.01, max_delay=0.01
+                ),
+            )
+            (znodes,) = await ee.wait_for("register", timeout=10)
+            stamps = []
+            ee.on("heartbeatFailure", lambda *a: stamps.append(time.monotonic()))
+            await client.unlink(znodes[0])  # every beat now fails
+            for _ in range(400):
+                if len(stamps) >= 3:
+                    break
+                await asyncio.sleep(0.02)
+            assert len(stamps) >= 3
+            gaps = [b - a for a, b in zip(stamps, stamps[1:])]
+            assert all(g >= 0.5 for g in gaps), gaps  # backoff, not 0.03
+            ee.stop()
+        finally:
+            await client.close()
+            await server.stop()
+
 
 class TestHeartbeatRepair:
     """Opt-in repair_heartbeat_miss (SURVEY.md §3.2's flagged improvement —

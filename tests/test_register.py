@@ -213,6 +213,40 @@ class TestRegister:
             await server.stop()
 
 
+class TestSettleDelay:
+    async def test_register_waits_the_settle_delay(self):
+        # The stage-2 pause is contract (reference lib/register.js:232-235
+        # hard-codes 1 s "to be nice to watchers"); a register configured
+        # with a 300 ms settle must take at least that long, and a
+        # settle-free one must not.
+        import time
+
+        server, client = await _pair()
+        try:
+            reg = {"domain": "settle.test.registrar", "type": "host"}
+            t0 = time.perf_counter()
+            await register(
+                client, reg, admin_ip="10.6.0.1", hostname="s1",
+                settle_delay=0.3,
+            )
+            # Lower bound only: asyncio.sleep never returns early, so this
+            # alone kills the settle-skip mutant; an upper bound on the
+            # settle-free path would be a latent flake under CI load.
+            assert time.perf_counter() - t0 >= 0.3
+        finally:
+            await client.close()
+            await server.stop()
+
+    def test_default_settle_is_the_reference_second(self):
+        from registrar_tpu.registration import SETTLE_DELAY_S
+        import inspect
+
+        assert SETTLE_DELAY_S == 1.0
+        # and it is the default, not an opt-in
+        sig = inspect.signature(register)
+        assert sig.parameters["settle_delay"].default == SETTLE_DELAY_S
+
+
 class TestUnregister:
     async def test_unregister_deletes_all_nodes(self):
         # reference test/register.test.js:89-109, plus the multi-node case
