@@ -137,6 +137,12 @@ class _Connection:
         self.peer_ip: Optional[str] = peer[0] if peer else None
         self._outbuf: List[bytes] = []
         self._inflight = 0  # frames written but not yet drained/counted
+        # Serializes writer.drain(): the serve loop and a watch fan-out
+        # from another connection's task can drain concurrently, and
+        # StreamWriter only supports multiple simultaneous drain waiters
+        # on Python >= 3.11 (FlowControlMixin asserted a single waiter
+        # before that).
+        self._drain_lock = asyncio.Lock()
 
     def queue(self, payload: bytes) -> None:
         """Stage a reply for the next :meth:`flush`.
@@ -190,7 +196,8 @@ class _Connection:
         if self.closed:
             return
         try:
-            await self.writer.drain()
+            async with self._drain_lock:
+                await self.writer.drain()
         except (ConnectionError, OSError):
             self._inflight = 0
             await self.close()
@@ -1542,6 +1549,14 @@ class ZKServer:
         except Exception:
             log.exception("connection handler crashed")
         finally:
+            # Replies generated for earlier requests in a burst must not
+            # be dropped because a LATER frame was malformed (or any
+            # other serve-loop exit): pre-batching, each reply went out
+            # immediately — deliver whatever was queued before closing.
+            try:
+                await conn.flush()
+            except Exception:  # noqa: BLE001 - the close below handles it
+                pass
             self._conns.discard(conn)
             if conn.session is not None and conn.session.conn is conn:
                 conn.session.conn = None

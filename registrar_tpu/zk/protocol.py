@@ -924,8 +924,11 @@ class ZKError(Exception):
 #: per-component walk.  Bounded in count AND entry size (a wire frame
 #: can carry a multi-MiB path, and the server validates client-supplied
 #: paths — an unbounded-bytes cache would let a hostile stream pin
-#: gigabytes); validation is pure, so caching is safe.
-_VALID_PATHS: set = set()
+#: gigabytes); validation is pure, so caching is safe.  FIFO eviction
+#: when full (insertion-ordered dict), so a long-lived daemon whose
+#: instance paths churn keeps caching NEW hot paths instead of freezing
+#: on the first 4096 it ever saw.
+_VALID_PATHS: dict = {}
 _VALID_PATHS_MAX = 4096
 _VALID_PATH_MAX_LEN = 256
 
@@ -947,6 +950,8 @@ def check_path(path: str) -> str:
             raise ValueError(f"relative path component: {path!r}")
         if "\x00" in comp:
             raise ValueError(f"null byte in path component: {path!r}")
-    if len(path) <= _VALID_PATH_MAX_LEN and len(_VALID_PATHS) < _VALID_PATHS_MAX:
-        _VALID_PATHS.add(path)
+    if len(path) <= _VALID_PATH_MAX_LEN:
+        if len(_VALID_PATHS) >= _VALID_PATHS_MAX:
+            _VALID_PATHS.pop(next(iter(_VALID_PATHS)))  # FIFO eviction
+        _VALID_PATHS[path] = True
     return path

@@ -1,0 +1,108 @@
+"""Unit tests for the backoff policy (reference `backoff` library parity).
+
+The delay schedule and both shipped policies mirror the reference
+exactly (lib/zk.js:38-42 heartbeat, lib/zk.js:97-101 connect;
+BASELINE.md) — pinned directly here rather than only through the
+integration suites that ride on them.
+"""
+
+import asyncio
+import math
+
+import pytest
+
+from registrar_tpu.retry import (
+    CONNECT_RETRY,
+    HEARTBEAT_RETRY,
+    RetryPolicy,
+    call_with_backoff,
+)
+
+
+class TestDelaySchedule:
+    def test_exponential_doubling_capped(self):
+        p = RetryPolicy(max_attempts=10, initial_delay=1.0, max_delay=30.0)
+        assert [p.delay(a) for a in range(7)] == [1, 2, 4, 8, 16, 30, 30]
+
+    def test_reference_policies(self):
+        assert (HEARTBEAT_RETRY.max_attempts,
+                HEARTBEAT_RETRY.initial_delay,
+                HEARTBEAT_RETRY.max_delay) == (5, 1.0, 30.0)
+        assert CONNECT_RETRY.max_attempts == math.inf
+        assert (CONNECT_RETRY.initial_delay, CONNECT_RETRY.max_delay) == (1.0, 90.0)
+
+
+class TestCallWithBackoff:
+    async def test_succeeds_first_try_without_sleeping(self):
+        calls = []
+
+        async def fn():
+            calls.append(1)
+            return "ok"
+
+        assert await call_with_backoff(fn, HEARTBEAT_RETRY) == "ok"
+        assert len(calls) == 1
+
+    async def test_retries_then_succeeds(self):
+        attempts = []
+
+        async def fn():
+            attempts.append(1)
+            if len(attempts) < 3:
+                raise RuntimeError("flaky")
+            return "recovered"
+
+        fast = RetryPolicy(max_attempts=5, initial_delay=0.001, max_delay=0.002)
+        backoffs = []
+        out = await call_with_backoff(
+            fn, fast, on_backoff=lambda a, d, e: backoffs.append((a, d))
+        )
+        assert out == "recovered"
+        assert len(attempts) == 3
+        # on_backoff fired before each sleep, with the schedule's delays
+        assert backoffs == [(0, 0.001), (1, 0.002)]
+
+    async def test_exhausts_attempts_and_raises_last_error(self):
+        attempts = []
+
+        async def fn():
+            attempts.append(1)
+            raise RuntimeError(f"boom {len(attempts)}")
+
+        fast = RetryPolicy(max_attempts=3, initial_delay=0.001, max_delay=0.002)
+        with pytest.raises(RuntimeError) as exc:
+            await call_with_backoff(fn, fast)
+        assert len(attempts) == 3  # max_attempts total calls, not retries
+        assert "boom 3" in str(exc.value)  # the LAST error propagates
+
+    async def test_non_retryable_error_is_fatal_immediately(self):
+        attempts = []
+
+        async def fn():
+            attempts.append(1)
+            raise ValueError("fatal")
+
+        with pytest.raises(ValueError):
+            await call_with_backoff(
+                fn,
+                RetryPolicy(max_attempts=5, initial_delay=0.001),
+                retryable=lambda e: not isinstance(e, ValueError),
+            )
+        assert len(attempts) == 1
+
+    async def test_cancellation_aborts_the_loop(self):
+        started = asyncio.Event()
+
+        async def fn():
+            started.set()
+            raise RuntimeError("always failing")
+
+        task = asyncio.ensure_future(
+            call_with_backoff(
+                fn, RetryPolicy(max_attempts=math.inf, initial_delay=30.0)
+            )
+        )
+        await started.wait()
+        task.cancel()
+        with pytest.raises(asyncio.CancelledError):
+            await task

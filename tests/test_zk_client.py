@@ -378,6 +378,34 @@ class TestConstructorValidation:
 
 
 class TestBurstInterruption:
+    async def test_replies_before_malformed_frame_are_delivered(self):
+        # A burst of [valid request, malformed frame]: the server kills
+        # the connection at the bad frame, but the reply already
+        # generated for the valid request must still be delivered first
+        # (pre-batching each reply went out immediately).
+        server, client = await _pair()
+        try:
+            client._cork()
+            try:
+                fut = client._post(
+                    client._next_xid(), OpCode.CREATE,
+                    proto.CreateRequest(
+                        path="/pre-bad", data=b"",
+                        acls=list(OPEN_ACL_UNSAFE),
+                        flags=CreateFlag.PERSISTENT,
+                    ),
+                )
+                # a COMPLETE frame whose 1-byte body cannot hold a header
+                client._corked.append(b"\x00\x00\x00\x01\x00")
+            finally:
+                client._uncork()
+            await client._writer.drain()
+            r = await asyncio.wait_for(fut, timeout=5)
+            assert proto.CreateResponse.read(r).path == "/pre-bad"
+        finally:
+            await client.close()
+            await server.stop()
+
     async def test_server_stop_mid_sweep_fails_cleanly(self):
         # A 500-frame pipelined heartbeat interrupted by server death must
         # fail with a clean error (every posted future resolved), not hang.
