@@ -1549,19 +1549,26 @@ class ZKServer:
         except Exception:
             log.exception("connection handler crashed")
         finally:
-            # Replies generated for earlier requests in a burst must not
-            # be dropped because a LATER frame was malformed (or any
-            # other serve-loop exit): pre-batching, each reply went out
-            # immediately — deliver whatever was queued before closing.
-            try:
-                await conn.flush()
-            except Exception:  # noqa: BLE001 - the close below handles it
-                pass
+            # Detach FIRST: the flush below can suspend, and a session
+            # that still looks connected is exempt from the expiry sweep
+            # — cleanup must never be hostage to the peer's read rate.
             self._conns.discard(conn)
             if conn.session is not None and conn.session.conn is conn:
                 conn.session.conn = None
                 conn.session.auth_ids.clear()
                 conn.session.last_heard = time.monotonic()
+            # Replies generated for earlier requests in a burst must not
+            # be dropped because a LATER frame was malformed (or any
+            # other serve-loop exit): pre-batching, each reply went out
+            # immediately — deliver what was queued, bounded so a
+            # non-reading peer cannot wedge the handler.
+            try:
+                await asyncio.wait_for(conn.flush(), timeout=1.0)
+            except asyncio.CancelledError:
+                await conn.close()
+                raise  # honor cancellation once cleanup is done
+            except Exception:  # noqa: BLE001 - timeout/conn loss: close below
+                pass
             await conn.close()
 
     async def _serve(self, conn: _Connection) -> None:

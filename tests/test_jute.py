@@ -266,3 +266,26 @@ class TestCheckPath:
     def test_invalid(self, bad):
         with pytest.raises(ValueError):
             check_path(bad)
+
+
+class TestCheckPathCache:
+    def test_cache_bounded_with_fifo_eviction(self):
+        # The validated-path cache must stay bounded past its cap AND
+        # keep caching NEW paths (FIFO eviction) — a frozen cache would
+        # quietly lose the optimization in a long-lived daemon whose
+        # instance paths churn.
+        from registrar_tpu.zk.protocol import (
+            _VALID_PATHS,
+            _VALID_PATHS_MAX,
+            check_path,
+        )
+
+        for i in range(_VALID_PATHS_MAX + 50):
+            check_path(f"/evict-test/p{i}")
+        assert len(_VALID_PATHS) <= _VALID_PATHS_MAX
+        # the newest path was cached even though the cap was hit ...
+        assert f"/evict-test/p{_VALID_PATHS_MAX + 49}" in _VALID_PATHS
+        # ... and oversized paths never are
+        long_path = "/x" * 200
+        check_path(long_path)
+        assert long_path not in _VALID_PATHS
