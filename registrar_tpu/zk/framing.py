@@ -9,6 +9,11 @@ awaited ``readexactly()`` calls per frame.  Pipelined storms (mkdirp,
 heartbeat sweeps, registration fan-outs) land hundreds of frames per
 segment, where the per-frame await overhead was a measurable slice of
 the hot loops (docs/PERF.md).
+
+Consumption is position-tracked, not sliced: a ``del buf[:n]`` per
+frame would memmove the whole remaining burst for every request
+(quadratic on large bursts); the consumed prefix is dropped once per
+transport read instead.
 """
 
 from __future__ import annotations
@@ -22,14 +27,18 @@ _READ_SIZE = 65536
 class FrameReader:
     """Buffered frame carving over an ``asyncio.StreamReader``."""
 
-    __slots__ = ("_reader", "_buf")
+    __slots__ = ("_reader", "_buf", "_pos")
 
     def __init__(self, reader) -> None:
         self._reader = reader
         self._buf = bytearray()
+        self._pos = 0  # consumed prefix; compacted at the next fill
 
     async def fill(self) -> bool:
         """One transport read into the buffer; False on EOF/conn error."""
+        if self._pos:
+            del self._buf[: self._pos]
+            self._pos = 0
         try:
             chunk = await self._reader.read(_READ_SIZE)
         except (ConnectionError, OSError):
@@ -39,6 +48,20 @@ class FrameReader:
         self._buf += chunk
         return True
 
+    def _available(self) -> int:
+        return len(self._buf) - self._pos
+
+    async def _need(self, n: int) -> bool:
+        while self._available() < n:
+            if not await self.fill():
+                return False
+        return True
+
+    def _take(self, n: int) -> bytes:
+        out = bytes(self._buf[self._pos : self._pos + n])
+        self._pos += n
+        return out
+
     def carve(self) -> List[bytes]:
         """Every complete frame payload currently buffered, in order.
 
@@ -46,37 +69,35 @@ class FrameReader:
         has lost framing and cannot be resynchronized.
         """
         buf = self._buf
-        pos, end = 0, len(buf)
+        pos = self._pos
+        end = len(buf)
         out: List[bytes] = []
         while end - pos >= 4:
-            length = int.from_bytes(buf[pos:pos + 4], "big", signed=True)
+            length = int.from_bytes(buf[pos : pos + 4], "big", signed=True)
             if length < 0 or length > MAX_FRAME:
+                self._pos = pos
                 raise ConnectionError(f"bad frame length {length}")
             if end - pos - 4 < length:
                 break
-            out.append(bytes(buf[pos + 4:pos + 4 + length]))
+            out.append(bytes(buf[pos + 4 : pos + 4 + length]))
             pos += 4 + length
-        if pos:
-            del buf[:pos]
+        self._pos = pos
         return out
 
     def pending(self) -> bool:
         """True when a complete frame is already buffered (reply batchers
         hold their flush until the input burst is exhausted)."""
-        buf = self._buf
-        if len(buf) < 4:
+        if self._available() < 4:
             return False
-        length = int.from_bytes(buf[:4], "big", signed=True)
-        return 0 <= length <= len(buf) - 4
+        p = self._pos
+        length = int.from_bytes(self._buf[p : p + 4], "big", signed=True)
+        return 0 <= length <= self._available() - 4
 
     async def read4(self) -> Optional[bytes]:
         """The stream's next 4 bytes (a frame length — or a 4lw command)."""
-        while len(self._buf) < 4:
-            if not await self.fill():
-                return None
-        out = bytes(self._buf[:4])
-        del self._buf[:4]
-        return out
+        if not await self._need(4):
+            return None
+        return self._take(4)
 
     async def frame(self, header: Optional[bytes] = None) -> Optional[bytes]:
         """The next complete frame payload; None on EOF or bad length.
@@ -88,16 +109,11 @@ class FrameReader:
         if header is not None:
             length = int.from_bytes(header, "big", signed=True)
         else:
-            while len(self._buf) < 4:
-                if not await self.fill():
-                    return None
-            length = int.from_bytes(self._buf[:4], "big", signed=True)
-            del self._buf[:4]
+            if not await self._need(4):
+                return None
+            length = int.from_bytes(self._take(4), "big", signed=True)
         if length < 0 or length > MAX_FRAME:
             return None
-        while len(self._buf) < length:
-            if not await self.fill():
-                return None
-        out = bytes(self._buf[:length])
-        del self._buf[:length]
-        return out
+        if not await self._need(length):
+            return None
+        return self._take(length)
