@@ -209,31 +209,21 @@ class HealthCheck(EventEmitter):
             )
         except asyncio.CancelledError:
             # stop() mid-check: don't orphan the child process — and
-            # don't let a pipe-holder wedge the stop either.  A plain
+            # don't let a pipe-holder wedge the stop either (a plain
             # proc.wait() blocks until the stdout/stderr transports see
-            # EOF, so anything still holding the inherited pipes (the
-            # killed shell's own child, for instance) stalls cancellation
-            # for its whole lifetime; bound it exactly like the timeout
-            # path below.
-            try:
-                proc.kill()
-            except ProcessLookupError:
-                pass  # already exited
-            try:
-                await asyncio.wait_for(proc.wait(), timeout=1.0)
-            except asyncio.TimeoutError:
-                proc._transport.close()
-                await proc.wait()
+            # EOF, so anything still holding the inherited pipes — the
+            # killed shell's own child, for instance — stalls
+            # cancellation for its whole lifetime).
+            await self._force_reap(proc)
             raise
         except asyncio.TimeoutError:
             # SIGTERM, matching the reference's killSignal
             # (lib/health.js:48); escalate if it lingers.  Drain the
             # pipes so their transports are closed and the child isn't
-            # wedged on a full pipe.  Every signal is guarded (the child
-            # may already be gone, e.g. the cap kill landed first) and
-            # every drain is bounded: a grandchild that inherited the
-            # pipes and ignores signals must not suspend health checking
-            # — after the grace period the pipes are abandoned instead.
+            # wedged on a full pipe; after the grace period escalate to
+            # the bounded SIGKILL reap (the pipes may be held open by a
+            # signal-ignoring grandchild — abandon them rather than
+            # suspend health checking).
             try:
                 proc.terminate()
             except ProcessLookupError:
@@ -241,17 +231,7 @@ class HealthCheck(EventEmitter):
             try:
                 await asyncio.wait_for(self._drain(proc), timeout=1.0)
             except asyncio.TimeoutError:
-                try:
-                    proc.kill()
-                except ProcessLookupError:
-                    pass
-                try:
-                    await asyncio.wait_for(self._drain(proc), timeout=1.0)
-                except asyncio.TimeoutError:
-                    # The pipes are held open by an orphaned grandchild;
-                    # close our ends and just reap the (SIGKILLed) shell.
-                    proc._transport.close()
-                    await proc.wait()
+                await self._force_reap(proc)
             return HealthCheckError(
                 f"{self.command} timed out after {self.timeout}s"
             )
@@ -270,6 +250,30 @@ class HealthCheck(EventEmitter):
                     f"stdout match ({self._regex.pattern}) failed", code=-1
                 )
         return None
+
+    @staticmethod
+    async def _force_reap(proc) -> None:
+        """SIGKILL and reap without ever blocking on the pipes.
+
+        The ONE copy of the bounded-reap escalation (both the timeout
+        and cancellation paths end here): kill, wait briefly, and if a
+        pipe-holder is keeping the transports open, abandon our pipe
+        ends and just reap the killed shell."""
+        try:
+            proc.kill()
+        except ProcessLookupError:
+            pass  # already exited
+        try:
+            await asyncio.wait_for(proc.wait(), timeout=1.0)
+        except asyncio.TimeoutError:
+            proc._transport.close()
+            await proc.wait()
+        else:
+            # The process is reaped, but its pipe read-transports stay
+            # registered until EOF — which never comes while an orphan
+            # holds the write ends.  Close explicitly (idempotent) so no
+            # open-fd transports linger for the garbage collector.
+            proc._transport.close()
 
     async def _drain_capped(self, proc) -> "tuple[bytes, bool]":
         """Read the child's output to EOF with the reference's *streaming*

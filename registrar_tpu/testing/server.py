@@ -106,9 +106,12 @@ class Session:
         return self.conn is not None
 
 
-#: Reply-batching cap: flush at least every this-many queued replies even
-#: mid-burst (see the request loop in ZKServer._serve).
+#: Reply-batching caps: flush at least every this-many queued replies —
+#: or this many queued bytes (a burst of big getData answers must not
+#: buffer unboundedly; the per-reply drain this batching replaced was
+#: also the memory backpressure) — even mid-burst (ZKServer._serve).
 _MAX_QUEUED = 256
+_MAX_QUEUED_BYTES = 1 << 20
 
 
 def _event_frame(ev_type: int, path: str) -> bytes:
@@ -136,6 +139,7 @@ class _Connection:
         peer = writer.get_extra_info("peername")
         self.peer_ip: Optional[str] = peer[0] if peer else None
         self._outbuf: List[bytes] = []
+        self._outbytes = 0  # staged bytes (see queue_full)
         self._inflight = 0  # frames written but not yet drained/counted
         # Serializes writer.drain(): the serve loop and a watch fan-out
         # from another connection's task can drain concurrently, and
@@ -153,11 +157,22 @@ class _Connection:
         reply.  Order with watch events is preserved because every path
         that emits a frame (send, send_event) drains this queue first.
         """
-        self._outbuf.append(proto.frame(payload))
+        framed = proto.frame(payload)
+        self._outbuf.append(framed)
+        self._outbytes += len(framed)
+
+    def queue_full(self) -> bool:
+        """True when the staged replies hit either batching cap — the
+        request loop must flush even though the input burst continues."""
+        return (
+            len(self._outbuf) >= _MAX_QUEUED
+            or self._outbytes >= _MAX_QUEUED_BYTES
+        )
 
     def _write_out(self) -> None:
         """Join and write everything queued; counted at the next drain."""
         chunks, self._outbuf = self._outbuf, []
+        self._outbytes = 0
         if not chunks:
             return
         try:
@@ -169,6 +184,7 @@ class _Connection:
     async def flush(self) -> None:
         if self.closed:
             self._outbuf.clear()
+            self._outbytes = 0
             return
         self._write_out()
         await self.drain()
@@ -192,18 +208,20 @@ class _Connection:
     async def drain(self) -> None:
         """Await transport flow control, then account the delivered
         frames — packets_sent counts only after a successful drain, the
-        single accounting point for both the flush and fan-out paths."""
+        single accounting point for both the flush and fan-out paths.
+        The snapshot of _inflight is taken under the lock, so a frame
+        written by another task while a drain is suspended is counted by
+        that task's own follow-up drain, never double- or pre-counted."""
         if self.closed:
             return
-        try:
-            async with self._drain_lock:
+        async with self._drain_lock:
+            inflight, self._inflight = self._inflight, 0
+            try:
                 await self.writer.drain()
-        except (ConnectionError, OSError):
-            self._inflight = 0
-            await self.close()
-            return
-        self.server.packets_sent += self._inflight
-        self._inflight = 0
+            except (ConnectionError, OSError):
+                await self.close()
+                return
+            self.server.packets_sent += inflight
 
     async def send_event(self, ev_type: int, path: str) -> None:
         self.post_framed(_event_frame(ev_type, path))
@@ -1674,12 +1692,13 @@ class ZKServer:
             reply = await self._dispatch(conn, sess, hdr, r)
             if reply is not None:
                 conn.queue(reply)
-            # Flush once per input burst — but also every _MAX_QUEUED
-            # replies, so a client that streams requests continuously
-            # (keeping a complete frame buffered at all times) still
-            # receives replies and the queue stays bounded; the per-reply
-            # drain this batching replaced was also the backpressure.
-            if len(conn._outbuf) >= _MAX_QUEUED or not frames.pending():
+            # Flush once per input burst — but also whenever the staged
+            # replies hit the count/byte caps, so a client that streams
+            # requests continuously (keeping a complete frame buffered
+            # at all times) still receives replies and the queue stays
+            # bounded in BOTH dimensions; the per-reply drain this
+            # batching replaced was also the backpressure.
+            if conn.queue_full() or not frames.pending():
                 await conn.flush()
 
     def _establish_session(self, req: proto.ConnectRequest) -> Optional[Session]:

@@ -55,7 +55,13 @@ def pytest_pyfunc_call(pyfuncitem):
         # convergence pass, so its budget must scale with the requested
         # storm length (a fixed 60 s cap silently forbids `CHAOS_SECONDS`
         # beyond ~55) — same slack for every test, chaos just starts later.
-        budget = 60 + float(os.environ.get("CHAOS_SECONDS", 0) or 0)
+        try:
+            budget = 60 + float(os.environ.get("CHAOS_SECONDS", 0) or 0)
+        except ValueError:
+            # Malformed value: keep the default so only the chaos test
+            # (which parses the variable itself) reports it, instead of
+            # every async test in the suite erroring.
+            budget = 60
 
         async def _run():
             await asyncio.wait_for(func(**kwargs), timeout=budget)

@@ -213,6 +213,8 @@ class TestSingleCheckEdges:
         await asyncio.to_thread(
             subprocess.run, ["pkill", "-f", marker], capture_output=True
         )
+        # one tick for the reaped transport's close callbacks to land
+        await asyncio.sleep(0.05)
 
 
 class TestThreshold:
