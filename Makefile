@@ -7,17 +7,23 @@ NAME = registrar
 RELEASE_TARBALL = $(NAME)-release.tar.gz
 RELSTAGEDIR = /tmp/$(NAME)-release
 
-.PHONY: all check test test-jax chaos bench release publish clean
+.PHONY: all check check-core test test-jax chaos bench release publish clean
 
 all: check test
 
 # Lint gate (the reference's `make check` runs jsl+jsstyle with shipped
 # configs, its Makefile:15,18 + tools/jsl.node.conf): byte-compile, the
-# in-tree static checker (undefined names, unused imports), and a
-# strict-warnings import smoke.
-check:
-	$(PYTHON) -m compileall -q registrar_tpu tests tools bench.py __graft_entry__.py
+# in-tree static analysis suite (tools/checklib/ — name resolution plus
+# asyncio concurrency rules, suppressions, baseline; docs/CHECKS.md),
+# and a strict-warnings import smoke.  `check-core` is everything
+# EXCEPT the static checker, for callers that already ran
+# tools/check.py themselves (CI invokes it once with --format json so
+# the report doubles as the gate and the build artifact).
+check: check-core
 	$(PYTHON) tools/check.py
+
+check-core:
+	$(PYTHON) -m compileall -q registrar_tpu tests tools bench.py __graft_entry__.py
 	$(PYTHON) bench.py --check-baseline
 	$(PYTHON) -X dev -W error -c "import registrar_tpu, registrar_tpu.main, \
 	    registrar_tpu.testing.server, registrar_tpu.config, \

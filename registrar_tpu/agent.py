@@ -48,7 +48,7 @@ import logging
 from typing import Any, Mapping, Optional
 
 from registrar_tpu import registration as register_mod
-from registrar_tpu.events import EventEmitter
+from registrar_tpu.events import EventEmitter, spawn_owned
 from registrar_tpu.health import HealthCheck, create_health_check
 from registrar_tpu.registration import SETTLE_DELAY_S
 from registrar_tpu.retry import RetryPolicy
@@ -74,7 +74,7 @@ class RegistrarEvents(EventEmitter):
         #: gates heartbeat repair so it never races a deliberate
         #: deregistration.
         self.down = False
-        self._tasks: list = []
+        self._tasks: set = set()
         self._health: Optional[HealthCheck] = None
         self._stopped = False
 
@@ -86,22 +86,15 @@ class RegistrarEvents(EventEmitter):
         self._stopped = True
         if self._health is not None:
             self._health.stop()
-        for task in self._tasks:
+        for task in list(self._tasks):
             task.cancel()
         self._tasks.clear()
 
-    def _track(self, task) -> None:
-        """Track a task for stop(); finished tasks drop out so a daemon
-        with a flapping health check doesn't accumulate them forever."""
-        self._tasks.append(task)
-
-        def _prune(t) -> None:
-            try:
-                self._tasks.remove(t)
-            except ValueError:
-                pass  # stop() already cleared the list
-
-        task.add_done_callback(_prune)
+    def _track(self, coro) -> "asyncio.Task":
+        """Spawn ``coro`` as a task owned until done (finished tasks drop
+        out, so a daemon with a flapping health check doesn't accumulate
+        them forever) and cancelled by stop()."""
+        return spawn_owned(coro, self._tasks)
 
     @property
     def stopped(self) -> bool:
@@ -131,12 +124,11 @@ def register_plus(
     behavior).
     """
     ee = RegistrarEvents()
-    loop = asyncio.get_running_loop()
-    ee._track(loop.create_task(_run(ee, zk, registration, admin_ip,
-                                    health_check, heartbeat_interval,
-                                    hostname, settle_delay,
-                                    heartbeat_retry,
-                                    repair_heartbeat_miss)))
+    ee._track(_run(ee, zk, registration, admin_ip,
+                   health_check, heartbeat_interval,
+                   hostname, settle_delay,
+                   heartbeat_retry,
+                   repair_heartbeat_miss))
     return ee
 
 
@@ -172,12 +164,9 @@ async def _run(
     if ee.stopped:
         return
 
-    loop = asyncio.get_running_loop()
-    ee._track(loop.create_task(
-        _heartbeat_loop(
-            ee, zk, heartbeat_interval, heartbeat_retry,
-            do_register if repair_heartbeat_miss else None,
-        )
+    ee._track(_heartbeat_loop(
+        ee, zk, heartbeat_interval, heartbeat_retry,
+        do_register if repair_heartbeat_miss else None,
     ))
     if health_check:
         _start_health_consumer(ee, zk, do_register, health_check)
@@ -303,18 +292,14 @@ def _start_health_consumer(
         rtype = record.get("type")
         if rtype == "ok":
             if ee.down:
-                ee._track(
-                    asyncio.get_running_loop().create_task(on_recover())
-                )
+                ee._track(on_recover())
         elif rtype == "fail":
             if (
                 record.get("err") is not None
                 and record.get("isDown")
                 and not ee.down
             ):
-                ee._track(
-                    asyncio.get_running_loop().create_task(on_fail(record["err"]))
-                )
+                ee._track(on_fail(record["err"]))
         else:
             ee.emit("error", ValueError(f"unknown check type: {rtype!r}"))
 

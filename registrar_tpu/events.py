@@ -17,6 +17,50 @@ from typing import Any, Callable, Dict, List
 
 log = logging.getLogger("registrar_tpu.events")
 
+#: Strong references to in-flight coroutine-listener tasks.  The event
+#: loop only weak-references running tasks, so the bare create_task()
+#: handle emit() used to discard could be garbage-collected mid-dispatch
+#: (the checker's dropped-task rule now flags exactly that).
+_DISPATCH_TASKS: set = set()
+
+#: The loop the last spawn_owned ran on — stranded-task eviction only
+#: needs to run when this changes (see spawn_owned).
+_LAST_SPAWN_LOOP = None
+
+
+def spawn_owned(coro, registry: set) -> "asyncio.Task":
+    """Run ``coro`` as a task strongly held by ``registry`` until done.
+
+    THE one copy of the fire-and-forget ownership idiom the dropped-task
+    rule enforces (the loop only weak-references running tasks).  The
+    caller owns ``registry`` and decides the shutdown policy: the test
+    server cancels its set in stop(); emit()'s dispatch tasks are never
+    cancelled, because listeners for terminal events (``close``, ``end``)
+    must still run while their emitter is being torn down.
+    """
+    try:
+        loop = asyncio.get_running_loop()
+    except RuntimeError:
+        # No loop: close the already-built coroutine so the clean
+        # RuntimeError isn't followed by a 'never awaited' warning.
+        coro.close()
+        raise
+    # Evict tasks stranded by a loop that closed without draining them
+    # (their done-callbacks can never fire).  Only the module-global
+    # dispatch set needs this — it outlives every loop, while per-owner
+    # registries die with their owners — and stranded entries can only
+    # appear across a loop change, so the O(registry) scan is skipped
+    # on the steady single-loop hot path (emit()'s listener dispatch).
+    global _LAST_SPAWN_LOOP
+    if registry is _DISPATCH_TASKS and _LAST_SPAWN_LOOP is not loop:
+        for t in [t for t in registry if t.get_loop().is_closed()]:
+            registry.discard(t)
+        _LAST_SPAWN_LOOP = loop
+    task = loop.create_task(coro)
+    registry.add(task)
+    task.add_done_callback(registry.discard)
+    return task
+
 
 class EventEmitter:
     def __init__(self) -> None:
@@ -49,7 +93,16 @@ class EventEmitter:
             try:
                 result = listener(*args)
                 if inspect.isawaitable(result):
-                    asyncio.get_running_loop().create_task(_guard(event, result))
+                    try:
+                        spawn_owned(_guard(event, result), _DISPATCH_TASKS)
+                    except RuntimeError:
+                        # No running loop: spawn_owned closed the _guard
+                        # wrapper, but the listener coroutine it would
+                        # have awaited needs closing too, or GC warns
+                        # 'coroutine was never awaited'.
+                        if inspect.iscoroutine(result):
+                            result.close()
+                        raise
             except Exception:
                 log.exception("listener for %r raised", event)
         return len(targets)

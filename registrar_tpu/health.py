@@ -208,12 +208,13 @@ class HealthCheck(EventEmitter):
                 self._drain_capped(proc), timeout=self.timeout
             )
         except asyncio.CancelledError:
-            # stop() mid-check: don't orphan the child process — and
-            # don't let a pipe-holder wedge the stop either (a plain
-            # proc.wait() blocks until the stdout/stderr transports see
-            # EOF, so anything still holding the inherited pipes — the
-            # killed shell's own child, for instance — stalls
-            # cancellation for its whole lifetime).
+            # stop() mid-check: don't orphan the child process.  The
+            # bounded reap also closes the pipe transports explicitly —
+            # their fds would otherwise stay registered until a pipe EOF
+            # that never comes while a signal-ignoring grandchild holds
+            # the inherited write ends (the wait itself is NOT the
+            # hazard: asyncio resolves wait() when the child watcher
+            # reaps the shell, independent of the pipes).
             await self._force_reap(proc)
             raise
         except asyncio.TimeoutError:
@@ -253,27 +254,47 @@ class HealthCheck(EventEmitter):
 
     @staticmethod
     async def _force_reap(proc) -> None:
-        """SIGKILL and reap without ever blocking on the pipes.
+        """SIGKILL, reap (bounded), and close the pipe transports.
 
-        The ONE copy of the bounded-reap escalation (both the timeout
-        and cancellation paths end here): kill, wait briefly, and if a
-        pipe-holder is keeping the transports open, abandon our pipe
-        ends and just reap the killed shell."""
+        The ONE copy of the reap escalation (both the timeout and
+        cancellation paths end here).  ``wait()`` resolves when the
+        child watcher reaps the killed shell — asyncio sets the exit
+        waiters in ``_process_exited``, with pipe EOF playing no part —
+        so the 1 s bound only guards against a wedged/absent watcher.
+        The explicit transport close matters separately: the pipe
+        read-transports stay registered until EOF, which never comes
+        while a signal-ignoring grandchild holds the inherited write
+        ends — without it their open fds linger for the garbage
+        collector.  ``_transport`` is asyncio private API, so its
+        absence (a future internals change) degrades to skipping the
+        close rather than crashing the reap path."""
         try:
             proc.kill()
         except ProcessLookupError:
             pass  # already exited
+        transport = getattr(proc, "_transport", None)
         try:
             await asyncio.wait_for(proc.wait(), timeout=1.0)
         except asyncio.TimeoutError:
-            proc._transport.close()
-            await proc.wait()
+            # The watcher did not reap within the bound — wedged watcher
+            # or dead watcher thread.  Close the pipe transports (when
+            # the private API still exposes them) and give the reap one
+            # more BOUNDED chance: transport.close() frees fds but only
+            # _process_exited resolves the exit waiters, so an unbounded
+            # second wait() could hang stop() forever in exactly the
+            # wedged-watcher case this timeout exists for.  The child is
+            # already SIGKILLed; abandoning leaves at worst a zombie.
+            if transport is not None:
+                transport.close()
+            try:
+                await asyncio.wait_for(proc.wait(), timeout=1.0)
+            except asyncio.TimeoutError:
+                log.warning(
+                    "health check child not reaped after SIGKILL; abandoning"
+                )
         else:
-            # The process is reaped, but its pipe read-transports stay
-            # registered until EOF — which never comes while an orphan
-            # holds the write ends.  Close explicitly (idempotent) so no
-            # open-fd transports linger for the garbage collector.
-            proc._transport.close()
+            if transport is not None:
+                transport.close()  # idempotent
 
     async def _drain_capped(self, proc) -> "tuple[bytes, bool]":
         """Read the child's output to EOF with the reference's *streaming*

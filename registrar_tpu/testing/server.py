@@ -35,6 +35,7 @@ import time
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Set, Tuple
 
+from registrar_tpu.events import spawn_owned
 from registrar_tpu.zk import protocol as proto
 from registrar_tpu.zk.framing import FrameReader
 from registrar_tpu.zk.jute import Reader, Writer
@@ -140,7 +141,6 @@ class _Connection:
         self.peer_ip: Optional[str] = peer[0] if peer else None
         self._outbuf: List[bytes] = []
         self._outbytes = 0  # staged bytes (see queue_full)
-        self._inflight = 0  # frames written but not yet drained/counted
         # Serializes writer.drain(): the serve loop and a watch fan-out
         # from another connection's task can drain concurrently, and
         # StreamWriter only supports multiple simultaneous drain waiters
@@ -170,14 +170,23 @@ class _Connection:
         )
 
     def _write_out(self) -> None:
-        """Join and write everything queued; counted at the next drain."""
+        """Join and write everything queued, counting packets_sent.
+
+        ``packets_sent`` means *written to the transport* (real ZK's
+        ``packetSent()`` increments when the packet leaves the outgoing
+        queue, not on TCP delivery): counting here — the single point
+        both the flush and fan-out paths funnel through — keeps frames
+        on connections that die mid-burst counted, where the previous
+        count-after-drain scheme leaked them (a closed connection's
+        drain returned early and dropped its in-flight tally).
+        """
         chunks, self._outbuf = self._outbuf, []
         self._outbytes = 0
         if not chunks:
             return
         try:
             self.writer.write(b"".join(chunks))
-            self._inflight += len(chunks)
+            self.server.packets_sent += len(chunks)
         except (ConnectionError, OSError):
             pass  # the follow-up drain() surfaces the loss and closes
 
@@ -206,22 +215,16 @@ class _Connection:
         self._write_out()
 
     async def drain(self) -> None:
-        """Await transport flow control, then account the delivered
-        frames — packets_sent counts only after a successful drain, the
-        single accounting point for both the flush and fan-out paths.
-        The snapshot of _inflight is taken under the lock, so a frame
-        written by another task while a drain is suspended is counted by
-        that task's own follow-up drain, never double- or pre-counted."""
+        """Await transport flow control (accounting happens at
+        :meth:`_write_out` — see its docstring for the packets_sent
+        semantics)."""
         if self.closed:
             return
         async with self._drain_lock:
-            inflight, self._inflight = self._inflight, 0
             try:
                 await self.writer.drain()
             except (ConnectionError, OSError):
                 await self.close()
-                return
-            self.server.packets_sent += inflight
 
     async def send_event(self, ev_type: int, path: str) -> None:
         self.post_framed(_event_frame(ev_type, path))
@@ -426,6 +429,11 @@ class ZKServer:
         self._server: Optional[asyncio.AbstractServer] = None
         self._sweeper: Optional[asyncio.Task] = None
         self._conns: Set[_Connection] = set()
+        # Fire-and-forget fan-out tasks (lag-watch reconciliation).  The
+        # event loop only weak-references running tasks, so a discarded
+        # create_task() handle can be garbage-collected mid-flight; this
+        # set owns them until done, and stop() cancels stragglers.
+        self._bg_tasks: Set[asyncio.Task] = set()
         #: number of sessions expired by the sweeper (test observability)
         self.expired_count = 0
         #: connections refused because the client had seen a newer zxid
@@ -433,7 +441,10 @@ class ZKServer:
         self.refused_count = 0
         #: soft-quota violations logged by this member (test observability)
         self.quota_warnings = 0
-        #: request/reply counters surfaced via the 4lw admin commands
+        #: request/reply counters surfaced via the 4lw admin commands.
+        #: packets_sent counts frames *written* to the transport (real
+        #: ZK's packetSent(), incremented as the packet leaves the
+        #: outgoing queue), not frames the peer provably received.
         self.packets_received = 0
         self.packets_sent = 0
         # While a multi transaction applies, watch events queue here so the
@@ -517,6 +528,11 @@ class ZKServer:
         log.debug("ZKServer listening on %s:%d", self.host, self.port)
         return self
 
+    def _spawn(self, coro) -> "asyncio.Task":
+        """Run a fire-and-forget coroutine as an owned background task
+        (cancelled by stop(), unlike emit()'s dispatch tasks)."""
+        return spawn_owned(coro, self._bg_tasks)
+
     async def stop(self) -> None:
         self._state.members.discard(self)
         self._state.recount_lag()
@@ -526,6 +542,10 @@ class ZKServer:
                 await self._sweeper
             except asyncio.CancelledError:
                 pass
+        for task in list(self._bg_tasks):
+            task.cancel()
+        if self._bg_tasks:
+            await asyncio.gather(*self._bg_tasks, return_exceptions=True)
         for conn in list(self._conns):
             await conn.close()
         if self._server:
@@ -1108,9 +1128,7 @@ class ZKServer:
             holders.discard(conn)
             if not holders:
                 self._watches[kind].pop(path, None)
-            asyncio.ensure_future(
-                self._send_watch_events({conn}, ev, path)
-            )
+            self._spawn(self._send_watch_events({conn}, ev, path))
         # The create log only serves members still behind; once everyone
         # has applied the backlog it is dead weight — clear it so it
         # cannot grow across lag windows.
@@ -1543,14 +1561,14 @@ class ZKServer:
                         raise proto.ZKError(
                             Err.RUNTIME_INCONSISTENCY, op_req.path
                         )
-                    results.append(proto._DeleteResult())
+                    results.append(proto.DeleteResult())
                 elif op_type == OpCode.SET_DATA:
                     stat = await self._set_data_node(
                         op_req.path, op_req.data, op_req.version, sess
                     )
                     results.append(proto.SetDataResponse(stat=stat))
                 else:  # OpCode.CHECK — validated above, nothing to apply
-                    results.append(proto._CheckResult())
+                    results.append(proto.CheckResult())
         finally:
             deferred, self._deferred_events = self._deferred_events, None
         for conns, ev_type, path in deferred:

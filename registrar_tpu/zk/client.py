@@ -85,6 +85,12 @@ class ZKClient(EventEmitter):
             if not isinstance(host, str) or not isinstance(port, int):
                 raise ValueError("servers must be (host, port) pairs")
         self.servers = servers
+        # Validated-path cache, scoped to this client so its hot entries
+        # (the instance's own znode paths, re-validated every heartbeat
+        # sweep) can never be evicted by other clients' traffic — or by
+        # the test server, which validates untrusted peer paths uncached
+        # (see protocol.PathCache).
+        self._path_cache = proto.PathCache()
         # Chroot: every path this client sends is prefixed with it and
         # every path the server returns (created paths, watch events) has
         # it stripped — the standard "host:port/app" suffix semantics of
@@ -331,7 +337,10 @@ class ZKClient(EventEmitter):
         except SessionExpiredError:
             pass  # _emit_expired already fired
         except asyncio.CancelledError:
-            pass
+            # close() cancelled us; re-raise so the task finishes as
+            # *cancelled* instead of silently completing (nothing awaits
+            # it, but a swallowed cancel here would mask a stuck close).
+            raise
         except Exception:  # noqa: BLE001
             log.exception("reconnect loop gave up")
 
@@ -339,6 +348,14 @@ class ZKClient(EventEmitter):
         self._closed = True
         self.emit("state", "session_expired")
         self.emit("session_expired")
+
+    # -- path validation ------------------------------------------------------
+
+    def _check_path(self, path: str) -> str:
+        """Validate through this client's PathCache — the ONE place the
+        cache is wired in, so a new op cannot silently fall back to
+        uncached validation."""
+        return check_path(path, self._path_cache)
 
     # -- chroot mapping -------------------------------------------------------
 
@@ -568,7 +585,7 @@ class ZKClient(EventEmitter):
         acls=None,
     ) -> str:
         """Create a znode; returns the created path."""
-        check_path(path)
+        self._check_path(path)
         r = await self._call(
             OpCode.CREATE,
             proto.CreateRequest(
@@ -603,7 +620,7 @@ class ZKClient(EventEmitter):
         zkplus ``put`` semantics, used for the persistent service record
         (reference lib/register.js:62).
         """
-        check_path(path)
+        self._check_path(path)
         try:
             return await self.set_data(path, data)
         except ZKError as err:
@@ -627,7 +644,7 @@ class ZKClient(EventEmitter):
         Unlike :meth:`put` (zkplus semantics: create-if-missing), this is
         the raw ZooKeeper op — the right primitive for conditional writes.
         """
-        check_path(path)
+        self._check_path(path)
         r = await self._call(
             OpCode.SET_DATA,
             proto.SetDataRequest(
@@ -638,7 +655,7 @@ class ZKClient(EventEmitter):
 
     async def unlink(self, path: str, version: int = -1) -> None:
         """Delete a znode (zkplus name, reference lib/register.js:87)."""
-        check_path(path)
+        self._check_path(path)
         await self._call(
             OpCode.DELETE,
             proto.DeleteRequest(path=self._abs(path), version=version),
@@ -646,7 +663,7 @@ class ZKClient(EventEmitter):
 
     async def stat(self, path: str, watch: bool = False) -> Stat:
         """Stat a znode; raises NO_NODE when absent (heartbeat primitive)."""
-        check_path(path)
+        self._check_path(path)
         try:
             r = await self._call(
                 OpCode.EXISTS,
@@ -670,7 +687,7 @@ class ZKClient(EventEmitter):
             raise
 
     async def get(self, path: str, watch: bool = False) -> Tuple[bytes, Stat]:
-        check_path(path)
+        self._check_path(path)
         r = await self._call(
             OpCode.GET_DATA,
             proto.GetDataRequest(path=self._abs(path), watch=watch),
@@ -692,7 +709,7 @@ class ZKClient(EventEmitter):
         """
         paths = list(paths)
         for p in paths:
-            check_path(p)
+            self._check_path(p)
         futs, post_err = await self._post_pipeline(
             (
                 OpCode.GET_DATA,
@@ -715,7 +732,7 @@ class ZKClient(EventEmitter):
         return out
 
     async def get_children(self, path: str, watch: bool = False) -> List[str]:
-        check_path(path)
+        self._check_path(path)
         r = await self._call(
             OpCode.GET_CHILDREN2,
             proto.GetChildrenRequest(path=self._abs(path), watch=watch),
@@ -738,7 +755,7 @@ class ZKClient(EventEmitter):
         failed ancestor cascades NO_NODE onto its descendants, so the
         root cause is the error reported).
         """
-        check_path(path)
+        self._check_path(path)
         if path == "/":
             return
 
@@ -782,7 +799,7 @@ class ZKClient(EventEmitter):
         surface (zkplus never exposed it) — useful before read-backs in
         multi-server deployments.
         """
-        check_path(path)
+        self._check_path(path)
         r = await self._call(
             OpCode.SYNC, proto.SyncRequest(path=self._abs(path))
         )
@@ -803,7 +820,7 @@ class ZKClient(EventEmitter):
         if not ops:
             return []
         for _, record in ops:
-            check_path(record.path)
+            self._check_path(record.path)
         if self.chroot:
             ops = [
                 (t, dataclasses.replace(rec, path=self._abs(rec.path)))
@@ -849,7 +866,7 @@ class ZKClient(EventEmitter):
 
     async def get_acl(self, path: str) -> Tuple[List[proto.ACL], Stat]:
         """Read a node's ACL list and stat (aversion lives in the stat)."""
-        check_path(path)
+        self._check_path(path)
         r = await self._call(
             OpCode.GET_ACL, proto.GetACLRequest(path=self._abs(path))
         )
@@ -865,7 +882,7 @@ class ZKClient(EventEmitter):
         version); pass -1 to skip the check.  Requires ADMIN permission on
         the node.
         """
-        check_path(path)
+        self._check_path(path)
         r = await self._call(
             OpCode.SET_ACL,
             proto.SetACLRequest(
@@ -889,7 +906,7 @@ class ZKClient(EventEmitter):
         """
         nodes = list(nodes)
         for n in nodes:
-            check_path(n)
+            self._check_path(n)
 
         async def check() -> None:
             # Pipelined: post every exists request (buffered writes), one

@@ -35,7 +35,19 @@ class FrameReader:
         self._pos = 0  # consumed prefix; compacted at the next fill
 
     async def fill(self) -> bool:
-        """One transport read into the buffer; False on EOF/conn error."""
+        """Ingest the transport's whole buffered burst; False on EOF/error.
+
+        The first ``read()`` may block; after it returns, everything the
+        underlying ``StreamReader`` *already* holds is drained too —
+        ``read()`` returns immediately (without suspending, so no new
+        data can race in) while its buffer is non-empty, and each 64 KB
+        read only takes part of a large burst.  Without the drain loop,
+        ``pending()`` reports the burst exhausted at every 64 KB
+        boundary and the reply batchers flush once per chunk instead of
+        once per burst (ADVICE r5).  ``_buffer`` is asyncio private API:
+        when absent, the loop degrades to the old one-read-per-fill
+        behavior (64 KB batching granularity), never to an error.
+        """
         if self._pos:
             del self._buf[: self._pos]
             self._pos = 0
@@ -46,6 +58,17 @@ class FrameReader:
         if not chunk:
             return False
         self._buf += chunk
+        # StreamReader.read() consumes from this same bytearray in
+        # place, so the live reference observes the drain's progress.
+        buffered = getattr(self._reader, "_buffer", None)
+        while buffered:
+            try:
+                chunk = await self._reader.read(_READ_SIZE)
+            except (ConnectionError, OSError):
+                break  # what was ingested so far still carves
+            if not chunk:
+                break
+            self._buf += chunk
         return True
 
     def _available(self) -> int:

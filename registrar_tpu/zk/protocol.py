@@ -783,14 +783,14 @@ class MultiResponse:
                 op_type = OpCode.CREATE
             elif isinstance(result, SetDataResponse):
                 op_type = OpCode.SET_DATA
-            elif isinstance(result, _DeleteResult):
+            elif isinstance(result, DeleteResult):
                 op_type = OpCode.DELETE
-            elif isinstance(result, _CheckResult):
+            elif isinstance(result, CheckResult):
                 op_type = OpCode.CHECK
             else:
                 raise ValueError(f"bad multi result {result!r}")
             MultiHeader(type=op_type, done=False, err=0).write(w)
-            if not isinstance(result, (_DeleteResult, _CheckResult)):
+            if not isinstance(result, (DeleteResult, CheckResult)):
                 result.write(w)
         _MULTI_DONE.write(w)
 
@@ -808,20 +808,20 @@ class MultiResponse:
             elif hdr.type == OpCode.SET_DATA:
                 results.append(SetDataResponse.read(r))
             elif hdr.type == OpCode.DELETE:
-                results.append(_DeleteResult())
+                results.append(DeleteResult())
             elif hdr.type == OpCode.CHECK:
-                results.append(_CheckResult())
+                results.append(CheckResult())
             else:
                 raise ValueError(f"bad multi result type {hdr.type}")
 
 
 @dataclass
-class _DeleteResult:
+class DeleteResult:
     """Successful delete inside a multi (no payload on the wire)."""
 
 
 @dataclass
-class _CheckResult:
+class CheckResult:
     """Successful version check inside a multi (no payload on the wire)."""
 
 
@@ -918,24 +918,68 @@ class ZKError(Exception):
         super().__init__(f"{self.name} ({code})" + (f": {path}" if path else ""))
 
 
-#: Paths already validated by check_path.  The daemon's hot loops
-#: (heartbeat sweeps, the registration pipeline) re-validate the same
-#: handful of paths every pass; membership here short-circuits the
-#: per-component walk.  Bounded in count AND entry size (a wire frame
-#: can carry a multi-MiB path, and the server validates client-supplied
-#: paths — an unbounded-bytes cache would let a hostile stream pin
-#: gigabytes); validation is pure, so caching is safe.  FIFO eviction
-#: when full (insertion-ordered dict), so a long-lived daemon whose
-#: instance paths churn keeps caching NEW hot paths instead of freezing
-#: on the first 4096 it ever saw.
-_VALID_PATHS: dict = {}
-_VALID_PATHS_MAX = 4096
-_VALID_PATH_MAX_LEN = 256
+#: PathCache bounds (module-level so the class-body defaults resolve in
+#: module scope — class attributes are invisible to the checker's
+#: default-argument approximation, and to nested scopes generally).
+PATH_CACHE_MAX_ENTRIES = 4096
+PATH_CACHE_MAX_PATH_LEN = 256
 
 
-def check_path(path: str) -> str:
-    """Validate a znode path the way ZooKeeper's PathUtils does."""
-    if type(path) is str and path in _VALID_PATHS:
+class PathCache:
+    """Paths already validated by :func:`check_path`.
+
+    The daemon's hot loops (heartbeat sweeps, the registration pipeline)
+    re-validate the same handful of paths every pass; membership here
+    short-circuits the per-component walk.  Bounded in count AND entry
+    size (a wire frame can carry a multi-MiB path — an unbounded-bytes
+    cache would let a hostile stream pin gigabytes); validation is pure,
+    so caching is safe.  FIFO eviction when full (insertion-ordered
+    dict), so a long-lived daemon whose instance paths churn keeps
+    caching NEW hot paths instead of freezing on the first 4096 it ever
+    saw.
+
+    Each :class:`~registrar_tpu.zk.client.ZKClient` owns one; the test
+    server validates client-supplied paths with NO cache at all, so a
+    noisy or hostile peer streaming unique valid paths can never thrash
+    the daemon's own hot entries (it only pays the per-component walk it
+    asked for).
+    """
+
+    __slots__ = ("_entries", "max_entries", "max_path_len")
+
+    def __init__(
+        self,
+        max_entries: int = PATH_CACHE_MAX_ENTRIES,
+        max_path_len: int = PATH_CACHE_MAX_PATH_LEN,
+    ):
+        self._entries: dict = {}
+        self.max_entries = max_entries
+        self.max_path_len = max_path_len
+
+    def __contains__(self, path) -> bool:
+        return type(path) is str and path in self._entries
+
+    def __len__(self) -> int:
+        return len(self._entries)
+
+    def add(self, path: str) -> None:
+        if self.max_entries <= 0:
+            return  # a zero-capacity cache is disabled, not a crash
+        if len(path) > self.max_path_len:
+            return
+        if len(self._entries) >= self.max_entries:
+            self._entries.pop(next(iter(self._entries)))  # FIFO eviction
+        self._entries[path] = True
+
+
+def check_path(path: str, cache: Optional[PathCache] = None) -> str:
+    """Validate a znode path the way ZooKeeper's PathUtils does.
+
+    ``cache`` (a caller-owned :class:`PathCache`) short-circuits
+    re-validation of known-good paths; pass None for untrusted input
+    (server-side validation of peer-supplied paths) or one-off calls.
+    """
+    if cache is not None and path in cache:
         return path
     if not isinstance(path, str) or not path:
         raise ValueError("path must be a non-empty string")
@@ -950,8 +994,6 @@ def check_path(path: str) -> str:
             raise ValueError(f"relative path component: {path!r}")
         if "\x00" in comp:
             raise ValueError(f"null byte in path component: {path!r}")
-    if len(path) <= _VALID_PATH_MAX_LEN:
-        if len(_VALID_PATHS) >= _VALID_PATHS_MAX:
-            _VALID_PATHS.pop(next(iter(_VALID_PATHS)))  # FIFO eviction
-        _VALID_PATHS[path] = True
+    if cache is not None:
+        cache.add(path)
     return path

@@ -9,6 +9,8 @@ the raise guards — is pinned here.
 import asyncio
 import logging
 
+import pytest
+
 from registrar_tpu.events import EventEmitter
 
 
@@ -98,3 +100,81 @@ class TestDispatchGuards:
         loop = asyncio.get_running_loop()
         loop.call_soon(lambda: ee.emit("ev", "a", 3))
         assert await ee.wait_for("ev", timeout=5) == ("a", 3)
+
+
+class TestSpawnOwned:
+    def test_closed_loop_tasks_are_evicted(self):
+        # A loop closed without draining its tasks strands them in the
+        # module-global dispatch registry (their done-callbacks can
+        # never fire); the next spawn from a NEW loop must evict them so
+        # the set cannot grow forever in a process that cycles loops.
+        from registrar_tpu import events
+
+        registry = events._DISPATCH_TASKS
+        saved = set(registry)
+        registry.clear()
+        try:
+
+            async def forever():
+                await asyncio.Event().wait()
+
+            async def strand():
+                events.spawn_owned(forever(), registry)
+
+            loop = asyncio.new_event_loop()
+            try:
+                loop.run_until_complete(strand())
+            finally:
+                loop.close()  # deliberately without cancelling
+            assert len(registry) == 1  # stranded
+
+            async def noop():
+                pass
+
+            async def spawn_and_drain():
+                task = events.spawn_owned(noop(), registry)
+                await task
+                await asyncio.sleep(0)  # let the done-callback run
+
+            asyncio.run(spawn_and_drain())
+            assert not registry  # stranded evicted, new task discarded
+        finally:
+            registry.update(saved)
+
+    def test_spawn_without_running_loop_raises_cleanly(self):
+        # Off-loop callers must get the RuntimeError (as before the
+        # refactor), not an orphaned 'never awaited' coroutine warning.
+        import warnings
+
+        from registrar_tpu.events import spawn_owned
+
+        async def noop():
+            pass
+
+        with warnings.catch_warnings():
+            warnings.simplefilter("error", RuntimeWarning)
+            with pytest.raises(RuntimeError):
+                spawn_owned(noop(), set())
+
+    def test_emit_without_loop_closes_listener_coroutine(self, caplog):
+        # emit() off-loop follows its normal guard contract (the error
+        # is logged, other listeners still run) — but the listener's
+        # coroutine must be CLOSED, not leaked for garbage collection
+        # to warn 'coroutine was never awaited' about.
+        import gc
+        import warnings
+
+        ee = EventEmitter()
+
+        async def listener():
+            pass
+
+        ee.on("ev", listener)
+        with warnings.catch_warnings():
+            warnings.simplefilter("error", RuntimeWarning)
+            with caplog.at_level(
+                logging.ERROR, logger="registrar_tpu.events"
+            ):
+                assert ee.emit("ev") == 1
+            gc.collect()  # would raise RuntimeWarning on a leaked coro
+        assert any("listener for" in r.message for r in caplog.records)

@@ -116,6 +116,24 @@ class TestPending:
 
 
 class TestFill:
+    def test_fill_drains_buffered_burst_past_read_size(self):
+        # A burst larger than the 64 KB read size that is ALREADY
+        # buffered in the StreamReader must land in one fill(), so the
+        # reply batchers see one burst, not one 64 KB chunk at a time
+        # (ADVICE r5: pending() used to declare the burst exhausted at
+        # every read-size boundary, costing a flush+drain per chunk).
+        async def go():
+            reader = asyncio.StreamReader()
+            payload = b"x" * 40000
+            reader.feed_data(b"".join(_frame(payload) for _ in range(4)))
+            reader.feed_eof()
+            fr = FrameReader(reader)
+            assert await fr.fill()
+            return fr.carve()
+
+        frames = run(go())
+        assert len(frames) == 4  # ~160 KB ingested by a single fill()
+
     def test_eof_returns_false(self):
         fr = FrameReader(_FakeReader([]))
         assert run(fr.fill()) is False

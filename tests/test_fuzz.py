@@ -166,8 +166,8 @@ class TestRecordProperties:
             st.one_of(
                 _paths.map(lambda p: proto.CreateResponse(path=p)),
                 ints.map(lambda e: proto.ErrorResult(err=e)),
-                st.just(proto._DeleteResult()),
-                st.just(proto._CheckResult()),
+                st.just(proto.DeleteResult()),
+                st.just(proto.CheckResult()),
                 ints.map(
                     lambda v: proto.SetDataResponse(stat=proto.Stat(version=v))
                 ),

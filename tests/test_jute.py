@@ -275,17 +275,55 @@ class TestCheckPathCache:
         # quietly lose the optimization in a long-lived daemon whose
         # instance paths churn.
         from registrar_tpu.zk.protocol import (
-            _VALID_PATHS,
-            _VALID_PATHS_MAX,
+            PATH_CACHE_MAX_ENTRIES,
+            PathCache,
             check_path,
         )
 
-        for i in range(_VALID_PATHS_MAX + 50):
-            check_path(f"/evict-test/p{i}")
-        assert len(_VALID_PATHS) <= _VALID_PATHS_MAX
+        cache = PathCache()
+        for i in range(PATH_CACHE_MAX_ENTRIES + 50):
+            check_path(f"/evict-test/p{i}", cache)
+        assert len(cache) <= PATH_CACHE_MAX_ENTRIES
         # the newest path was cached even though the cap was hit ...
-        assert f"/evict-test/p{_VALID_PATHS_MAX + 49}" in _VALID_PATHS
+        assert f"/evict-test/p{PATH_CACHE_MAX_ENTRIES + 49}" in cache
         # ... and oversized paths never are
         long_path = "/x" * 200
-        check_path(long_path)
-        assert long_path not in _VALID_PATHS
+        check_path(long_path, cache)
+        assert long_path not in cache
+
+    def test_cache_is_per_instance_not_global(self):
+        # ADVICE r5: one process-global cache let any noisy peer churn
+        # the daemon's hot entries.  Validation through one cache (or
+        # none at all — the server-side mode for untrusted peer paths)
+        # must leave another client's cache untouched.
+        from registrar_tpu.zk.protocol import PathCache, check_path
+
+        mine, theirs = PathCache(max_entries=4), PathCache(max_entries=4)
+        check_path("/my/hot/path", mine)
+        # a hostile stream of unique valid paths through ANOTHER cache...
+        for i in range(100):
+            check_path(f"/thrash/p{i}", theirs)
+        # ...and through no cache at all (the server-side mode)...
+        for i in range(100):
+            check_path(f"/uncached/p{i}")
+        # ...cannot evict this client's hot entry.
+        assert "/my/hot/path" in mine
+        assert len(theirs) <= 4
+
+    def test_client_owns_a_path_cache(self):
+        # The ZKClient wires a per-instance cache into every validation.
+        from registrar_tpu.zk.client import ZKClient
+        from registrar_tpu.zk.protocol import PathCache
+
+        client = ZKClient([("127.0.0.1", 2181)])
+        assert isinstance(client._path_cache, PathCache)
+        assert client._path_cache is not ZKClient(
+            [("127.0.0.1", 2181)]
+        )._path_cache
+
+    def test_zero_capacity_cache_is_disabled_not_a_crash(self):
+        from registrar_tpu.zk.protocol import PathCache, check_path
+
+        off = PathCache(max_entries=0)
+        assert check_path("/a", off) == "/a"  # validates, caches nothing
+        assert len(off) == 0 and "/a" not in off

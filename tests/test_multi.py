@@ -46,9 +46,9 @@ class TestMultiWire:
         stat = Stat(*([0] * 11))
         results = [
             proto.CreateResponse(path="/a"),
-            proto._DeleteResult(),
+            proto.DeleteResult(),
             proto.SetDataResponse(stat=stat),
-            proto._CheckResult(),
+            proto.CheckResult(),
         ]
         w = Writer()
         proto.MultiResponse(results=results).write(w)

@@ -128,7 +128,6 @@ class TestBookkeepingBounds:
             # session, and the tree back to its resting children
             for conn in server._conns:
                 assert not conn._outbuf
-                assert conn._inflight == 0
             assert all(not t for t in server._watches.values())
             root_children = set((await client.get_children("/")))
             assert root_children == {"zookeeper"}

@@ -1,0 +1,36 @@
+"""In-tree static analysis framework behind ``make check``.
+
+The reference gates its build on jsl + jsstyle with shipped configs
+(reference Makefile:15,18, tools/jsl.node.conf, tools/jsstyle.conf).
+This package is the rebuild's equivalent, grown from the original
+two-rule ``tools/check.py`` (undefined names, unused imports) into a
+rule framework tuned to an asyncio codebase: the checker walks each
+file once, hands a shared :class:`~checklib.context.FileContext` to
+every registered rule, applies inline suppressions and the checked-in
+baseline, and renders text or JSON.
+
+Layout:
+
+  * ``model.py``     — the :class:`Finding` record every rule emits
+  * ``scopes.py``    — scope-chain resolver (undefined-name / unused-import)
+  * ``context.py``   — per-file parse + derived facts shared by rules
+  * ``registry.py``  — the rule registry and ``@rule`` decorator
+  * ``rules_names.py``, ``rules_async.py``, ``rules_hygiene.py`` — rules
+  * ``suppress.py``  — ``# check: disable=<rule> -- why`` comments
+  * ``baseline.py``  — grandfathered findings (tools/check-baseline.json)
+  * ``engine.py``    — file iteration, orchestration, output, exit code
+
+``tools/check.py`` is the CLI shim; docs/CHECKS.md is the operator-facing
+rule catalog (including how to add a rule).
+"""
+
+from checklib.model import Finding  # noqa: F401  (public surface)
+from checklib.registry import RULES, rule  # noqa: F401
+from checklib.engine import check_file, main, run  # noqa: F401
+
+# Importing the rule modules registers their rules.
+import checklib.rules_names  # check: disable=unused-import -- import registers the rules
+import checklib.rules_async  # check: disable=unused-import -- import registers the rules
+import checklib.rules_hygiene  # check: disable=unused-import -- import registers the rules
+
+__all__ = ["Finding", "RULES", "rule", "check_file", "run", "main"]

@@ -1,0 +1,124 @@
+"""Grandfathered findings (``tools/check-baseline.json``).
+
+The baseline exists so a NEW rule can land with the gate still green
+while pre-existing violations are burned down — never as a place to
+park fresh ones.  Entries match on ``(path, rule, message)`` (no line
+numbers, so unrelated edits above a site don't invalidate them), as a
+*multiset*: two identical findings need two entries.  An entry that no
+longer matches anything fails the gate as ``stale-baseline`` — burn-down
+progress must be banked by shrinking the file (``--write-baseline``
+regenerates it from the current tree).
+"""
+
+from __future__ import annotations
+
+import json
+from collections import Counter
+from typing import List, Tuple
+
+from checklib.model import Finding
+from checklib.registry import ENGINE_RULES
+
+BASELINE_VERSION = 1
+
+
+def load(path: str) -> Counter:
+    """The baseline as a Counter of (path, rule, message) keys.
+
+    A missing file is an empty baseline; a malformed one raises
+    ValueError (the gate must not silently run baseline-less).
+    """
+    try:
+        with open(path, "r", encoding="utf-8") as fh:
+            data = json.load(fh)
+    except FileNotFoundError:
+        return Counter()
+    except json.JSONDecodeError as err:
+        raise ValueError(f"malformed baseline {path}: {err}") from None
+    if data.get("version") != BASELINE_VERSION:
+        raise ValueError(
+            f"baseline {path} has unsupported version {data.get('version')!r}"
+        )
+    out: Counter = Counter()
+    findings = data.get("findings", [])
+    if not isinstance(findings, list):
+        raise ValueError(f"malformed baseline {path}: findings must be a list")
+    for entry in findings:
+        # Validate shape explicitly so a hand-edited file fails with the
+        # documented 'malformed baseline' exit (2), not a raw traceback.
+        if not isinstance(entry, dict) or not all(
+            isinstance(entry.get(k), str) for k in ("path", "rule", "message")
+        ):
+            raise ValueError(
+                f"malformed baseline {path}: each entry needs string "
+                f"path/rule/message, got {entry!r}"
+            )
+        if entry["rule"] in ENGINE_RULES:
+            # Defense against hand-edited baselines: a grandfathered
+            # syntax-error would green-light an unanalyzable file.
+            raise ValueError(
+                f"baseline {path} grandfathers engine finding "
+                f"'{entry['rule']}' ({entry['path']}); fix it instead"
+            )
+        out[(entry["path"], entry["rule"], entry["message"])] += 1
+    return out
+
+
+def apply(
+    findings: List[Finding],
+    baseline: Counter,
+    baseline_path: str,
+    in_scope=None,
+) -> Tuple[List[Finding], int]:
+    """(surviving findings + stale-baseline findings, grandfathered count).
+
+    ``in_scope`` (rel-path -> bool) is the run's coverage predicate:
+    staleness is only asserted for entries this run *would have checked
+    had the file existed* — checked files, plus anything under a target
+    directory.  A partial-target run (one file, one subtree) must not
+    condemn entries belonging to files it never looked at, while a
+    deleted file's entry IS condemned by any run whose targets cover
+    its directory (otherwise dead entries would accumulate forever and
+    the burn-down invariant — "an entry matching nothing fails the
+    gate" — would silently stop holding).  None means everything is in
+    scope.  Coverage, not filesystem probing: an existence check cannot
+    tell a scratch tree's ``registrar_tpu/x.py`` from the checker's own
+    repo's, and is cwd-dependent besides.
+    """
+    remaining = Counter(baseline)
+    kept: List[Finding] = []
+    grandfathered = 0
+    for f in findings:
+        if remaining[f.key()] > 0:
+            remaining[f.key()] -= 1
+            grandfathered += 1
+        else:
+            kept.append(f)
+    for (path, rule_name, message), count in sorted(remaining.items()):
+        if in_scope is not None and not in_scope(path):
+            continue  # outside this run's targets: not this run's call
+        if count > 0:
+            kept.append(
+                Finding(
+                    "stale-baseline",
+                    baseline_path,
+                    0,
+                    f"entry matches nothing: {path} [{rule_name}] {message}"
+                    + (f" (x{count})" if count > 1 else "")
+                    + " — regenerate with --write-baseline",
+                )
+            )
+    return kept, grandfathered
+
+
+def write(path: str, findings: List[Finding]) -> int:
+    entries = [
+        {"path": f.path, "rule": f.rule, "message": f.message}
+        for f in sorted(findings, key=Finding.sort_key)
+    ]
+    with open(path, "w", encoding="utf-8") as fh:
+        json.dump(
+            {"version": BASELINE_VERSION, "findings": entries}, fh, indent=2
+        )
+        fh.write("\n")
+    return len(entries)

@@ -1,0 +1,159 @@
+"""Per-file context shared by every rule.
+
+Parsing, the scope-resolver pass, and the cheap whole-tree fact
+collection happen once here; rules then do their own (small) walks
+against the shared tree.  Facts collected:
+
+  * ``async_def_names`` — every name bound by an ``async def`` anywhere
+    in the file (module level or nested), for the unawaited-coroutine
+    rule's "locally resolvable" test;
+  * ``local_private_attrs`` — every ``_name`` a class in this module
+    defines (``self._x = ...`` in any method, class-body assignments,
+    ``__slots__`` entries, ``def _m``) — private access *between* objects
+    of this module's own classes is cooperation, not an API poke;
+  * ``in_package`` — whether the file ships in the daemon package
+    (``registrar_tpu/``), which arms the package-only hygiene rules.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Optional, Set
+
+from checklib.scopes import ScopeAnalyzer, iter_all_args
+
+#: Path prefix (posix-relative) of shipped daemon code: package-scoped
+#: rules (blocking calls, private-attr pokes, asserts) apply here only —
+#: tests and tooling poke privates and assert by design.
+PACKAGE_PREFIX = "registrar_tpu/"
+
+
+def dotted_name(node) -> Optional[str]:
+    """``a.b.c`` for a Name/Attribute chain, else None."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if not isinstance(node, ast.Name):
+        return None
+    parts.append(node.id)
+    return ".".join(reversed(parts))
+
+
+def _class_private_attrs(cls: ast.ClassDef) -> Set[str]:
+    """Every single-underscore attribute a class body defines."""
+    out: Set[str] = set()
+    for node in ast.walk(cls):
+        # self._x = ... / self._x: T = ... anywhere in a method body
+        if isinstance(node, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+            targets = (
+                node.targets if isinstance(node, ast.Assign) else [node.target]
+            )
+            for t in targets:
+                for sub in ast.walk(t):
+                    if (
+                        isinstance(sub, ast.Attribute)
+                        and isinstance(sub.value, ast.Name)
+                        and sub.value.id in ("self", "cls")
+                    ):
+                        out.add(sub.attr)
+    for stmt in cls.body:
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            out.add(stmt.name)
+        elif isinstance(stmt, ast.Assign):
+            for t in stmt.targets:
+                if isinstance(t, ast.Name):
+                    out.add(t.id)
+                    if t.id == "__slots__":
+                        out.update(_slot_names(stmt.value))
+        elif isinstance(stmt, ast.AnnAssign) and isinstance(
+            stmt.target, ast.Name
+        ):
+            out.add(stmt.target.id)
+    return {a for a in out if a.startswith("_") and not a.startswith("__")}
+
+
+def _slot_names(value) -> Set[str]:
+    if isinstance(value, (ast.Tuple, ast.List, ast.Set)):
+        return {
+            e.value
+            for e in value.elts
+            if isinstance(e, ast.Constant) and isinstance(e.value, str)
+        }
+    return set()
+
+
+class FileContext:
+    """Everything a rule may consult about one file."""
+
+    def __init__(self, path: str, rel_path: str, source: bytes, tree: ast.Module):
+        self.path = path
+        self.rel_path = rel_path  # posix-relative; used in reports/baseline
+        self.tree = tree
+        # Split on '\n' ONLY — str.splitlines() also breaks on \f/\v/
+        # \x1c/U+2028, which ast and tokenize do NOT treat as newlines,
+        # so a form feed above a suppression comment would skew every
+        # line number below it and silently unbind the suppressions.
+        self.source_text = source.decode("utf-8", errors="replace")
+        self.source_lines = self.source_text.split("\n")
+        self.in_package = rel_path.startswith(PACKAGE_PREFIX)
+
+        analyzer = ScopeAnalyzer()
+        analyzer.visit(tree)
+        #: (rule, lineno, message) from the name rules' resolver pass.
+        self.scope_problems = analyzer.resolve()
+
+        self.async_def_names: Set[str] = set()
+        self.local_private_attrs: Set[str] = set()
+        self.classes: List[ast.ClassDef] = []
+        #: Names an async-def name may be *shadowed* by somewhere in the
+        #: file (parameters, assignments, import aliases).  The
+        #: unawaited-coroutine rule does no scope resolution, so a name
+        #: in this set is ambiguous — e.g. `def fire(notify): notify()`
+        #: beside `async def notify()` — and must not be flagged
+        #: (zero-false-positive beats coverage in a build gate).
+        self.shadowable_names: Set[str] = set()
+        #: Names bound as `with ... as <name>` targets — receivers whose
+        #: methods manage their own lifecycles (TaskGroup and friends).
+        self.cm_bound_names: Set[str] = set()
+        for node in ast.walk(tree):
+            if isinstance(node, ast.AsyncFunctionDef):
+                self.async_def_names.add(node.name)
+            elif isinstance(node, ast.FunctionDef):
+                # a sync def of the same name makes the binding ambiguous
+                self.shadowable_names.add(node.name)
+            elif isinstance(node, ast.ClassDef):
+                self.classes.append(node)
+                self.local_private_attrs |= _class_private_attrs(node)
+                self.shadowable_names.add(node.name)
+            if isinstance(
+                node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+            ):
+                for arg in iter_all_args(node.args):
+                    self.shadowable_names.add(arg.arg)
+            elif isinstance(node, ast.Name) and isinstance(
+                node.ctx, ast.Store
+            ):
+                self.shadowable_names.add(node.id)
+            elif isinstance(node, (ast.Import, ast.ImportFrom)):
+                for alias in node.names:
+                    self.shadowable_names.add(
+                        alias.asname or alias.name.split(".")[0]
+                    )
+            elif isinstance(node, (ast.With, ast.AsyncWith)):
+                # `async with asyncio.TaskGroup() as tg:` — a context
+                # manager OWNS what it hands out; tg.create_task(...)
+                # discarding the handle is the canonical idiom, not the
+                # GC hazard the dropped-task rule exists for.
+                for item in node.items:
+                    if item.optional_vars is not None:
+                        for sub in ast.walk(item.optional_vars):
+                            if isinstance(sub, ast.Name):
+                                self.cm_bound_names.add(sub.id)
+
+    def async_methods_of(self, cls: ast.ClassDef) -> Set[str]:
+        return {
+            stmt.name
+            for stmt in cls.body
+            if isinstance(stmt, ast.AsyncFunctionDef)
+        }

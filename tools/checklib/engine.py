@@ -1,0 +1,296 @@
+"""Orchestration: iterate files, run rules, apply suppressions and the
+baseline, render text or JSON, exit nonzero on anything left."""
+
+from __future__ import annotations
+
+import argparse
+import ast
+import json
+import os
+import sys
+from typing import List, Optional
+
+from checklib import baseline as baseline_mod
+from checklib.context import FileContext
+from checklib.model import Finding
+from checklib.registry import ENGINE_RULES, RULES
+from checklib.suppress import apply_suppressions, parse_suppressions
+
+DEFAULT_TARGETS = [
+    "registrar_tpu",
+    "tests",
+    "tools",
+    "bench.py",
+    "__graft_entry__.py",
+]
+
+#: The tree this checker ships in (parent of tools/).  Report/baseline
+#: paths and the package-scope test anchor here, NOT at the cwd, so
+#: `python tools/check.py zk` run from inside registrar_tpu/ still arms
+#: the package-scoped rules and produces stable baseline keys.
+REPO_ROOT = os.path.dirname(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+#: Default baseline location, resolved relative to the tools/ directory
+#: (not the cwd) so `python tools/check.py` works from anywhere.
+DEFAULT_BASELINE = os.path.join(REPO_ROOT, "tools", "check-baseline.json")
+
+
+def _default_rel_path(path: str) -> str:
+    """Repo-root-relative for files in this repo; cwd-relative otherwise
+    (scratch trees — e.g. the seeded-violation tests — carry their own
+    registrar_tpu/ prefix relative to wherever the checker runs)."""
+    ap = os.path.abspath(path)
+    if ap == REPO_ROOT:
+        return "."  # the repo root itself (normalizes to the
+        # everything-in-scope coverage prefix), not cwd-relative
+    root = REPO_ROOT + os.sep
+    if ap.startswith(root):
+        return ap[len(root):].replace(os.sep, "/")
+    return os.path.relpath(path).replace(os.sep, "/")
+
+
+def iter_python_files(targets):
+    for target in targets:
+        if os.path.isfile(target):
+            yield target
+        elif os.path.isdir(target):
+            for dirpath, dirnames, filenames in os.walk(target):
+                dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+                for fn in sorted(filenames):
+                    if fn.endswith(".py"):
+                        yield os.path.join(dirpath, fn)
+        else:
+            # A lint gate that silently checks zero files would report
+            # success on a wrong cwd or a typo'd path; fail instead.
+            raise FileNotFoundError(f"check target does not exist: {target}")
+
+
+def check_file(path: str, rel_path: Optional[str] = None) -> List[Finding]:
+    """All findings for one file, inline suppressions applied (the
+    baseline is a whole-run concept and is applied by :func:`run`).
+
+    ``rel_path`` overrides the reported path — the package-scoped rules
+    key off it (see checklib.context.PACKAGE_PREFIX), and tests use it
+    to exercise them on fixtures outside the package tree.
+    """
+    if rel_path is None:
+        rel_path = _default_rel_path(path)
+    with open(path, "rb") as fh:
+        source = fh.read()
+    try:
+        tree = ast.parse(source, filename=path)
+    except SyntaxError as err:
+        return [
+            Finding(
+                "syntax-error",
+                rel_path,
+                err.lineno or 0,
+                f"syntax error: {err.msg}",
+            )
+        ]
+    ctx = FileContext(path, rel_path, source, tree)
+    problems = parse_suppressions(ctx)
+    findings: List[Finding] = []
+    for rule in RULES.values():
+        if rule.applies_to(ctx):
+            findings.extend(rule.run(ctx))
+    findings = apply_suppressions(ctx, findings)
+    findings.extend(problems)
+    return findings
+
+
+def run(
+    targets,
+    baseline_path: Optional[str] = None,
+) -> "RunResult":
+    """Check every file under ``targets``; apply the baseline if given."""
+    findings: List[Finding] = []
+    checked_rel_paths = set()
+    # Directory targets define the run's *coverage*: a baseline entry
+    # under one of these prefixes was either checked, or names a file
+    # that no longer exists — in both cases this run may judge it stale.
+    # '.' (or the repo root) normalizes to the empty prefix = everything
+    # in scope, so `check.py . --baseline ...` still detects staleness.
+    covered_prefixes = []
+    for t in targets:
+        if not os.path.isdir(t):
+            continue
+        rel = _default_rel_path(t)
+        covered_prefixes.append(
+            "" if rel in (".", "") else rel.rstrip("/") + "/"
+        )
+    for path in iter_python_files(targets):
+        rel = _default_rel_path(path)
+        if rel in checked_rel_paths:
+            continue  # overlapping targets: check (and count) each file once
+        checked_rel_paths.add(rel)
+        findings.extend(check_file(path, rel_path=rel))
+    findings.sort(key=Finding.sort_key)
+    grandfathered = 0
+
+    def in_scope(p):
+        return p in checked_rel_paths or any(
+            p.startswith(pre) for pre in covered_prefixes
+        )
+
+    if baseline_path is not None:
+        bl = baseline_mod.load(baseline_path)
+        # Same repo-root anchoring as every finding path, so the JSON
+        # report's stale-baseline entries don't vary with the cwd.
+        rel_bl = _default_rel_path(baseline_path)
+        findings, grandfathered = baseline_mod.apply(
+            findings, bl, rel_bl, in_scope=in_scope
+        )
+        findings.sort(key=Finding.sort_key)
+    return RunResult(findings, len(checked_rel_paths), grandfathered, in_scope)
+
+
+class RunResult:
+    __slots__ = ("findings", "checked_files", "grandfathered", "in_scope")
+
+    def __init__(self, findings, checked_files, grandfathered, in_scope=None):
+        self.findings = findings
+        self.checked_files = checked_files
+        self.grandfathered = grandfathered
+        #: rel-path -> bool: was this path covered by the run's targets?
+        self.in_scope = in_scope or (lambda p: True)
+
+    def to_dict(self) -> dict:
+        return {
+            "version": 1,
+            "checked_files": self.checked_files,
+            "grandfathered": self.grandfathered,
+            "problem_count": len(self.findings),
+            "problems": [f.to_dict() for f in self.findings],
+        }
+
+
+def _render_text(result: RunResult, out) -> None:
+    for f in result.findings:
+        print(f.render(), file=out)
+
+
+def _summary(result: RunResult) -> str:
+    extra = (
+        f" ({result.grandfathered} grandfathered by baseline)"
+        if result.grandfathered
+        else ""
+    )
+    return (
+        f"check: {len(result.findings)} problem(s) in "
+        f"{result.checked_files} file(s){extra}"
+    )
+
+
+def _list_rules() -> str:
+    lines = ["rules (suppress with '# check: disable=<rule> -- <why>'):"]
+    for rule in RULES.values():
+        where = "" if rule.scope == "all" else "  [package-only]"
+        lines.append(f"  {rule.name:24s} {rule.description}{where}")
+    lines.append("engine findings (not directly suppressible rules):")
+    for name, desc in ENGINE_RULES.items():
+        lines.append(f"  {name:24s} {desc}")
+    return "\n".join(lines)
+
+
+def main(argv) -> int:
+    parser = argparse.ArgumentParser(
+        prog="check",
+        description="In-tree static analysis gate (see docs/CHECKS.md).",
+    )
+    parser.add_argument(
+        "targets", nargs="*", help="files/directories (default: the tree)"
+    )
+    parser.add_argument(
+        "--format", choices=("text", "json"), default="text", dest="fmt"
+    )
+    parser.add_argument(
+        "--output", help="write the report here instead of stdout"
+    )
+    parser.add_argument(
+        "--baseline",
+        default=DEFAULT_BASELINE,
+        help="baseline file (default: tools/check-baseline.json)",
+    )
+    parser.add_argument(
+        "--no-baseline",
+        action="store_true",
+        help="report grandfathered findings too",
+    )
+    parser.add_argument(
+        "--write-baseline",
+        action="store_true",
+        help="regenerate the baseline from the current findings and exit",
+    )
+    parser.add_argument(
+        "--list-rules", action="store_true", help="print the rule catalog"
+    )
+    args = parser.parse_args(argv[1:])
+
+    if args.list_rules:
+        print(_list_rules())
+        return 0
+
+    targets = args.targets or DEFAULT_TARGETS
+    try:
+        if args.write_baseline:
+            result = run(targets, baseline_path=None)
+            # Engine findings can NEVER be grandfathered: a baselined
+            # syntax-error would green-light a file no rule analyzes at
+            # all, and suppression problems are trivially fixable.
+            rule_findings = [f for f in result.findings if f.rule in RULES]
+            excluded = [f for f in result.findings if f.rule not in RULES]
+            # A partial-target rewrite must PRESERVE entries for files
+            # outside its coverage — otherwise `check.py a.py
+            # --write-baseline` would silently drop every other file's
+            # grandfathered findings and turn the next full gate red.
+            preserved = [
+                Finding(rule_name, path, 0, message)
+                for (path, rule_name, message), n in sorted(
+                    baseline_mod.load(args.baseline).items()
+                )
+                for _ in range(n)
+                if not result.in_scope(path)
+            ]
+            count = baseline_mod.write(
+                args.baseline, rule_findings + preserved
+            )
+            print(f"check: wrote {count} finding(s) to {args.baseline}")
+            if excluded:
+                for f in excluded:
+                    print(f.render())
+                print(
+                    f"check: {len(excluded)} engine finding(s) cannot be "
+                    "grandfathered; fix them",
+                    file=sys.stderr,
+                )
+                return 1
+            return 0
+        result = run(
+            targets,
+            baseline_path=None if args.no_baseline else args.baseline,
+        )
+    except (FileNotFoundError, ValueError) as err:
+        print(f"check: {err}", file=sys.stderr)
+        return 2
+
+    out = sys.stdout
+    close = None
+    if args.output:
+        out = close = open(args.output, "w", encoding="utf-8")
+    try:
+        if args.fmt == "json":
+            json.dump(result.to_dict(), out, indent=2)
+            out.write("\n")
+        else:
+            _render_text(result, out)
+    finally:
+        if close is not None:
+            close.close()
+
+    if result.findings:
+        print(_summary(result), file=sys.stderr)
+        return 1
+    return 0

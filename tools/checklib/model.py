@@ -1,0 +1,55 @@
+"""The finding record every rule emits.
+
+A finding identifies itself by ``(path, rule, message)`` — deliberately
+NOT by line number — so the baseline survives unrelated edits above a
+grandfathered site (lines drift; the message names the construct).
+"""
+
+from __future__ import annotations
+
+
+class Finding:
+    """One problem at one site.
+
+    ``line`` is 1-based; 0 means "whole file" (e.g. a stale-baseline
+    entry or an unreadable file).
+    """
+
+    __slots__ = ("rule", "path", "line", "message")
+
+    def __init__(self, rule: str, path: str, line: int, message: str):
+        self.rule = rule
+        self.path = path
+        self.line = line
+        self.message = message
+
+    def key(self):
+        """Baseline identity: everything but the line number."""
+        return (self.path, self.rule, self.message)
+
+    def sort_key(self):
+        return (self.path, self.line, self.rule, self.message)
+
+    def to_dict(self) -> dict:
+        return {
+            "path": self.path,
+            "line": self.line,
+            "rule": self.rule,
+            "message": self.message,
+        }
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}: [{self.rule}] {self.message}"
+
+    def __repr__(self):  # debug aid only
+        return f"Finding({self.render()!r})"
+
+    def __eq__(self, other):
+        return (
+            isinstance(other, Finding)
+            and self.key() == other.key()
+            and self.line == other.line
+        )
+
+    def __hash__(self):
+        return hash((self.key(), self.line))

@@ -1,0 +1,74 @@
+"""The rule registry.
+
+A rule is a generator function ``(ctx: FileContext) -> Iterator[Finding]``
+registered with the :func:`rule` decorator.  ``scope`` controls where it
+runs: ``"all"`` (every checked file) or ``"package"`` (shipped daemon
+code under ``registrar_tpu/`` only — tests and tooling legitimately
+assert, block, and poke privates).
+
+Adding a rule (the full recipe is in docs/CHECKS.md):
+
+    @rule("my-rule", "one-line description", scope="all")
+    def my_rule(ctx):
+        for node in ast.walk(ctx.tree):
+            ...
+            yield finding(ctx, "my-rule", node, "message")
+
+then add a seeded-violation test to tests/test_check.py and a catalog
+entry to docs/CHECKS.md.  Rule names are kebab-case and stable: they are
+the suppression/baseline identity.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, Iterable
+
+from checklib.model import Finding
+
+
+class Rule:
+    __slots__ = ("name", "description", "scope", "func")
+
+    def __init__(self, name: str, description: str, scope: str, func: Callable):
+        self.name = name
+        self.description = description
+        self.scope = scope  # "all" | "package"
+        self.func = func
+
+    def applies_to(self, ctx) -> bool:
+        return self.scope == "all" or ctx.in_package
+
+    def run(self, ctx) -> Iterable[Finding]:
+        return self.func(ctx)
+
+
+#: name -> Rule, in registration order (the catalog order).
+RULES: Dict[str, Rule] = {}
+
+#: Finding rules that are not produced by registered rule functions but
+#: by the engine itself; they share the rule namespace so suppressions
+#: and the baseline treat them uniformly.
+ENGINE_RULES = {
+    "syntax-error": "file does not parse; nothing else can be checked",
+    "bad-suppression": "malformed suppression comment (missing justification)",
+    "unused-suppression": "suppression comment that matched no finding",
+    "stale-baseline": "baseline entry that no longer matches any finding",
+}
+
+
+def rule(name: str, description: str, scope: str = "all"):
+    if scope not in ("all", "package"):
+        raise ValueError(f"bad rule scope {scope!r}")
+
+    def register(func: Callable) -> Callable:
+        if name in RULES or name in ENGINE_RULES:
+            raise ValueError(f"duplicate rule name {name!r}")
+        RULES[name] = Rule(name, description, scope, func)
+        return func
+
+    return register
+
+
+def finding(ctx, rule_name: str, node, message: str) -> Finding:
+    """Convenience constructor anchoring a finding at an AST node."""
+    return Finding(rule_name, ctx.rel_path, getattr(node, "lineno", 0), message)

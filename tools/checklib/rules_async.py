@@ -1,0 +1,273 @@
+"""Asyncio concurrency rules.
+
+These target the bug classes an ~8k-LoC asyncio tree actually grows
+(un-awaited coroutines, GC'd fire-and-forget tasks, event-loop stalls,
+swallowed cancellation) — the analysis is intentionally local to one
+file: a call is only treated as a coroutine when it resolves to an
+``async def`` in the same module, which keeps every rule zero-false-
+positive on this tree at the cost of missing cross-module cases (the
+suppression/baseline machinery is for the opposite error direction).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator, Optional, Tuple
+
+from checklib.context import FileContext, dotted_name
+from checklib.registry import finding, rule
+
+#: Known event-loop-blocking callables (dotted as written at call sites).
+#: socket.create_connection and the subprocess waiters wedge the whole
+#: loop for their full duration; time.sleep for its argument.
+BLOCKING_CALLS = frozenset(
+    {
+        "time.sleep",
+        "subprocess.run",
+        "subprocess.call",
+        "subprocess.check_call",
+        "subprocess.check_output",
+        "subprocess.getoutput",
+        "subprocess.getstatusoutput",
+        "socket.create_connection",
+        "socket.getaddrinfo",
+        "os.system",
+        "os.popen",
+    }
+)
+
+_TASK_SPAWNERS = ("create_task", "ensure_future")
+
+
+def _walk_state(
+    node: ast.AST, in_async: bool = False, cls: Optional[ast.ClassDef] = None
+) -> Iterator[Tuple[ast.AST, bool, Optional[ast.ClassDef]]]:
+    """Yield every node with (inside-async-def?, enclosing class) state.
+
+    Only a function's BODY takes on that function's context: its
+    decorators, argument defaults, and annotations evaluate at
+    *definition* time in the enclosing context (a blocking call in an
+    async def's decorator runs wherever the def statement runs, not on
+    an awaited frame — and conversely, a sync def nested in an async
+    body IS defined on the loop).  A nested sync ``def``/``lambda``
+    body resets ``in_async`` — it runs whenever it is *called*, which
+    need not be on the loop.
+    """
+    yield node, in_async, cls
+    if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+        body = [node.body] if isinstance(node, ast.Lambda) else node.body
+        body_ids = {id(stmt) for stmt in body}
+        body_async = isinstance(node, ast.AsyncFunctionDef)
+        for child in ast.iter_child_nodes(node):
+            child_cls = child if isinstance(child, ast.ClassDef) else cls
+            if id(child) in body_ids:
+                yield from _walk_state(child, body_async, child_cls)
+            else:  # decorators, args (defaults/annotations), returns
+                yield from _walk_state(child, in_async, child_cls)
+        return
+    for child in ast.iter_child_nodes(node):
+        child_cls = child if isinstance(child, ast.ClassDef) else cls
+        yield from _walk_state(child, in_async, child_cls)
+
+
+def _expr_call(node) -> Optional[ast.Call]:
+    """The Call of a bare expression statement (result discarded)."""
+    if isinstance(node, ast.Expr) and isinstance(node.value, ast.Call):
+        return node.value
+    return None
+
+
+@rule(
+    "unawaited-coroutine",
+    "call to a same-module async def whose result is discarded",
+)
+def unawaited_coroutine(ctx: FileContext):
+    for node, _in_async, cls in _walk_state(ctx.tree):
+        call = _expr_call(node)
+        if call is None:
+            continue
+        func = call.func
+        if (
+            isinstance(func, ast.Name)
+            and func.id in ctx.async_def_names
+            and func.id not in ctx.shadowable_names
+        ):
+            yield finding(
+                ctx,
+                "unawaited-coroutine",
+                node,
+                f"coroutine '{func.id}()' is never awaited",
+            )
+        elif (
+            isinstance(func, ast.Attribute)
+            and isinstance(func.value, ast.Name)
+            and func.value.id in ("self", "cls")
+            and cls is not None
+            and func.attr in ctx.async_methods_of(cls)
+        ):
+            yield finding(
+                ctx,
+                "unawaited-coroutine",
+                node,
+                f"coroutine 'self.{func.attr}()' is never awaited",
+            )
+
+
+@rule(
+    "dropped-task",
+    "create_task/ensure_future result discarded (task can be GC'd mid-run)",
+)
+def dropped_task(ctx: FileContext):
+    # The event loop holds only a weak reference to running tasks: a
+    # task whose last strong reference is the discarded return value can
+    # be garbage-collected mid-flight.  Keep the handle (a tracked set,
+    # an attribute) or add a done-callback that owns it.
+    for node in ast.walk(ctx.tree):
+        call = _expr_call(node)
+        if call is None:
+            continue
+        func = call.func
+        # Any .create_task/.ensure_future attribute counts, whatever the
+        # receiver — including chains rooted in a call, the repo's own
+        # `asyncio.get_running_loop().create_task(...)` idiom, which
+        # dotted_name() alone cannot resolve.
+        if isinstance(func, ast.Attribute) and func.attr in _TASK_SPAWNERS:
+            if (
+                isinstance(func.value, ast.Name)
+                and func.value.id in ctx.cm_bound_names
+            ):
+                # a with-statement capture (asyncio.TaskGroup() as tg)
+                # owns its tasks — discarding the handle is correct
+                continue
+            shown = dotted_name(func) or ast.unparse(func)
+        elif isinstance(func, ast.Name) and func.id in _TASK_SPAWNERS:
+            shown = func.id
+        else:
+            continue
+        yield finding(
+            ctx,
+            "dropped-task",
+            node,
+            f"task handle from '{shown}(...)' is discarded",
+        )
+
+
+@rule(
+    "blocking-call-in-async",
+    "event-loop-blocking call inside an async def",
+    scope="package",
+)
+def blocking_call_in_async(ctx: FileContext):
+    for node, in_async, _cls in _walk_state(ctx.tree):
+        if not in_async or not isinstance(node, ast.Call):
+            continue
+        name = dotted_name(node.func)
+        if name in BLOCKING_CALLS:
+            yield finding(
+                ctx,
+                "blocking-call-in-async",
+                node,
+                f"blocking call '{name}(...)' inside async def",
+            )
+        elif isinstance(node.func, ast.Name) and node.func.id == "open":
+            mode = _open_mode(node)
+            if mode is not None and any(c in mode for c in "wax+"):
+                yield finding(
+                    ctx,
+                    "blocking-call-in-async",
+                    node,
+                    f"blocking call 'open(..., {mode!r})' inside async def",
+                )
+
+
+def _open_mode(call: ast.Call) -> Optional[str]:
+    mode = call.args[1] if len(call.args) >= 2 else None
+    if mode is None:
+        for kw in call.keywords:
+            if kw.arg == "mode":
+                mode = kw.value
+    if isinstance(mode, ast.Constant) and isinstance(mode.value, str):
+        return mode.value
+    return None
+
+
+#: Exception expressions that catch CancelledError.
+_CANCEL_CATCHERS = frozenset(
+    {
+        "BaseException",
+        "CancelledError",
+        "asyncio.CancelledError",
+        "concurrent.futures.CancelledError",
+    }
+)
+
+
+def _catches_cancel(handler: ast.ExceptHandler) -> bool:
+    t = handler.type
+    if t is None:
+        return True  # bare except
+    exprs = t.elts if isinstance(t, ast.Tuple) else [t]
+    return any(dotted_name(e) in _CANCEL_CATCHERS for e in exprs)
+
+
+def _reraises(handler: ast.ExceptHandler) -> bool:
+    """A ``raise`` anywhere in the handler body (excluding nested defs)
+    counts: bare re-raise propagates the CancelledError, and a converting
+    raise still fails the await — the hazard is *silent* swallowing."""
+    stack = list(handler.body)
+    while stack:
+        node = stack.pop()
+        if isinstance(node, ast.Raise):
+            return True
+        if isinstance(
+            node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+        ):
+            continue
+        stack.extend(ast.iter_child_nodes(node))
+    return False
+
+
+def _is_cancel_reap(try_node: ast.Try) -> bool:
+    """The cancel-and-reap idiom: every statement in the try body is an
+    ``await`` of a plain name/attribute (``await task`` after
+    ``task.cancel()``) — there the CancelledError is one this code just
+    induced, and swallowing it is the point."""
+    for stmt in try_node.body:
+        value = None
+        if isinstance(stmt, ast.Expr):
+            value = stmt.value
+        elif isinstance(stmt, ast.Assign):
+            value = stmt.value
+        if not isinstance(value, ast.Await):
+            return False
+        if not isinstance(value.value, (ast.Name, ast.Attribute)):
+            return False
+    return bool(try_node.body)
+
+
+@rule(
+    "swallowed-cancel",
+    "handler catches CancelledError (or broader) without re-raising",
+)
+def swallowed_cancel(ctx: FileContext):
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Try):
+            continue
+        for handler in node.handlers:
+            if not _catches_cancel(handler):
+                continue
+            if _reraises(handler):
+                continue
+            if _is_cancel_reap(node):
+                continue
+            what = (
+                "bare except"
+                if handler.type is None
+                else f"'except {ast.unparse(handler.type)}'"
+            )
+            yield finding(
+                ctx,
+                "swallowed-cancel",
+                handler,
+                f"{what} swallows CancelledError (no re-raise)",
+            )

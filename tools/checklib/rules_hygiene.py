@@ -1,0 +1,100 @@
+"""Package-hygiene rules (shipped daemon code only).
+
+Tests and tooling poke private attributes and assert by design, so the
+private-attr and assert rules arm only under ``registrar_tpu/`` (see
+``checklib.context.PACKAGE_PREFIX``); mutable defaults are a hazard
+everywhere.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from checklib.context import FileContext
+from checklib.registry import finding, rule
+from checklib.scopes import iter_defaults
+
+
+@rule(
+    "unguarded-private-attr",
+    "private attribute access on a foreign object without a getattr guard",
+    scope="package",
+)
+def unguarded_private_attr(ctx: FileContext):
+    # ``proc._transport`` / ``reader._buffer`` style pokes at another
+    # library's internals break silently when that library's internals
+    # move; the sanctioned form is ``getattr(obj, "_attr", None)`` plus a
+    # None check (which this rule naturally does not see — getattr is a
+    # Call, not an Attribute).  Private attributes that any class in the
+    # *same module* defines are cooperation, not pokes, and are exempt.
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Attribute):
+            continue
+        attr = node.attr
+        if not attr.startswith("_") or attr.startswith("__"):
+            continue
+        if isinstance(node.value, ast.Name) and node.value.id in (
+            "self",
+            "cls",
+        ):
+            continue
+        if attr in ctx.local_private_attrs:
+            continue
+        yield finding(
+            ctx,
+            "unguarded-private-attr",
+            node,
+            f"unguarded private attribute access '.{attr}' on a foreign "
+            "object (use getattr(..., None) and handle absence)",
+        )
+
+
+#: Built-in factory calls whose results are as mutable as a literal.
+_MUTABLE_FACTORIES = frozenset({"list", "dict", "set", "bytearray"})
+
+
+@rule(
+    "mutable-default",
+    "mutable default argument shared across calls",
+)
+def mutable_default(ctx: FileContext):
+    for node in ast.walk(ctx.tree):
+        if not isinstance(
+            node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+        ):
+            continue
+        name = getattr(node, "name", "<lambda>")
+        for default in iter_defaults(node.args):
+            if isinstance(
+                default,
+                (ast.List, ast.Dict, ast.Set, ast.ListComp, ast.DictComp,
+                 ast.SetComp),
+            ) or (
+                isinstance(default, ast.Call)
+                and isinstance(default.func, ast.Name)
+                and default.func.id in _MUTABLE_FACTORIES
+            ):
+                yield finding(
+                    ctx,
+                    "mutable-default",
+                    default,
+                    f"mutable default argument in '{name}()' is shared "
+                    "across calls (default to None and create inside)",
+                )
+
+
+@rule(
+    "assert-in-package",
+    "assert statement in shipped package code (vanishes under -O)",
+    scope="package",
+)
+def assert_in_package(ctx: FileContext):
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, ast.Assert):
+            yield finding(
+                ctx,
+                "assert-in-package",
+                node,
+                "assert in package code is stripped under -O; raise an "
+                "exception for runtime invariants",
+            )

@@ -1,0 +1,32 @@
+"""Name-resolution rules: the two the original checker shipped.
+
+The heavy lifting (scope chain, load resolution) runs once in
+:class:`checklib.scopes.ScopeAnalyzer` during context construction;
+these rules just re-emit its problems under their registered names so
+suppressions and the baseline address them like any other rule.
+"""
+
+from __future__ import annotations
+
+from checklib.model import Finding
+from checklib.registry import rule
+
+
+@rule(
+    "undefined-name",
+    "a Name load that resolves to no binding in the scope chain",
+)
+def undefined_name(ctx):
+    for rule_name, lineno, message in ctx.scope_problems:
+        if rule_name == "undefined-name":
+            yield Finding(rule_name, ctx.rel_path, lineno, message)
+
+
+@rule(
+    "unused-import",
+    "an import binding never referenced anywhere in the module",
+)
+def unused_import(ctx):
+    for rule_name, lineno, message in ctx.scope_problems:
+        if rule_name == "unused-import":
+            yield Finding(rule_name, ctx.rel_path, lineno, message)

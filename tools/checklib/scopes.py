@@ -1,0 +1,287 @@
+"""Scope-chain resolver shared by the name rules.
+
+Ported intact from the original two-rule ``tools/check.py``: one visitor
+builds the scope tree (function scopes, class bodies invisible to nested
+scopes per Python's scoping rules, comprehension scopes, walrus/global/
+nonlocal placement), records every Name load, and resolves them against
+the chain afterwards.  The deliberate approximations are unchanged and
+verified against this repository: default-argument expressions resolve
+in the scope of the ``def`` rather than the enclosing scope, and a
+module containing ``from x import *`` skips undefined-name resolution.
+"""
+
+from __future__ import annotations
+
+import ast
+import builtins
+
+BUILTIN_NAMES = set(dir(builtins)) | {
+    "__file__",
+    "__name__",
+    "__doc__",
+    "__package__",
+    "__spec__",
+    "__loader__",
+    "__builtins__",
+    "__debug__",
+    "__path__",
+    "__all__",
+    "__version__",
+    "__annotations__",
+    "__dict__",
+    "__class__",  # implicit cell in methods using super()/__class__
+}
+
+
+# match-statement nodes exist only on Python 3.10+; isinstance against an
+# empty tuple is simply False on 3.9 (the package's floor).
+_MATCH_AS = getattr(ast, "MatchAs", ())
+_MATCH_STAR = getattr(ast, "MatchStar", ())
+_MATCH_MAPPING = getattr(ast, "MatchMapping", ())
+
+
+def iter_all_args(args):
+    """Every arg object of an arguments node, across all five kinds —
+    the ONE copy of the flattening (scope binding, lambda binding, and
+    the shadowable-name collection all consume it)."""
+    return (
+        list(args.posonlyargs)
+        + list(args.args)
+        + list(args.kwonlyargs)
+        + ([args.vararg] if args.vararg else [])
+        + ([args.kwarg] if args.kwarg else [])
+    )
+
+
+def iter_defaults(args):
+    """Every present default expression of an arguments node — the ONE
+    copy of the sibling flattening (scope resolution visits these, the
+    mutable-default rule inspects them)."""
+    return list(args.defaults) + [
+        d for d in args.kw_defaults if d is not None
+    ]
+
+
+class Scope:
+    __slots__ = ("node", "kind", "bindings", "parent")
+
+    def __init__(self, node, kind, parent):
+        self.node = node
+        self.kind = kind  # "module" | "function" | "class" | "comprehension"
+        self.bindings = set()
+        self.parent = parent
+
+
+def _bind_target(scope, target):
+    """Bind every name created by an assignment target node."""
+    for node in ast.walk(target):
+        if isinstance(node, ast.Name) and isinstance(
+            node.ctx, (ast.Store, ast.Del)
+        ):
+            scope.bindings.add(node.id)
+        elif isinstance(node, _MATCH_AS) and node.name:
+            scope.bindings.add(node.name)
+        elif isinstance(node, _MATCH_STAR) and node.name:
+            scope.bindings.add(node.name)
+        elif isinstance(node, _MATCH_MAPPING) and node.rest:
+            scope.bindings.add(node.rest)
+
+
+def _function_scope(scope):
+    """Nearest enclosing scope where a walrus/global binding lands."""
+    s = scope
+    while s.kind == "comprehension":
+        s = s.parent
+    return s
+
+
+class ScopeAnalyzer(ast.NodeVisitor):
+    """Collects bindings/loads/imports; :meth:`resolve` yields problems
+    as ``(rule, lineno, message)`` tuples."""
+
+    def __init__(self):
+        self.module_scope = None
+        self.scope = None
+        self.loads = []  # (name, lineno, scope) resolved after collection
+        self.used_names = set()  # every load anywhere, for unused-import
+        self.imports = []  # (alias-name, lineno, is_reexport)
+        self.has_star_import = False
+
+    # -- scope plumbing ---------------------------------------------------
+
+    def _push(self, node, kind):
+        self.scope = Scope(node, kind, self.scope)
+        if kind == "module":
+            self.module_scope = self.scope
+        return self.scope
+
+    def _pop(self):
+        self.scope = self.scope.parent
+
+    # -- visitors ---------------------------------------------------------
+
+    def visit_Module(self, node):
+        self._push(node, "module")
+        self.generic_visit(node)
+        self._pop()
+
+    def _visit_function(self, node):
+        self.scope.bindings.add(node.name)
+        for dec in node.decorator_list:
+            self.visit(dec)
+        if node.returns:
+            self.visit(node.returns)
+        scope = self._push(node, "function")
+        args = node.args
+        for a in iter_all_args(args):
+            scope.bindings.add(a.arg)
+            if a.annotation:
+                self.visit(a.annotation)
+        for default in iter_defaults(args):
+            self.visit(default)
+        for stmt in node.body:
+            self.visit(stmt)
+        self._pop()
+
+    visit_FunctionDef = _visit_function
+    visit_AsyncFunctionDef = _visit_function
+
+    def visit_Lambda(self, node):
+        scope = self._push(node, "function")
+        args = node.args
+        for a in iter_all_args(args):
+            scope.bindings.add(a.arg)
+        for default in iter_defaults(args):
+            self.visit(default)
+        self.visit(node.body)
+        self._pop()
+
+    def visit_ClassDef(self, node):
+        self.scope.bindings.add(node.name)
+        for dec in node.decorator_list:
+            self.visit(dec)
+        for base in list(node.bases) + [kw.value for kw in node.keywords]:
+            self.visit(base)
+        self._push(node, "class")
+        for stmt in node.body:
+            self.visit(stmt)
+        self._pop()
+
+    def _visit_comprehension(self, node):
+        # First iterable evaluates in the enclosing scope.
+        if node.generators:
+            self.visit(node.generators[0].iter)
+        scope = self._push(node, "comprehension")
+        for i, gen in enumerate(node.generators):
+            _bind_target(scope, gen.target)
+            if i > 0:
+                self.visit(gen.iter)
+            for cond in gen.ifs:
+                self.visit(cond)
+        if isinstance(node, ast.DictComp):
+            self.visit(node.key)
+            self.visit(node.value)
+        else:
+            self.visit(node.elt)
+        self._pop()
+
+    visit_ListComp = _visit_comprehension
+    visit_SetComp = _visit_comprehension
+    visit_GeneratorExp = _visit_comprehension
+    visit_DictComp = _visit_comprehension
+
+    def visit_Import(self, node):
+        for alias in node.names:
+            name = alias.asname or alias.name.split(".")[0]
+            self.scope.bindings.add(name)
+            if self.scope is self.module_scope:
+                reexport = alias.asname is not None and alias.asname == alias.name
+                self.imports.append((name, node.lineno, reexport))
+
+    def visit_ImportFrom(self, node):
+        for alias in node.names:
+            if alias.name == "*":
+                self.has_star_import = True
+                continue
+            name = alias.asname or alias.name
+            self.scope.bindings.add(name)
+            if self.scope is self.module_scope and node.module != "__future__":
+                reexport = alias.asname is not None and alias.asname == alias.name
+                self.imports.append((name, node.lineno, reexport))
+
+    def visit_Global(self, node):
+        for name in node.names:
+            self.scope.bindings.add(name)
+            self.module_scope.bindings.add(name)
+
+    def visit_Nonlocal(self, node):
+        for name in node.names:
+            self.scope.bindings.add(name)
+
+    def visit_Name(self, node):
+        if isinstance(node.ctx, (ast.Store, ast.Del)):
+            self.scope.bindings.add(node.id)
+        else:
+            self.loads.append((node.id, node.lineno, self.scope))
+            self.used_names.add(node.id)
+
+    def visit_NamedExpr(self, node):
+        # walrus binds in the nearest function/module scope
+        if isinstance(node.target, ast.Name):
+            _function_scope(self.scope).bindings.add(node.target.id)
+        self.visit(node.value)
+
+    def visit_ExceptHandler(self, node):
+        if node.name:
+            self.scope.bindings.add(node.name)
+        self.generic_visit(node)
+
+    def visit_For(self, node):
+        _bind_target(self.scope, node.target)
+        self.generic_visit(node)
+
+    visit_AsyncFor = visit_For
+
+    def visit_withitem(self, node):
+        if node.optional_vars:
+            _bind_target(self.scope, node.optional_vars)
+        self.visit(node.context_expr)
+
+    def visit_match_case(self, node):
+        _bind_target(self.scope, node.pattern)
+        self.generic_visit(node)
+
+    def visit_Constant(self, node):
+        # __all__ entries and other string constants may name module
+        # attributes; count them toward import usage (not name loads).
+        if isinstance(node.value, str) and node.value.isidentifier():
+            self.used_names.add(node.value)
+
+    # -- resolution -------------------------------------------------------
+
+    def resolve(self):
+        problems = []
+        for name, lineno, scope in self.loads:
+            s = scope
+            found = False
+            while s is not None:
+                # Class bodies are invisible to nested scopes (but visible
+                # to loads occurring directly inside the class body).
+                if s.kind != "class" or s is scope:
+                    if name in s.bindings:
+                        found = True
+                        break
+                s = s.parent
+            if not found and name not in BUILTIN_NAMES:
+                if not self.has_star_import:
+                    problems.append(
+                        ("undefined-name", lineno, f"undefined name '{name}'")
+                    )
+        for name, lineno, reexport in self.imports:
+            if reexport or name == "_" or name.startswith("__"):
+                continue
+            if name not in self.used_names:
+                problems.append(
+                    ("unused-import", lineno, f"unused import '{name}'")
+                )
+        return problems

@@ -1,0 +1,220 @@
+"""Inline suppression comments.
+
+Syntax (the justification after ``--`` is REQUIRED — a suppression that
+doesn't say why is itself a finding)::
+
+    something_flagged()  # check: disable=rule-name -- why this is safe
+    # check: disable=rule-a,rule-b -- standalone form covers the NEXT line
+
+A suppression on a code line covers findings of the listed rules on that
+line; a standalone comment line covers the next non-blank, non-comment
+line (so multi-clause statements can carry a suppression without blowing
+the line length).  A suppression that matches no finding is reported as
+``unused-suppression`` — stale opt-outs must not outlive the code they
+excused.
+
+Comments are found with :mod:`tokenize`, not a line regex, so the
+directive text inside a string literal (e.g. a checker test fixture) is
+never mistaken for a live suppression.
+"""
+
+from __future__ import annotations
+
+import ast
+import io
+import re
+import tokenize
+from typing import Iterator, List, Tuple
+
+from checklib.model import Finding
+from checklib.registry import ENGINE_RULES, RULES
+
+_PATTERN = re.compile(
+    r"#\s*check:\s*disable=(?P<rules>[A-Za-z0-9,_-]+)"
+    r"(?:\s+--\s*(?P<why>.*\S))?"
+)
+
+
+class Suppression:
+    __slots__ = (
+        "line", "target_line", "target_end", "rules", "why", "used_rules",
+    )
+
+    def __init__(
+        self,
+        line: int,
+        target_line: int,
+        target_end: int,
+        rules: List[str],
+        why: str,
+    ):
+        self.line = line  # where the comment sits (for reporting)
+        # [target_line, target_end]: the line span whose findings it
+        # covers — a statement's full extent (its header only, for
+        # compound statements), so a wrapped `def f(\n items=[],\n):`
+        # can carry one suppression without it leaking into the body.
+        self.target_line = target_line
+        self.target_end = target_end
+        self.rules = rules
+        self.why = why
+        # Tracked per rule: in `disable=a,b` where only `a` ever
+        # matches, the stale `b` must still be reported as unused.
+        self.used_rules: set = set()
+
+
+def _stmt_spans(tree) -> list:
+    """(start, end) line spans a suppression binds to: each statement's
+    full extent, clamped to just above its body for compound statements
+    (a comment above a def covers the signature's wrapped default
+    arguments, NOT every finding in the body), and starting at the
+    first decorator for decorated defs/classes."""
+    spans = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.stmt):
+            continue
+        end = getattr(node, "end_lineno", None) or node.lineno
+        body = getattr(node, "body", None)
+        if isinstance(body, list) and body and isinstance(body[0], ast.stmt):
+            end = min(end, max(node.lineno, body[0].lineno - 1))
+        start = node.lineno
+        decorators = getattr(node, "decorator_list", None)
+        if decorators:
+            start = min(start, decorators[0].lineno)
+        spans.append((start, end))
+    return spans
+
+
+def _covering_span(spans, line):
+    """The innermost statement span containing ``line`` (so a trailing
+    comment on a continuation line binds to the whole statement —
+    including the finding anchored at its first line)."""
+    best = None
+    for start, end in spans:
+        if start <= line <= end and (best is None or start > best[0]):
+            best = (start, end)
+    return best
+
+
+def _iter_comments(text: str) -> Iterator[Tuple[int, int, str]]:
+    """(line, column, comment-text) for every real comment token."""
+    try:
+        for tok in tokenize.generate_tokens(io.StringIO(text).readline):
+            if tok.type == tokenize.COMMENT:
+                yield tok.start[0], tok.start[1], tok.string
+    except (tokenize.TokenError, IndentationError, SyntaxError):
+        return  # the ast parse already vouched for the file; be lenient
+
+
+def parse_suppressions(ctx) -> List[Finding]:
+    """Attach ``ctx.suppressions``; return malformed-comment findings."""
+    problems: List[Finding] = []
+    suppressions: List[Suppression] = []
+    lines = ctx.source_lines
+    spans = _stmt_spans(ctx.tree)
+    for lineno, col, comment in _iter_comments(ctx.source_text):
+        m = _PATTERN.search(comment)
+        if m is None:
+            continue
+        rules = [r for r in m.group("rules").split(",") if r]
+        why = (m.group("why") or "").strip()
+        if not rules:
+            # `disable=,` must not be silently inert — no-op opt-outs
+            # are findings, per the module invariant.
+            problems.append(
+                Finding(
+                    "bad-suppression",
+                    ctx.rel_path,
+                    lineno,
+                    "suppression names no rules "
+                    "(write '# check: disable=<rule> -- <why>')",
+                )
+            )
+            continue
+        unknown = [
+            r for r in rules if r not in RULES and r not in ENGINE_RULES
+        ]
+        engine = [r for r in rules if r in ENGINE_RULES]
+        if unknown:
+            problems.append(
+                Finding(
+                    "bad-suppression",
+                    ctx.rel_path,
+                    lineno,
+                    f"suppression names unknown rule(s): {', '.join(unknown)}",
+                )
+            )
+            continue
+        if engine:
+            # Engine findings are emitted outside the suppression pass
+            # (a syntax-error precludes it entirely), so a disable=
+            # naming one could only ever surface as a baffling
+            # unused-suppression — say what is actually wrong instead.
+            problems.append(
+                Finding(
+                    "bad-suppression",
+                    ctx.rel_path,
+                    lineno,
+                    "engine finding(s) cannot be suppressed: "
+                    + ", ".join(engine)
+                    + " — fix them instead",
+                )
+            )
+            continue
+        if not why:
+            problems.append(
+                Finding(
+                    "bad-suppression",
+                    ctx.rel_path,
+                    lineno,
+                    "suppression lacks a justification "
+                    "(write '# check: disable=<rule> -- <why>')",
+                )
+            )
+            continue
+        target = lineno
+        if not lines[lineno - 1][:col].strip():
+            # Standalone comment: covers the next non-blank, non-comment
+            # line (or nothing, which unused-suppression will report).
+            for j in range(lineno, len(lines)):
+                stripped = lines[j].strip()
+                if stripped and not stripped.startswith("#"):
+                    target = j + 1
+                    break
+        # The covered span is the whole statement the target line falls
+        # in, so a trailing comment on a continuation line suppresses
+        # the finding anchored at the statement's first line, and a
+        # comment above a wrapped signature covers its default
+        # arguments.
+        span = _covering_span(spans, target) or (target, target)
+        suppressions.append(
+            Suppression(lineno, span[0], span[1], rules, why)
+        )
+    ctx.suppressions = suppressions
+    return problems
+
+
+def apply_suppressions(ctx, findings: List[Finding]) -> List[Finding]:
+    """Drop suppressed findings; append unused-suppression findings."""
+    kept: List[Finding] = []
+    for f in findings:
+        suppressed = False
+        for s in ctx.suppressions:
+            if s.target_line <= f.line <= s.target_end and f.rule in s.rules:
+                s.used_rules.add(f.rule)
+                suppressed = True
+        if not suppressed:
+            kept.append(f)
+    for s in ctx.suppressions:
+        stale = [r for r in s.rules if r not in s.used_rules]
+        if stale:
+            kept.append(
+                Finding(
+                    "unused-suppression",
+                    ctx.rel_path,
+                    s.line,
+                    "suppression of "
+                    + ", ".join(f"'{r}'" for r in stale)
+                    + " matched no finding; remove it",
+                )
+            )
+    return kept
