@@ -26,7 +26,8 @@ check-core:
 	$(PYTHON) -m compileall -q registrar_tpu tests tools bench.py __graft_entry__.py
 	$(PYTHON) bench.py --check-baseline
 	$(PYTHON) -X dev -W error -c "import registrar_tpu, registrar_tpu.main, \
-	    registrar_tpu.testing.server, registrar_tpu.config, \
+	    registrar_tpu.testing.server, registrar_tpu.testing.netem, \
+	    registrar_tpu.config, \
 	    registrar_tpu.tools.zkcli, registrar_tpu.binderview, \
 	    registrar_tpu.metrics"
 
@@ -45,10 +46,12 @@ test-jax:
 	env -u PALLAS_AXON_POOL_IPS -u PYTHONPATH JAX_PLATFORMS=cpu \
 	    $(PYTHON) -m pytest tests/test_graft_entry.py -m jax -x -q
 
-# Long-form chaos soak: 30 s fault-injection storm (the suite's default
-# run is ~5 s).  CHAOS_SEED=<n> pins a schedule for reproduction.
+# Long-form chaos soak: the per-toxic netem armor suite, then a 30 s
+# fault-injection storm with network faults routed through ChaosProxy
+# (the suite's default run is ~5 s).  CHAOS_SEED=<n> pins a schedule for
+# reproduction; CHAOS_NETEM=0 drops back to server-side faults only.
 chaos:
-	CHAOS_SECONDS=30 $(PYTHON) -m pytest tests/test_chaos.py -x -q
+	CHAOS_SECONDS=30 $(PYTHON) -m pytest tests/test_netem.py tests/test_chaos.py -x -q
 
 bench:
 	$(PYTHON) bench.py

@@ -111,6 +111,7 @@ def register_plus(
     settle_delay: float = SETTLE_DELAY_S,
     heartbeat_retry: Optional[RetryPolicy] = None,
     repair_heartbeat_miss: bool = False,
+    register_retry: Optional[RetryPolicy] = None,
 ) -> RegistrarEvents:
     """Register, then keep the registration alive; returns the event surface.
 
@@ -121,14 +122,18 @@ def register_plus(
     from the sample config's ``maxAttempts``, see config.py).
     ``repair_heartbeat_miss`` opts into re-registering when a heartbeat
     finds the znodes gone (module docstring; default off = reference
-    behavior).
+    behavior).  ``register_retry`` opts the registration pipeline (initial
+    and every re-registration) into the transient-fault retry layer
+    (:data:`registrar_tpu.registration.REGISTER_RETRY` is the shipped
+    policy); default None = single attempt, reference behavior.
     """
     ee = RegistrarEvents()
     ee._track(_run(ee, zk, registration, admin_ip,
                    health_check, heartbeat_interval,
                    hostname, settle_delay,
                    heartbeat_retry,
-                   repair_heartbeat_miss))
+                   repair_heartbeat_miss,
+                   register_retry))
     return ee
 
 
@@ -143,12 +148,13 @@ async def _run(
     settle_delay: float,
     heartbeat_retry: Optional[RetryPolicy] = None,
     repair_heartbeat_miss: bool = False,
+    register_retry: Optional[RetryPolicy] = None,
 ) -> None:
     async def do_register() -> list:
         """The one registration pipeline call every path shares."""
         return await register_mod.register(
             zk, registration, admin_ip=admin_ip, hostname=hostname,
-            settle_delay=settle_delay,
+            settle_delay=settle_delay, retry_policy=register_retry,
         )
 
     try:
@@ -202,6 +208,7 @@ async def _heartbeat_loop(
                 and not ee.stopped
                 and isinstance(err, ZKError)
                 and err.code == Err.NO_NODE
+                and await _confirm_nodes_missing(zk, ee)
             ):
                 try:
                     new_znodes = await repair()
@@ -237,6 +244,30 @@ async def _heartbeat_loop(
         log.debug("zk.heartbeat(%s): ok", ee.znodes)
         ee.emit("heartbeat", ee.znodes)
         await asyncio.sleep(interval)
+
+
+async def _confirm_nodes_missing(zk: ZKClient, ee: RegistrarEvents) -> bool:
+    """One fresh single-attempt probe before the repair pipeline runs.
+
+    A NO_NODE from the probe retry chain can be a *transient* artifact —
+    a stale read served by a lagging follower just before catch-up, or a
+    probe raced with a session reattach — and the repair pipeline is not
+    free: its cleanup stage deletes and re-creates the live znodes, a
+    real (if brief) deregistration observable by Binder.  Repair only
+    proceeds when a second, immediate probe confirms the znodes are
+    really gone; any other outcome (probe passes, or fails for transient
+    reasons like CONNECTION_LOSS) falls back to the reference's plain
+    failure backoff.
+    """
+    try:
+        await zk.heartbeat(ee.znodes, retry=RetryPolicy(max_attempts=1))
+    except asyncio.CancelledError:
+        raise
+    except ZKError as err:
+        return err.code == Err.NO_NODE
+    except Exception:  # noqa: BLE001 - transient/unknown: do not repair
+        return False
+    return False
 
 
 def _start_health_consumer(

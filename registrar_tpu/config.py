@@ -76,6 +76,11 @@ class ZookeeperConfig:
     timeout_ms: int = 30000
     connect_timeout_ms: int = 4000
     chroot: Optional[str] = None
+    #: per-operation deadline (``requestTimeout``, ms).  None (the
+    #: default) = wait forever, the reference's behavior; when set, a
+    #: stalled reply tears the connection down and the op fails with the
+    #: retryable OPERATION_TIMEOUT (docs/FAULTS.md).
+    request_timeout_ms: Optional[int] = None
 
 
 @dataclass
@@ -156,6 +161,7 @@ def parse_config(raw: Mapping[str, Any]) -> Config:
         timeout_ms=_ms(zk_raw, "timeout", 30000),
         connect_timeout_ms=_ms(zk_raw, "connectTimeout", 4000),
         chroot=chroot,
+        request_timeout_ms=_optional_ms(zk_raw, "requestTimeout"),
     )
 
     registration = raw.get("registration")
@@ -306,6 +312,14 @@ def load_config(path: str) -> Config:
     except json.JSONDecodeError as e:
         raise ConfigError(f"unable to parse configuration {path}: {e}") from e
     return parse_config(raw)
+
+
+def _optional_ms(obj: Mapping[str, Any], key: str) -> Optional[int]:
+    """:func:`_ms` for keys with no default at all: absent (or JSON null)
+    means the feature is off, never a fallback number."""
+    if obj.get(key) is None:
+        return None
+    return _ms(obj, key, obj[key])  # default unreachable: key is present
 
 
 def _ms(obj: Mapping[str, Any], key: str, default: int) -> int:

@@ -146,6 +146,7 @@ async def run(cfg: Config, *, _exit=sys.exit) -> None:
         timeout_ms=cfg.zookeeper.timeout_ms,
         connect_timeout_ms=cfg.zookeeper.connect_timeout_ms,
         chroot=cfg.zookeeper.chroot,
+        request_timeout_ms=cfg.zookeeper.request_timeout_ms,
     )
 
     zk.on("close", lambda *a: log.warning("zookeeper: disconnected"))

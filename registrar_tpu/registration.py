@@ -41,6 +41,7 @@ from registrar_tpu.records import (
     payload_bytes,
     service_record,
 )
+from registrar_tpu.retry import RetryPolicy, call_with_backoff, is_transient
 from registrar_tpu.zk.client import MultiError, Op, ZKClient
 from registrar_tpu.zk.protocol import Err, ZKError
 
@@ -48,6 +49,17 @@ log = logging.getLogger("registrar_tpu.registration")
 
 #: Stage-2 pause before re-creating nodes, reference lib/register.js:232-235.
 SETTLE_DELAY_S = 1.0
+
+#: Default transient-fault retry for the registration pipeline when a
+#: caller opts in (``retry_policy=REGISTER_RETRY``): a blip of connection
+#: loss / per-op timeout mid-pipeline re-runs the whole idempotent
+#: pipeline (stage 1's cleanup reconciles any half-registration) after a
+#: short decorrelated-jitter backoff, instead of surfacing to the
+#: orchestrator as a registration failure.  SESSION_EXPIRED and semantic
+#: errors stay fatal (retry.is_transient).
+REGISTER_RETRY = RetryPolicy(
+    max_attempts=4, initial_delay=0.25, max_delay=2.0, jitter="decorrelated"
+)
 
 
 def _validate_registration(registration: Mapping[str, Any]) -> None:
@@ -108,6 +120,7 @@ async def register(
     admin_ip: Optional[str] = None,
     hostname: Optional[str] = None,
     settle_delay: float = SETTLE_DELAY_S,
+    retry_policy: Optional[RetryPolicy] = None,
 ) -> List[str]:
     """Run the five-stage registration pipeline; returns the owned znodes.
 
@@ -115,8 +128,41 @@ async def register(
     aliases?, ttl?, ports?, service?).  ``admin_ip`` overrides the
     interface-probe address (reference lib/register.js:141,148 uses
     opts.adminIp the same way).
+
+    ``retry_policy`` opts into the transient-fault retry layer (ISSUE 2):
+    a connection blip or per-operation timeout mid-pipeline re-runs the
+    whole pipeline from stage 1 (whose cleanup makes re-entry idempotent)
+    with the policy's backoff, while session expiry and semantic errors
+    (bad config, ACLs) propagate immediately.  Default None = single
+    attempt, the reference's behavior.
     """
     _validate_registration(registration)
+    if retry_policy is not None:
+        return await call_with_backoff(
+            lambda: _register_once(
+                zk, registration, admin_ip, hostname, settle_delay
+            ),
+            retry_policy,
+            on_backoff=lambda n, delay, err: log.warning(
+                "register: transient fault (%r); retrying pipeline in %.2fs "
+                "(attempt %d)", err, delay, n + 1,
+            ),
+            # A closed client surfaces CONNECTION_LOSS too, but nothing
+            # will ever reconnect it — an expired session must propagate
+            # on the first failure, not after the whole backoff budget.
+            retryable=lambda err: not zk.closed and is_transient(err),
+        )
+    return await _register_once(zk, registration, admin_ip, hostname, settle_delay)
+
+
+async def _register_once(
+    zk: ZKClient,
+    registration: Mapping[str, Any],
+    admin_ip: Optional[str],
+    hostname: Optional[str],
+    settle_delay: float,
+) -> List[str]:
+    """One pass of the five-stage pipeline (validated input)."""
     service = registration.get("service")
     service_payload = (
         payload_bytes(service_record(service)) if service is not None else None

@@ -11,16 +11,38 @@ Two policies exist in the reference and both are preserved exactly
 Delay schedule matches node-backoff's ExponentialStrategy: the first retry
 waits ``initial_delay``, each subsequent retry doubles it, capped at
 ``max_delay``.
+
+Beyond the reference, two robustness layers ride here (ISSUE 2):
+
+  * **Decorrelated jitter** (``jitter="decorrelated"``): pure doubling makes
+    every client of a restarted ensemble reconnect in lockstep — N workers
+    all retry at t+1, t+3, t+7, ... and the herd re-stampedes the servers at
+    each step.  The decorrelated schedule (AWS architecture blog's
+    "Exponential Backoff And Jitter") draws each delay uniformly from
+    ``[initial_delay, 3 * previous_delay]`` capped at ``max_delay``, so
+    retries spread out instead of synchronizing.  :data:`RECONNECT_RETRY`
+    adopts it for the client's default *reconnect* policy; the initial
+    connect (:data:`CONNECT_RETRY`) keeps the reference's exact schedule.
+  * **Error classification** (:func:`is_transient`): the predicate the
+    retry layers share for "could retrying possibly help?" — connection
+    loss, per-operation timeouts, and plain socket errors are transient;
+    SESSION_EXPIRED (and every other ZooKeeper semantic error) is not.
 """
 
 from __future__ import annotations
 
 import asyncio
 import math
+import random
 from dataclasses import dataclass
-from typing import Awaitable, Callable, Optional, TypeVar
+from typing import Awaitable, Callable, Iterator, Optional, TypeVar
+
+from registrar_tpu.zk.protocol import Err, ZKError
 
 T = TypeVar("T")
+
+#: jitter modes accepted by :class:`RetryPolicy`
+JITTER_MODES = ("none", "decorrelated")
 
 
 @dataclass(frozen=True)
@@ -28,16 +50,76 @@ class RetryPolicy:
     max_attempts: float = 5  # math.inf for unbounded
     initial_delay: float = 1.0  # seconds
     max_delay: float = 30.0  # seconds
+    #: "none" = the reference's pure doubling; "decorrelated" = each delay
+    #: drawn from [initial_delay, 3 * previous] capped at max_delay, so a
+    #: fleet that lost its ensemble together does not retry in lockstep.
+    jitter: str = "none"
+
+    def __post_init__(self) -> None:
+        if self.jitter not in JITTER_MODES:
+            raise ValueError(
+                f"jitter must be one of {JITTER_MODES}, got {self.jitter!r}"
+            )
 
     def delay(self, attempt: int) -> float:
-        """Delay before retry number ``attempt`` (0-based)."""
+        """Deterministic delay before retry number ``attempt`` (0-based) —
+        the pure doubling schedule, jitter ignored (kept stable for the
+        reference-parity pins in tests/test_retry.py)."""
         return min(self.initial_delay * (2**attempt), self.max_delay)
+
+    def schedule(self, rng: Optional[random.Random] = None) -> Iterator[float]:
+        """Yield successive backoff delays, honoring the jitter mode.
+
+        With ``jitter="none"`` this is exactly ``delay(0), delay(1), ...``.
+        With ``jitter="decorrelated"``, each delay is drawn from
+        ``uniform(initial_delay, 3 * previous)`` capped at ``max_delay``
+        (``rng`` makes a schedule reproducible in tests; default is the
+        module RNG).  Every jittered delay stays within
+        ``[initial_delay, max_delay]`` — the same envelope operators
+        already budget for.
+        """
+        if self.jitter == "none":
+            attempt = 0
+            while True:
+                yield self.delay(attempt)
+                attempt += 1
+        else:
+            uniform = (rng or random).uniform
+            prev = self.initial_delay
+            while True:
+                prev = min(self.max_delay, uniform(self.initial_delay, prev * 3))
+                yield prev
 
 
 #: reference lib/zk.js:38-42
 HEARTBEAT_RETRY = RetryPolicy(max_attempts=5, initial_delay=1.0, max_delay=30.0)
 #: reference lib/zk.js:97-101
 CONNECT_RETRY = RetryPolicy(max_attempts=math.inf, initial_delay=1.0, max_delay=90.0)
+#: the client's default *reconnect* policy: the reference's 1-90 s envelope
+#: with decorrelated jitter, so a fleet dropped by an ensemble restart does
+#: not reconnect as a thundering herd (ISSUE 2 satellite).
+RECONNECT_RETRY = RetryPolicy(
+    max_attempts=math.inf, initial_delay=1.0, max_delay=90.0,
+    jitter="decorrelated",
+)
+
+
+def is_transient(err: BaseException) -> bool:
+    """True when retrying the failed operation could plausibly succeed.
+
+    Transient: CONNECTION_LOSS (the connection died; a reconnect may
+    already be in progress), OPERATION_TIMEOUT (a per-operation deadline
+    tore the connection down, :class:`~registrar_tpu.zk.client.
+    OperationTimeoutError`), and plain socket/timeout errors.
+
+    NOT transient: SESSION_EXPIRED (a dead session cannot be retried back
+    to life — the orchestrator must build a new one) and every other
+    ZooKeeper semantic error (NO_NODE, NODE_EXISTS, NO_AUTH, ...), where a
+    retry would just repeat the same answer.
+    """
+    if isinstance(err, ZKError):
+        return err.code in (Err.CONNECTION_LOSS, Err.OPERATION_TIMEOUT)
+    return isinstance(err, (ConnectionError, asyncio.TimeoutError, OSError))
 
 
 async def call_with_backoff(
@@ -45,6 +127,7 @@ async def call_with_backoff(
     policy: RetryPolicy,
     on_backoff: Optional[Callable[[int, float, Exception], object]] = None,
     retryable: Optional[Callable[[Exception], bool]] = None,
+    rng: Optional[random.Random] = None,
 ) -> T:
     """Run ``fn`` until it succeeds or the policy's attempts are exhausted.
 
@@ -56,8 +139,12 @@ async def call_with_backoff(
     ``retryable(err)`` returning False makes the error fatal: it propagates
     immediately without further attempts (e.g. session expiry during a
     reconnect loop — retrying cannot resurrect an expired session).
+
+    ``rng`` seeds a jittered policy's delay draws (tests); ignored for
+    ``jitter="none"`` policies.
     """
     attempt = 0
+    delays = policy.schedule(rng)
     while True:
         try:
             return await fn()
@@ -68,7 +155,7 @@ async def call_with_backoff(
                 raise
             if attempt + 1 >= policy.max_attempts:
                 raise
-            delay = policy.delay(attempt)
+            delay = next(delays)
             if on_backoff is not None:
                 on_backoff(attempt, delay, err)
             await asyncio.sleep(delay)

@@ -19,6 +19,15 @@ Connection/session model:
     re-arming watches via SetWatches.  If the server no longer knows the
     session it emits ``session_expired`` — the daemon's policy is to exit
     and let the supervisor restart it (reference main.js:141-144).
+  * Network-fault armor (ISSUE 2): optional per-operation deadlines
+    (``request_timeout_ms`` -> :class:`OperationTimeoutError`, connection
+    torn down because a FIFO pipeline cannot skip a reply), a bounded
+    whole-pass connect budget (``connect_pass_timeout_ms``), a liveness
+    watchdog whose keepalive drain is itself deadline-bounded (a peer
+    that stops *reading* must not wedge the watchdog), and jittered
+    reconnect backoff by default (retry.RECONNECT_RETRY).  All proven
+    against deterministic wire faults in
+    :mod:`registrar_tpu.testing.netem` (tests/test_netem.py).
   * ``ephemeral_plus`` creates (zkplus's flag, used at
     reference lib/register.js:157) are ephemeral creates that transparently
     mkdirp a missing parent.  Intentional divergence, documented: this
@@ -41,6 +50,7 @@ from registrar_tpu.events import EventEmitter
 from registrar_tpu.retry import (
     CONNECT_RETRY,
     HEARTBEAT_RETRY,
+    RECONNECT_RETRY,
     RetryPolicy,
     call_with_backoff,
 )
@@ -76,7 +86,24 @@ class ZKClient(EventEmitter):
         reconnect: bool = True,
         reconnect_policy: Optional[RetryPolicy] = None,
         chroot: Optional[str] = None,
+        request_timeout_ms: Optional[int] = None,
+        connect_pass_timeout_ms: Optional[int] = None,
     ):
+        """``request_timeout_ms``: per-operation deadline.  When set, every
+        awaited reply is bounded; on expiry the connection is torn down
+        (ZooKeeper answers FIFO — one reply cannot be skipped without
+        desynchronizing every later one, so the only safe recovery is a
+        fresh connection) and the op raises
+        :class:`OperationTimeoutError`, which
+        :func:`registrar_tpu.retry.is_transient` classifies as retryable.
+        Default None = wait forever (reference behavior), leaving stall
+        detection to the session watchdog alone.
+
+        ``connect_pass_timeout_ms``: bound on ONE whole pass of
+        :meth:`connect` over the server list.  Without it, each candidate
+        gets ``connect_timeout_ms`` and a long list of blackholed servers
+        can stall a reconnect far past the session timeout; the default
+        bound is the session timeout itself (``timeout_ms``)."""
         super().__init__()
         servers = list(servers)
         if not servers:
@@ -103,8 +130,12 @@ class ZKClient(EventEmitter):
             self.chroot = chroot
         self.requested_timeout_ms = timeout_ms
         self.connect_timeout_ms = connect_timeout_ms
+        self.request_timeout_ms = request_timeout_ms
+        self.connect_pass_timeout_ms = connect_pass_timeout_ms
         self.reconnect = reconnect
-        self.reconnect_policy = reconnect_policy or CONNECT_RETRY
+        # Default reconnects use decorrelated jitter (RECONNECT_RETRY): a
+        # fleet dropped by an ensemble restart must not retry in lockstep.
+        self.reconnect_policy = reconnect_policy or RECONNECT_RETRY
 
         self.session_id = 0
         self.session_passwd = b"\x00" * 16
@@ -153,17 +184,31 @@ class ZKClient(EventEmitter):
         """Connect (or reconnect) to the first reachable server.
 
         Single pass over the server list in random order; raises on total
-        failure.  Use :func:`create_zk_client` for the reference's
-        infinite-backoff behavior.
+        failure.  The WHOLE pass is bounded by ``connect_pass_timeout_ms``
+        (default: the session timeout), not just each candidate by
+        ``connect_timeout_ms`` — a long list of slow or blackholed servers
+        must not stall one reconnect attempt past the point where the
+        session it is trying to save has already expired.  Use
+        :func:`create_zk_client` for the reference's infinite-backoff
+        behavior.
         """
         if self._closed:
             raise ZKError(Err.SESSION_EXPIRED, None)
         last_err: Optional[Exception] = None
         order = list(self.servers)
         random.shuffle(order)
+        pass_timeout_ms = (
+            self.connect_pass_timeout_ms
+            if self.connect_pass_timeout_ms is not None
+            else self.requested_timeout_ms
+        )
+        deadline = time.monotonic() + pass_timeout_ms / 1000.0
         for host, port in order:
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                break
             try:
-                await self._connect_one(host, port)
+                await self._connect_one(host, port, max_wait=remaining)
                 return self
             except SessionExpiredError:
                 raise
@@ -172,12 +217,30 @@ class ZKClient(EventEmitter):
             except Exception as err:  # noqa: BLE001 - try next server
                 last_err = err
                 log.debug("connect to %s:%d failed: %r", host, port, err)
-        raise last_err if last_err else ConnectionError("no servers")
+        raise (
+            last_err
+            if last_err
+            else ConnectionError("no servers within the connect pass budget")
+        )
 
-    async def _connect_one(self, host: str, port: int) -> None:
-        timeout = self.connect_timeout_ms / 1000.0
+    async def _connect_one(
+        self, host: str, port: int, max_wait: Optional[float] = None
+    ) -> None:
+        per_step = self.connect_timeout_ms / 1000.0
+        # The pass budget is CUMULATIVE across the dial/handshake steps: a
+        # server that trickles — dial completes just in time, then the
+        # header, then never the payload — must not get a fresh allowance
+        # per step, or one candidate overshoots the whole-pass bound by
+        # the number of steps (see connect()).
+        deadline = None if max_wait is None else time.monotonic() + max_wait
+
+        def step_timeout() -> float:
+            if deadline is None:
+                return per_step
+            return min(per_step, max(deadline - time.monotonic(), 0.001))
+
         reader, writer = await asyncio.wait_for(
-            asyncio.open_connection(host, port), timeout
+            asyncio.open_connection(host, port), step_timeout()
         )
         try:
             req = proto.ConnectRequest(
@@ -190,10 +253,12 @@ class ZKClient(EventEmitter):
             w = Writer()
             req.write(w)
             writer.write(proto.frame(w.to_bytes()))
-            await writer.drain()
-            hdr = await asyncio.wait_for(reader.readexactly(4), timeout)
+            await asyncio.wait_for(writer.drain(), step_timeout())
+            hdr = await asyncio.wait_for(reader.readexactly(4), step_timeout())
             length = int.from_bytes(hdr, "big", signed=True)
-            payload = await asyncio.wait_for(reader.readexactly(length), timeout)
+            payload = await asyncio.wait_for(
+                reader.readexactly(length), step_timeout()
+            )
             resp = proto.ConnectResponse.read(Reader(payload))
         except Exception:
             writer.close()
@@ -303,7 +368,18 @@ class ZKClient(EventEmitter):
                 task.cancel()
         if self._writer is not None:
             try:
-                self._writer.close()
+                transport = getattr(self._writer, "transport", None)
+                if not expected and transport is not None:
+                    # Abort, don't close: close() flushes the send buffer
+                    # first, and on a connection being torn down *because*
+                    # the peer stopped reading that flush never completes —
+                    # connection_lost never fires and every coroutine
+                    # parked in drain() stays parked forever.  abort()
+                    # discards the buffer and wakes them with a
+                    # ConnectionResetError immediately.
+                    transport.abort()
+                else:
+                    self._writer.close()
             except Exception:  # noqa: BLE001
                 pass
             self._writer = None
@@ -532,10 +608,45 @@ class ZKClient(EventEmitter):
             await self._writer.drain()
         except (ConnectionError, OSError):
             await self._teardown(expected=False)
-        return await fut
+        return await self._await_reply(fut)
 
     async def _call(self, op: int, body) -> Reader:
         return await self._submit(self._next_xid(), op, body)
+
+    async def _await_reply(self, awaitable):
+        """Bound one awaited reply (or a gathered burst of them) by the
+        per-operation deadline.
+
+        On expiry the connection is torn down before raising: ZooKeeper
+        answers strictly FIFO, so a reply cannot be skipped — if op N's
+        answer never comes, neither does N+1's, and the only way to
+        recover the pipeline is a fresh connection (which also resolves
+        every other pending future to CONNECTION_LOSS).  The caller gets
+        :class:`OperationTimeoutError`, which
+        :func:`registrar_tpu.retry.is_transient` marks retryable.
+        """
+        if self.request_timeout_ms is None:
+            return await awaitable
+        try:
+            return await asyncio.wait_for(
+                awaitable, self.request_timeout_ms / 1000.0
+            )
+        except asyncio.TimeoutError:
+            log.warning(
+                "no reply within request_timeout (%d ms); dropping connection",
+                self.request_timeout_ms,
+            )
+            await self._teardown(expected=False)
+            raise OperationTimeoutError() from None
+
+    async def _gather_replies(self, futs: Sequence[asyncio.Future]) -> List:
+        """Deadline-bounded ``gather(..., return_exceptions=True)`` over a
+        pipelined burst's reply futures (one shared deadline for the whole
+        burst: the replies ride one FIFO connection, so the burst is one
+        wire operation from the deadline's point of view)."""
+        return await self._await_reply(
+            asyncio.gather(*futs, return_exceptions=True)
+        )
 
     async def _ping_loop(self) -> None:
         """Session keepalive + server-liveness watchdog.
@@ -568,7 +679,28 @@ class ZKClient(EventEmitter):
                         self._writer.write(
                             proto.encode_request(proto.XID_PING, OpCode.PING)
                         )
-                        await self._writer.drain()
+                        # drain() itself can block indefinitely: a peer
+                        # that stops READING (slow-loris) fills the kernel
+                        # send buffer, the transport buffer rises past its
+                        # high-water mark, and an unbounded drain parks
+                        # the watchdog behind the exact stall it exists to
+                        # detect (the pre-fix wedge; regression test:
+                        # tests/test_netem.py drain-wedge).  Bound it by
+                        # what is left of the dead-after budget, then
+                        # declare the connection dead ourselves.
+                        budget = dead_after - (
+                            time.monotonic() - self._last_response
+                        )
+                        await asyncio.wait_for(
+                            self._writer.drain(), timeout=max(budget, 0.01)
+                        )
+                except asyncio.TimeoutError:
+                    log.warning(
+                        "send buffer stalled for the remaining dead-after "
+                        "budget (peer stopped reading); dropping connection",
+                    )
+                    await self._teardown(expected=False)
+                    return
                 except (ConnectionError, OSError):
                     await self._teardown(expected=False)
                     return
@@ -717,7 +849,7 @@ class ZKClient(EventEmitter):
             )
             for p in paths
         )
-        results = await asyncio.gather(*futs, return_exceptions=True)
+        results = await self._gather_replies(futs)
         out: List[Optional[Tuple[bytes, Stat]]] = []
         for res in results:
             if isinstance(res, ZKError) and res.code == Err.NO_NODE:
@@ -775,7 +907,7 @@ class ZKClient(EventEmitter):
 
         futs, post_err = await self._post_pipeline(requests())
         first_err: Optional[BaseException] = post_err
-        for res in await asyncio.gather(*futs, return_exceptions=True):
+        for res in await self._gather_replies(futs):
             if (
                 isinstance(res, BaseException)
                 and not (isinstance(res, ZKError) and res.code == Err.NODE_EXISTS)
@@ -919,14 +1051,24 @@ class ZKClient(EventEmitter):
                 )
                 for n in nodes
             )
-            results = await asyncio.gather(*futs, return_exceptions=True)
+            results = await self._gather_replies(futs)
             for res in results:
                 if isinstance(res, BaseException):
                     raise res
             if post_err is not None:
                 raise post_err
 
-        await call_with_backoff(check, retry or HEARTBEAT_RETRY)
+        await call_with_backoff(
+            check,
+            retry or HEARTBEAT_RETRY,
+            # An expired session cannot heartbeat its way back: retrying
+            # just burns the bounded attempts while the daemon should
+            # already be exiting for its supervisor restart.  Everything
+            # else keeps the reference's retry-all behavior.
+            retryable=lambda err: not (
+                isinstance(err, ZKError) and err.code == Err.SESSION_EXPIRED
+            ),
+        )
 
 
 class Op:
@@ -991,6 +1133,19 @@ class SessionExpiredError(ZKError):
         super().__init__(Err.SESSION_EXPIRED)
 
 
+class OperationTimeoutError(ZKError):
+    """A per-operation deadline (``request_timeout_ms``) expired.
+
+    The connection was already torn down when this is raised (FIFO
+    pipeline — see :meth:`ZKClient._await_reply`), so the session is on
+    its way back up via the reconnect machinery; retrying the operation
+    is the right move (:func:`registrar_tpu.retry.is_transient` → True).
+    """
+
+    def __init__(self) -> None:
+        super().__init__(Err.OPERATION_TIMEOUT)
+
+
 async def create_zk_client(
     servers: Sequence[Tuple[str, int]],
     timeout_ms: int = 30000,
@@ -998,6 +1153,7 @@ async def create_zk_client(
     on_attempt=None,
     retry_policy: Optional[RetryPolicy] = None,
     chroot: Optional[str] = None,
+    request_timeout_ms: Optional[int] = None,
 ) -> ZKClient:
     """Create and connect a client, retrying forever (reference lib/zk.js:62-127).
 
@@ -1011,8 +1167,9 @@ async def create_zk_client(
         servers,
         timeout_ms=timeout_ms,
         connect_timeout_ms=connect_timeout_ms,
-        reconnect_policy=retry_policy,  # reconnects follow the same policy
+        reconnect_policy=retry_policy,  # None -> jittered RECONNECT_RETRY
         chroot=chroot,
+        request_timeout_ms=request_timeout_ms,
     )
 
     def backoff_log(number: int, delay: float, err: Exception) -> None:

@@ -210,6 +210,54 @@ class TestHeartbeatRepair:
             await client.close()
             await server.stop()
 
+    async def test_transient_no_node_blip_does_not_trip_repair(
+        self, monkeypatch
+    ):
+        # ISSUE 2: one transient NO_NODE blip — a stale read from a
+        # lagging follower, a probe raced with a reconnect — must NOT
+        # run the repair pipeline, whose cleanup stage deletes and
+        # re-creates the live znodes (a real, Binder-visible
+        # deregistration window).  The agent confirms with a second,
+        # immediate probe before repairing; a blip that a fresh probe
+        # cannot reproduce is left alone.
+        import registrar_tpu.agent as agent_mod
+        from registrar_tpu.retry import RetryPolicy
+        from registrar_tpu.zk.protocol import Err, ZKError
+
+        monkeypatch.setattr(agent_mod, "HEARTBEAT_FAILURE_BACKOFF_S", 0.05)
+        server, client = await _pair()
+
+        blips = []  # armed below, AFTER the listeners are registered
+        real_heartbeat = client.heartbeat
+
+        async def blippy_heartbeat(nodes, retry=None):
+            if blips:
+                blips.pop()
+                raise ZKError(Err.NO_NODE)
+            return await real_heartbeat(nodes, retry=retry)
+
+        client.heartbeat = blippy_heartbeat
+        try:
+            ee = self._fast_ee(client, repair_heartbeat_miss=True)
+            (znodes,) = await ee.wait_for("register", timeout=10)
+            czxid_before = (await client.stat(znodes[0])).czxid
+            registers, failures = [], []
+            ee.on("register", registers.append)
+            ee.on("heartbeatFailure", failures.append)
+            blips.append(1)  # fail exactly one upcoming probe
+            # the blip fires on the next probe; then let several healthy
+            # cycles pass
+            await ee.wait_for("heartbeatFailure", timeout=10)
+            await ee.wait_for("heartbeat", timeout=10)
+            assert failures  # the blip was surfaced to operators
+            assert registers == []  # ... but repair never ran
+            # the znode was never deleted/re-created by a repair pipeline
+            assert (await client.stat(znodes[0])).czxid == czxid_before
+            ee.stop()
+        finally:
+            await client.close()
+            await server.stop()
+
     async def test_repair_rolls_back_when_health_drops_mid_repair(
         self, monkeypatch
     ):

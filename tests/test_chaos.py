@@ -24,6 +24,15 @@ Reproducibility: the run is driven by one RNG seed, printed at start
 ``CHAOS_SEED=<n>``; lengthen the churn window with ``CHAOS_SECONDS=<s>``
 (default keeps the whole test well under 10 s).
 
+Network faults: unless ``CHAOS_NETEM=0``, every worker's connections are
+routed through a per-member :class:`registrar_tpu.testing.netem.ChaosProxy`
+and the storm also toggles seeded wire toxics (latency/jitter, bandwidth
+throttle, frame slicing, reset-after-N — the transient entries of
+``netem.STORM_TOXICS``) on and off, so the churn exercises the client's
+per-operation deadlines and reconnect armor, not just server-side kills.
+The same ``CHAOS_SEED`` drives the toxic schedule; the storm-over cleanup
+heals every proxy before convergence is asserted.
+
 Failure-detection parity: SURVEY.md §5 — liveness via sessions,
 crash-and-restart recovery, idempotent re-registration
 (reference lib/register.js:78-105 cleanup stage) are the app's core
@@ -39,6 +48,7 @@ from registrar_tpu import binderview
 from registrar_tpu.records import parse_payload
 from registrar_tpu.registration import register, unregister
 from registrar_tpu.retry import RetryPolicy
+from registrar_tpu.testing.netem import DOWN, STORM_TOXICS, UP, ChaosProxy
 from registrar_tpu.testing.server import ZKEnsemble
 from registrar_tpu.zk.client import SessionExpiredError, ZKClient
 from registrar_tpu.zk.protocol import CreateFlag, ZKError
@@ -69,20 +79,27 @@ def _reg():
 class _Worker:
     """One registrar instance churning through the chaos."""
 
-    def __init__(self, i: int, ens: ZKEnsemble, seed: int):
+    def __init__(self, i: int, ens: ZKEnsemble, seed: int, addresses=None):
         self.i = i
         self.ens = ens
         self.rng = random.Random(seed)
         self.hostname = f"chaos{i}"
         self.admin_ip = f"10.9.0.{i + 1}"
+        #: where this worker dials: the ensemble directly, or (netem mode)
+        #: the per-member ChaosProxy front doors
+        self.addresses = addresses or ens.addresses
         self.client: ZKClient = None
         self.nodes = None
         self.ops = 0
 
     async def connect(self) -> None:
         self.client = ZKClient(
-            self.ens.addresses,
+            self.addresses,
             timeout_ms=8000,
+            # fail fast through a faulted proxy instead of hanging an op
+            # on a sliced/stalled wire for the rest of the storm
+            request_timeout_ms=1500,
+            connect_timeout_ms=500,
             reconnect_policy=FAST_RECONNECT,
         )
         await self.client.connect()
@@ -158,6 +175,7 @@ async def _chaos_task(
     stop: asyncio.Event,
     events: list,
     max_events: float = float("inf"),
+    proxies: list = None,
 ) -> None:
     while not stop.is_set() and len(events) < max_events:
         await asyncio.sleep(rng.uniform(0.02, 0.1))
@@ -167,6 +185,23 @@ async def _chaos_task(
             if m is not None and m._server is not None
         ]
         dead = [i for i in range(ENSEMBLE) if i not in live]
+        if proxies is not None and rng.random() < 0.3:
+            # Network fault instead of a server fault this round: toggle
+            # a seeded toxic on one member's proxy (off if one is on).
+            # STORM_TOXICS is the transient palette — traffic eventually
+            # passes or resets, so the storm stays convergeable; the
+            # forever-silent toxics have their own deterministic tests.
+            i = rng.randrange(len(proxies))
+            proxy = proxies[i]
+            if proxy.toxics(UP) or proxy.toxics(DOWN):
+                proxy.clear()
+                events.append(("netem-off", i))
+            else:
+                kind = rng.choice(sorted(STORM_TOXICS))
+                direction = rng.choice((UP, DOWN))
+                proxy.add(STORM_TOXICS[kind](rng), direction=direction)
+                events.append(("netem-on", i, kind, direction))
+            continue
         roll = rng.random()
         if roll < 0.3 and len(live) > 1:
             i = rng.choice(live)
@@ -206,10 +241,12 @@ async def _chaos_task(
             i = rng.choice(live)
             await ens.servers[i].drop_connections()
             events.append(("drop", i))
-    # storm over: restore full strength and linearizable reads
+    # storm over: restore full strength, linearizable reads, clean wires
     for i in range(ENSEMBLE):
         await ens.restart(i)
         ens.set_lag(i, 0)
+    for proxy in proxies or []:
+        proxy.clear()
 
 
 def _orphan_ephemerals(ens: ZKEnsemble) -> list:
@@ -232,12 +269,29 @@ def _orphan_ephemerals(ens: ZKEnsemble) -> list:
 async def test_chaos_churn_converges():
     seed = int(os.environ.get("CHAOS_SEED", random.randrange(2**32)))
     churn_s = float(os.environ.get("CHAOS_SECONDS", "2.5"))
-    print(f"CHAOS_SEED={seed} CHAOS_SECONDS={churn_s}", file=sys.stderr)
+    netem = os.environ.get("CHAOS_NETEM", "1") != "0"
+    print(
+        f"CHAOS_SEED={seed} CHAOS_SECONDS={churn_s} "
+        f"CHAOS_NETEM={int(netem)}",
+        file=sys.stderr,
+    )
     rng = random.Random(seed)
 
     async with ZKEnsemble(ENSEMBLE, tick_ms=20) as ens:
+        # Netem mode: one fault-injection proxy fronts each member; the
+        # workers only ever dial the proxies, so every byte of the churn
+        # crosses the toxic-injectable wire.  (The victim client and the
+        # orphan sweep below stay direct — they assert server-side truth.)
+        proxies = []
+        if netem:
+            for addr in ens.addresses:
+                proxies.append(
+                    await ChaosProxy(addr, seed=rng.randrange(2**32)).start()
+                )
+        worker_addrs = [p.address for p in proxies] if netem else None
         workers = [
-            _Worker(i, ens, rng.randrange(2**32)) for i in range(N_WORKERS)
+            _Worker(i, ens, rng.randrange(2**32), addresses=worker_addrs)
+            for i in range(N_WORKERS)
         ]
         for w in workers:
             await w.connect()
@@ -257,7 +311,9 @@ async def test_chaos_churn_converges():
         stop = asyncio.Event()
         events: list = []
         tasks = [asyncio.create_task(w.churn(stop)) for w in workers]
-        chaos = asyncio.create_task(_chaos_task(ens, rng, stop, events))
+        chaos = asyncio.create_task(
+            _chaos_task(ens, rng, stop, events, proxies=proxies or None)
+        )
 
         await asyncio.sleep(churn_s)
         stop.set()
@@ -324,6 +380,8 @@ async def test_chaos_churn_converges():
             for w in workers:
                 if w.client is not None and not w.client.closed:
                     await w.client.close()
+            for proxy in proxies:
+                await proxy.stop()
 
 
 async def test_chaos_repeats_with_fixed_seed():
@@ -335,10 +393,19 @@ async def test_chaos_repeats_with_fixed_seed():
         async with ZKEnsemble(ENSEMBLE, tick_ms=20) as ens:
             stop = asyncio.Event()
             events: list = []
-            await _chaos_task(ens, rng, stop, events, max_events=12)
+            # Unstarted proxies: toxic toggles work without sockets, so
+            # the netem arm of the schedule is pinned too.
+            proxies = [
+                ChaosProxy(addr, seed=rng.randrange(2**32))
+                for addr in ens.addresses
+            ]
+            await _chaos_task(
+                ens, rng, stop, events, max_events=12, proxies=proxies
+            )
             return events
 
     a = await fault_schedule(1234)
     b = await fault_schedule(1234)
     assert a == b
     assert len(a) == 12
+    assert any(ev[0].startswith("netem-") for ev in a), a

@@ -64,6 +64,23 @@ class TestParse:
         assert cfg.metrics.port == 9090
         assert cfg.health_check["stdout_match"]["invert"] is True
 
+    def test_request_timeout_opt_in(self):
+        # Per-operation deadline (ISSUE 2): off by default (reference
+        # behavior — wait forever), a positive ms number when configured.
+        base = {
+            "registration": {"domain": "a.b", "type": "host"},
+            "zookeeper": {"servers": [{"host": "h", "port": 1}]},
+        }
+        assert parse_config(base).zookeeper.request_timeout_ms is None
+        base["zookeeper"]["requestTimeout"] = 5000
+        assert parse_config(base).zookeeper.request_timeout_ms == 5000
+        base["zookeeper"]["requestTimeout"] = "5s"
+        with pytest.raises(ConfigError):
+            parse_config(base)
+        base["zookeeper"]["requestTimeout"] = -1
+        with pytest.raises(ConfigError):
+            parse_config(base)
+
     def test_unknown_top_level_keys_surfaced(self):
         cfg = parse_config(
             {

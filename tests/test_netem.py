@@ -1,0 +1,434 @@
+"""Network-fault armor: every netem toxic against the hardened client.
+
+The contract under test (ISSUE 2 acceptance): for each
+:mod:`registrar_tpu.testing.netem` toxic, the client either *recovers*
+(reconnects, re-registers, session/ephemerals intact, Binder view
+converges) or *fails fast with the documented error class*
+(``OperationTimeoutError`` / CONNECTION_LOSS — both classified transient
+by :func:`registrar_tpu.retry.is_transient`); and the two wedge scenarios
+the watchdog exists for — a blackholed-but-connected server, and a peer
+that stops **reading** (the pre-fix ``_ping_loop`` drain wedge) — are
+detected within the dead-after budget.  Fault → detection → recovery →
+bound is catalogued in docs/FAULTS.md.
+"""
+
+import asyncio
+import time
+
+import pytest
+
+from registrar_tpu import binderview
+from registrar_tpu.registration import REGISTER_RETRY, register
+from registrar_tpu.retry import RetryPolicy, is_transient
+from registrar_tpu.testing.netem import (
+    DOWN,
+    UP,
+    Bandwidth,
+    Blackhole,
+    ChaosProxy,
+    Latency,
+    ResetAfter,
+    Slicer,
+    StopReading,
+    Truncate,
+)
+from registrar_tpu.testing.server import ZKServer
+from registrar_tpu.zk.client import (
+    OperationTimeoutError,
+    ZKClient,
+)
+from registrar_tpu.zk.protocol import CreateFlag, Err, ZKError
+
+#: sub-second reconnects so recovery happens inside test budgets
+FAST = RetryPolicy(max_attempts=float("inf"), initial_delay=0.02, max_delay=0.2)
+
+DOMAIN = "netem.test.registrar"
+PATH = "/registrar/test/netem"
+REG = {
+    "domain": DOMAIN,
+    "type": "load_balancer",
+    "service": {
+        "type": "service",
+        "service": {"srvce": "_http", "proto": "_tcp", "port": 80},
+    },
+}
+
+
+async def _proxied_pair(seed=7, sock_buf=None, **client_kw):
+    server = await ZKServer().start()
+    proxy = await ChaosProxy(server.address, seed=seed, sock_buf=sock_buf).start()
+    client_kw.setdefault("reconnect_policy", FAST)
+    client_kw.setdefault("connect_timeout_ms", 500)
+    client = await ZKClient([proxy.address], **client_kw).connect()
+    return server, proxy, client
+
+
+async def _shutdown(server, proxy, *clients):
+    for c in clients:
+        if not c.closed:
+            await c.close()
+    await proxy.stop()
+    await server.stop()
+
+
+def _orphan_ephemerals(server: ZKServer):
+    """Every ephemeral in the tree whose owner session is gone."""
+    orphans = []
+
+    def walk(node, prefix):
+        for name, child in node.children.items():
+            path = f"{prefix}/{name}" if prefix != "/" else f"/{name}"
+            if child.ephemeral_owner:
+                sess = server.sessions.get(child.ephemeral_owner)
+                if sess is None or sess.closed:
+                    orphans.append((path, child.ephemeral_owner))
+            walk(child, path)
+
+    walk(server.root, "/")
+    return orphans
+
+
+class TestPassthrough:
+    async def test_clean_proxy_is_transparent(self):
+        server, proxy, client = await _proxied_pair()
+        try:
+            await client.create("/t", b"hello")
+            data, stat = await client.get("/t")
+            assert data == b"hello"
+            kids = await client.get_children("/")
+            assert "t" in kids
+        finally:
+            await _shutdown(server, proxy, client)
+
+    async def test_full_registration_through_proxy(self):
+        server, proxy, client = await _proxied_pair()
+        try:
+            nodes = await register(
+                client, REG, admin_ip="10.1.1.1",
+                hostname="netemhost", settle_delay=0.01,
+            )
+            assert nodes == [f"{PATH}/netemhost", PATH]
+            res = await binderview.resolve(client, DOMAIN, "A")
+            assert [a.data for a in res.answers] == ["10.1.1.1"]
+        finally:
+            await _shutdown(server, proxy, client)
+
+
+class TestLatency:
+    async def test_ops_survive_latency_and_jitter(self):
+        server, proxy, client = await _proxied_pair()
+        try:
+            proxy.add(Latency(latency_ms=40, jitter_ms=20), direction=DOWN)
+            t0 = time.monotonic()
+            await client.create("/slow", b"x")
+            elapsed = time.monotonic() - t0
+            # the reply crossed a >= (40-20) ms injected delay
+            assert elapsed >= 0.02, elapsed
+            data, _ = await client.get("/slow")
+            assert data == b"x"
+        finally:
+            await _shutdown(server, proxy, client)
+
+
+class TestBandwidth:
+    async def test_throttle_paces_large_replies(self):
+        server, proxy, client = await _proxied_pair()
+        try:
+            payload = bytes(16 * 1024)
+            await client.create("/big", payload)
+            proxy.add(Bandwidth(bytes_per_s=64 * 1024), direction=DOWN)
+            t0 = time.monotonic()
+            data, _ = await client.get("/big")
+            elapsed = time.monotonic() - t0
+            assert data == payload
+            # 16 KiB at 64 KiB/s >= 0.25 s of injected pacing
+            assert elapsed >= 0.2, elapsed
+        finally:
+            await _shutdown(server, proxy, client)
+
+
+class TestSlicer:
+    async def test_torn_frames_reassemble(self):
+        # Fragmenting every reply into 1-8 byte segments attacks the
+        # client's frame buffering (framing.FrameReader): payloads must
+        # reassemble byte-identical, headers must never desynchronize.
+        server, proxy, client = await _proxied_pair(seed=11)
+        try:
+            payload = bytes(range(256)) * 16  # 4 KiB, position-sensitive
+            await client.create("/sliced", payload)
+            proxy.add(Slicer(max_size=8), direction=DOWN)
+            data, _ = await client.get("/sliced")
+            assert data == payload
+            # several ops in a row: xid pairing survives the shredding
+            for _ in range(3):
+                st = await client.stat("/sliced")
+                assert st.data_length == len(payload)
+        finally:
+            await _shutdown(server, proxy, client)
+
+
+class TestOperationDeadline:
+    async def test_stalled_reply_times_out_and_recovers(self):
+        # A server that reads but never answers (ZKServer.freeze) is
+        # indistinguishable from a reply stall: the per-op deadline must
+        # fire, tear the connection down, and the reconnect must recover
+        # the session.
+        server = await ZKServer().start()
+        client = await ZKClient(
+            [server.address], request_timeout_ms=300, reconnect_policy=FAST,
+        ).connect()
+        try:
+            await client.create("/dl", b"", CreateFlag.EPHEMERAL)
+            server.freeze = True
+            t0 = time.monotonic()
+            with pytest.raises(OperationTimeoutError) as exc:
+                await client.get("/dl")
+            assert time.monotonic() - t0 < 2.0
+            assert exc.value.code == Err.OPERATION_TIMEOUT
+            assert is_transient(exc.value)  # the retry layers will retry it
+            server.freeze = False
+            await client.wait_for("connect", timeout=10)
+            # session reattached: the ephemeral survived the stall
+            st = await client.stat("/dl")
+            assert st.ephemeral_owner == client.session_id
+        finally:
+            await client.close()
+            await server.stop()
+
+    async def test_pipelined_ops_share_the_deadline(self):
+        # get_many/heartbeat ride one corked burst; the deadline must
+        # bound the gathered replies, not just single _call ops.
+        server = await ZKServer().start()
+        client = await ZKClient(
+            [server.address], request_timeout_ms=300, reconnect_policy=FAST,
+        ).connect()
+        try:
+            await client.create("/p1", b"a")
+            await client.create("/p2", b"b")
+            server.freeze = True
+            with pytest.raises(OperationTimeoutError):
+                await client.get_many(["/p1", "/p2"])
+            server.freeze = False
+            await client.wait_for("connect", timeout=10)
+            server.freeze = True
+            with pytest.raises(OperationTimeoutError):
+                await client.heartbeat(
+                    ["/p1", "/p2"], retry=RetryPolicy(max_attempts=1)
+                )
+        finally:
+            server.freeze = False
+            await client.close()
+            await server.stop()
+
+
+class TestTruncate:
+    async def test_half_open_reply_fails_fast_then_recovers(self):
+        # Truncate-then-stall on DOWN: the reply's first bytes arrive,
+        # then the wire goes silent with no FIN — half-open TCP.  The
+        # per-op deadline is the documented detection path.
+        server, proxy, client = await _proxied_pair(request_timeout_ms=400)
+        try:
+            payload = bytes(4096)
+            await client.create("/half", payload, CreateFlag.EPHEMERAL)
+            toxic = proxy.add(Truncate(n=10), direction=DOWN)
+            with pytest.raises(OperationTimeoutError):
+                await client.get("/half")
+            proxy.remove(toxic)  # heal the wire; reconnect must recover
+            await client.wait_for("connect", timeout=10)
+            data, st = await client.get("/half")
+            assert data == payload
+            assert st.ephemeral_owner == client.session_id
+            assert _orphan_ephemerals(server) == []
+        finally:
+            await _shutdown(server, proxy, client)
+
+
+class TestBlackhole:
+    async def test_watchdog_detects_silent_server(self):
+        # The 2/3-session-timeout liveness watchdog, deterministically:
+        # TCP stays up, nothing ever answers.  Detection bound: dead_after
+        # (= 2/3 * negotiated timeout) + one ping interval.
+        server, proxy, client = await _proxied_pair(timeout_ms=1500)
+        try:
+            await client.create("/bh", b"", CreateFlag.EPHEMERAL)
+            assert client.negotiated_timeout_ms == 1500
+            proxy.add(Blackhole(), direction=UP)
+            proxy.add(Blackhole(), direction=DOWN)
+            t0 = time.monotonic()
+            await client.wait_for("close", timeout=10)
+            detected = time.monotonic() - t0
+            # dead_after = 1.0 s, interval = 0.5 s; generous CI margin
+            assert detected < 4.0, detected
+            proxy.clear()
+            await client.wait_for("connect", timeout=10)
+            # the same session reattached before it could expire
+            st = await client.stat("/bh")
+            assert st.ephemeral_owner == client.session_id
+            assert _orphan_ephemerals(server) == []
+        finally:
+            await _shutdown(server, proxy, client)
+
+    async def test_connect_pass_is_bounded_by_total_budget(self):
+        # A server list full of blackholed entries must not stall one
+        # connect() pass beyond connect_pass_timeout_ms — even when each
+        # entry's own connect_timeout_ms would allow far more.
+        server = await ZKServer().start()
+        proxies = []
+        for i in range(3):
+            p = await ChaosProxy(server.address, seed=i).start()
+            p.add(Blackhole(), direction=UP)
+            p.add(Blackhole(), direction=DOWN)
+            proxies.append(p)
+        try:
+            client = ZKClient(
+                [p.address for p in proxies],
+                connect_timeout_ms=10_000,       # per-candidate: generous
+                connect_pass_timeout_ms=600,     # whole pass: tight
+                reconnect=False,
+            )
+            t0 = time.monotonic()
+            with pytest.raises(Exception):
+                await client.connect()
+            elapsed = time.monotonic() - t0
+            # Bound: ~one candidate's handshake at the pass budget, not
+            # 3 x 10 s of per-candidate allowance.
+            assert elapsed < 3.0, elapsed
+        finally:
+            for p in proxies:
+                await p.stop()
+            await server.stop()
+
+
+class TestResetAfter:
+    async def test_reset_surfaces_connection_loss_and_session_survives(self):
+        server, proxy, client = await _proxied_pair()
+        try:
+            await client.create("/rst", b"", CreateFlag.EPHEMERAL)
+            toxic = proxy.add(ResetAfter(n=0), direction=UP)
+            with pytest.raises((ZKError, ConnectionError, OSError)) as exc:
+                # the RST can land on this op or already be latent; either
+                # way the op fails with a transient, retryable error
+                await client.stat("/rst")
+                await client.stat("/rst")
+            if isinstance(exc.value, ZKError):
+                assert exc.value.code == Err.CONNECTION_LOSS
+            assert is_transient(exc.value)
+            proxy.remove(toxic)
+            await client.wait_for("connect", timeout=10)
+            st = await client.stat("/rst")
+            assert st.ephemeral_owner == client.session_id
+            assert _orphan_ephemerals(server) == []
+        finally:
+            await _shutdown(server, proxy, client)
+
+
+class TestStopReadingDrainWedge:
+    async def test_watchdog_survives_peer_that_stops_reading(self):
+        # REGRESSION (the _ping_loop drain wedge): a peer that accepts
+        # the TCP connection but stops READING fills the kernel send
+        # buffer; the client's transport rises past its high-water mark
+        # and an unbounded `await drain()` parks the watchdog forever —
+        # the exact stall it exists to detect.  Pre-fix, no `close` ever
+        # fires and this test fails; post-fix the bounded drain times out
+        # against the dead-after budget and tears the connection down.
+        server = await ZKServer().start()
+        proxy = await ChaosProxy(server.address, seed=3, sock_buf=8192).start()
+        client = await ZKClient(
+            [proxy.address],
+            timeout_ms=1200,           # interval 0.4 s, dead_after 0.8 s
+            reconnect=False,           # keep the post-mortem simple
+        ).connect()
+        try:
+            await client.create("/wedge", b"seed")
+            # Shrink the client-side buffers so the wedge needs KBs, not
+            # MBs: a small kernel send buffer plus a low transport
+            # high-water mark make drain() block almost immediately once
+            # the proxy stops draining its end.
+            import socket as _socket
+
+            sock = client._writer.get_extra_info("socket")
+            sock.setsockopt(_socket.SOL_SOCKET, _socket.SO_SNDBUF, 8192)
+            client._writer.transport.set_write_buffer_limits(high=16384)
+
+            proxy.add(StopReading(), direction=UP)
+            # Fill the pipe: a write far larger than every buffer in the
+            # path wedges this task in _submit's drain — and, pre-fix,
+            # the next ping's drain right behind it.
+            blocked = asyncio.ensure_future(
+                client.set_data("/wedge", bytes(512 * 1024))
+            )
+            t0 = time.monotonic()
+            await client.wait_for("close", timeout=8)
+            detected = time.monotonic() - t0
+            assert detected < 6.0, detected
+            with pytest.raises((ZKError, ConnectionError, OSError)):
+                await blocked
+        finally:
+            await client.close()
+            await proxy.stop()
+            await server.stop()
+
+
+class TestRegistrationRetryLayer:
+    async def test_transient_fault_mid_pipeline_retries_to_convergence(self):
+        # End-to-end acceptance: a blackholed wire mid-registration makes
+        # the pipeline fail with the transient OperationTimeoutError; the
+        # classification+retry layer re-runs the idempotent pipeline, and
+        # once the wire heals the host converges — registered, ephemeral
+        # owned by the live session, Binder answering, no orphans.
+        server, proxy, client = await _proxied_pair(
+            request_timeout_ms=300, timeout_ms=8000
+        )
+        try:
+            nodes = await register(
+                client, REG, admin_ip="10.2.2.2",
+                hostname="retryhost", settle_delay=0.01,
+            )
+            assert nodes == [f"{PATH}/retryhost", PATH]
+
+            proxy.add(Blackhole(), direction=UP)
+            proxy.add(Blackhole(), direction=DOWN)
+            task = asyncio.ensure_future(
+                register(
+                    client, REG, admin_ip="10.2.2.2",
+                    hostname="retryhost", settle_delay=0.01,
+                    retry_policy=RetryPolicy(
+                        max_attempts=50, initial_delay=0.1,
+                        max_delay=0.5, jitter="decorrelated",
+                    ),
+                )
+            )
+            await asyncio.sleep(0.6)   # let >= 1 attempt fail on the fault
+            assert not task.done()
+            proxy.clear()              # heal; a later retry must converge
+            nodes = await asyncio.wait_for(task, timeout=15)
+            assert nodes == [f"{PATH}/retryhost", PATH]
+
+            st = await client.stat(nodes[0])
+            assert st.ephemeral_owner == client.session_id
+            res = await binderview.resolve(client, DOMAIN, "A")
+            assert [a.data for a in res.answers] == ["10.2.2.2"]
+            assert _orphan_ephemerals(server) == []
+        finally:
+            await _shutdown(server, proxy, client)
+
+    async def test_fatal_errors_are_not_retried(self):
+        # SESSION_EXPIRED must stay fatal through the retry layer —
+        # retrying a dead session would mask the supervisor-restart
+        # design (and REGISTER_RETRY's classifier must agree).
+        server, proxy, client = await _proxied_pair()
+        try:
+            await server.expire_session(client.session_id)
+            await client.wait_for("session_expired", timeout=10)
+            t0 = time.monotonic()
+            with pytest.raises(ZKError) as exc:
+                await register(
+                    client, REG, admin_ip="10.3.3.3",
+                    hostname="fatalhost", settle_delay=0.01,
+                    retry_policy=REGISTER_RETRY,
+                )
+            assert time.monotonic() - t0 < 2.0  # no backoff attempts burned
+            assert exc.value.code in (Err.SESSION_EXPIRED, Err.CONNECTION_LOSS)
+            assert not is_transient(ZKError(Err.SESSION_EXPIRED))
+        finally:
+            await _shutdown(server, proxy, client)

@@ -7,16 +7,21 @@ integration suites that ride on them.
 """
 
 import asyncio
+import itertools
 import math
+import random
 
 import pytest
 
 from registrar_tpu.retry import (
     CONNECT_RETRY,
     HEARTBEAT_RETRY,
+    RECONNECT_RETRY,
     RetryPolicy,
     call_with_backoff,
+    is_transient,
 )
+from registrar_tpu.zk.protocol import Err, ZKError
 
 
 class TestDelaySchedule:
@@ -30,6 +35,98 @@ class TestDelaySchedule:
                 HEARTBEAT_RETRY.max_delay) == (5, 1.0, 30.0)
         assert CONNECT_RETRY.max_attempts == math.inf
         assert (CONNECT_RETRY.initial_delay, CONNECT_RETRY.max_delay) == (1.0, 90.0)
+
+
+class TestDecorrelatedJitter:
+    def test_schedule_stays_inside_the_envelope(self):
+        # Every jittered delay must respect the same [initial, max]
+        # envelope operators budget for with the plain schedule.
+        p = RetryPolicy(
+            max_attempts=math.inf, initial_delay=1.0, max_delay=30.0,
+            jitter="decorrelated",
+        )
+        delays = list(itertools.islice(p.schedule(random.Random(42)), 200))
+        assert all(1.0 <= d <= 30.0 for d in delays)
+        # ... and must actually vary (the whole point): a lockstep fleet
+        # would produce one repeated value.
+        assert len({round(d, 6) for d in delays}) > 50
+
+    def test_seeded_schedules_are_reproducible(self):
+        p = RetryPolicy(jitter="decorrelated")
+        a = list(itertools.islice(p.schedule(random.Random(7)), 20))
+        b = list(itertools.islice(p.schedule(random.Random(7)), 20))
+        assert a == b
+
+    def test_two_clients_decorrelate(self):
+        # The thundering-herd property: two workers restarting together
+        # must not share a delay schedule.
+        p = RetryPolicy(jitter="decorrelated")
+        a = list(itertools.islice(p.schedule(random.Random(1)), 20))
+        b = list(itertools.islice(p.schedule(random.Random(2)), 20))
+        assert a != b
+
+    def test_none_jitter_schedule_matches_delay(self):
+        p = RetryPolicy(max_attempts=10, initial_delay=1.0, max_delay=30.0)
+        assert list(itertools.islice(p.schedule(), 7)) == [
+            p.delay(a) for a in range(7)
+        ]
+
+    def test_invalid_jitter_mode_rejected(self):
+        with pytest.raises(ValueError):
+            RetryPolicy(jitter="full")
+
+    def test_reconnect_policy_adopts_jitter(self):
+        # The default reconnect policy keeps the reference's 1-90 s
+        # envelope but jitters inside it (ISSUE 2 satellite); the initial
+        # connect keeps the reference's exact doubling.
+        assert RECONNECT_RETRY.max_attempts == math.inf
+        assert (RECONNECT_RETRY.initial_delay, RECONNECT_RETRY.max_delay) == (
+            1.0, 90.0,
+        )
+        assert RECONNECT_RETRY.jitter == "decorrelated"
+        assert CONNECT_RETRY.jitter == "none"
+
+    async def test_call_with_backoff_draws_from_jittered_schedule(self):
+        attempts = []
+
+        async def fn():
+            attempts.append(1)
+            if len(attempts) < 3:
+                raise RuntimeError("flaky")
+            return "ok"
+
+        p = RetryPolicy(
+            max_attempts=5, initial_delay=0.001, max_delay=0.005,
+            jitter="decorrelated",
+        )
+        delays = []
+        out = await call_with_backoff(
+            fn, p,
+            on_backoff=lambda a, d, e: delays.append(d),
+            rng=random.Random(3),
+        )
+        assert out == "ok"
+        expected = list(itertools.islice(p.schedule(random.Random(3)), 2))
+        assert delays == expected
+
+
+class TestIsTransient:
+    def test_connection_loss_and_op_timeout_are_transient(self):
+        assert is_transient(ZKError(Err.CONNECTION_LOSS))
+        assert is_transient(ZKError(Err.OPERATION_TIMEOUT))
+        assert is_transient(ConnectionResetError())
+        assert is_transient(asyncio.TimeoutError())
+        assert is_transient(OSError(113, "no route to host"))
+
+    def test_session_expiry_and_semantic_errors_are_fatal(self):
+        from registrar_tpu.zk.client import SessionExpiredError
+
+        assert not is_transient(SessionExpiredError())
+        assert not is_transient(ZKError(Err.SESSION_EXPIRED))
+        assert not is_transient(ZKError(Err.NO_NODE))
+        assert not is_transient(ZKError(Err.NODE_EXISTS))
+        assert not is_transient(ZKError(Err.NO_AUTH))
+        assert not is_transient(ValueError("bad config"))
 
 
 class TestCallWithBackoff:
