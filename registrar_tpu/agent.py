@@ -35,6 +35,23 @@ Loop behavior matches the reference exactly (BASELINE.md):
     next health ``ok`` the full registration pipeline runs again
     (lib/index.js:59-116).
 
+Two opt-in recovery layers ride above the reference loops (ISSUE 3):
+
+  * the ZK client's ``session_reborn`` event (``surviveSessionExpiry``)
+    is consumed here — a fresh in-process session has no ephemerals, so
+    the idempotent registration pipeline re-runs, honoring ``ee.down``
+    (a health-deregistered host is never resurrected by a rebirth);
+  * a level-triggered reconciler (:mod:`registrar_tpu.reconcile`, config
+    ``reconcile: {intervalSeconds, repair}``) periodically diffs the
+    owned znodes against the desired records and emits structured
+    ``drift`` / ``driftRepaired`` / ``reconcile`` events — with
+    ``repair`` on it converges through the same pipeline.
+
+Every znode-mutating flow (heartbeat repair, rebirth re-registration,
+health transitions, reconciler repair) is single-flight through one
+``asyncio.Lock``, so two recovery paths can never interleave a cleanup
+stage into each other's half-built registration.
+
 Fixed here (reference warts that are unobservable in znode state):
 ``register_plus`` references an undefined ``cfg`` on initial-registration
 failure (lib/index.js:48) — the error path here just emits ``error``; and
@@ -74,9 +91,22 @@ class RegistrarEvents(EventEmitter):
         #: gates heartbeat repair so it never races a deliberate
         #: deregistration.
         self.down = False
+        #: bumped every time a registration pipeline run refreshes
+        #: ``znodes``.  Recovery actors queued on the single-flight lock
+        #: snapshot it when they DECIDE to repair and skip if it moved by
+        #: the time they hold the lock: without this, the loser of the
+        #: race re-runs the pipeline over the winner's fresh registration
+        #: and its cleanup stage deletes the just-repaired znodes —
+        #: re-minting the very drift that queued it (an unbounded
+        #: repair tug-of-war between heartbeat repair and the
+        #: reconciler; regression: tests/test_e2e_options.py).
+        self.epoch = 0
         self._tasks: set = set()
         self._health: Optional[HealthCheck] = None
         self._stopped = False
+        #: the level-triggered reconciler, when configured (test/metrics
+        #: observability; None without the ``reconcile`` config block)
+        self.reconciler = None
 
     def stop(self) -> None:
         """Stop the heartbeat loop and health checker.
@@ -112,6 +142,7 @@ def register_plus(
     heartbeat_retry: Optional[RetryPolicy] = None,
     repair_heartbeat_miss: bool = False,
     register_retry: Optional[RetryPolicy] = None,
+    reconcile: Optional[Mapping[str, Any]] = None,
 ) -> RegistrarEvents:
     """Register, then keep the registration alive; returns the event surface.
 
@@ -126,6 +157,10 @@ def register_plus(
     and every re-registration) into the transient-fault retry layer
     (:data:`registrar_tpu.registration.REGISTER_RETRY` is the shipped
     policy); default None = single attempt, reference behavior.
+    ``reconcile`` starts the level-triggered reconciler (module
+    docstring): ``{"interval_seconds": float, "repair": bool}`` — the
+    config's ``reconcile`` object, seconds-based.  Default None = no
+    reconciler, reference behavior.
     """
     ee = RegistrarEvents()
     ee._track(_run(ee, zk, registration, admin_ip,
@@ -133,7 +168,8 @@ def register_plus(
                    hostname, settle_delay,
                    heartbeat_retry,
                    repair_heartbeat_miss,
-                   register_retry))
+                   register_retry,
+                   reconcile))
     return ee
 
 
@@ -149,6 +185,7 @@ async def _run(
     heartbeat_retry: Optional[RetryPolicy] = None,
     repair_heartbeat_miss: bool = False,
     register_retry: Optional[RetryPolicy] = None,
+    reconcile: Optional[Mapping[str, Any]] = None,
 ) -> None:
     async def do_register() -> list:
         """The one registration pipeline call every path shares."""
@@ -156,6 +193,11 @@ async def _run(
             zk, registration, admin_ip=admin_ip, hostname=hostname,
             settle_delay=settle_delay, retry_policy=register_retry,
         )
+
+    #: single-flight guard over every znode-mutating recovery flow
+    #: (heartbeat repair, rebirth re-registration, health transitions,
+    #: reconciler repair) — see module docstring.
+    repair_lock = asyncio.Lock()
 
     try:
         znodes = await do_register()
@@ -167,16 +209,141 @@ async def _run(
         return
 
     ee.znodes = znodes
+    ee.epoch += 1
     if ee.stopped:
         return
 
     ee._track(_heartbeat_loop(
         ee, zk, heartbeat_interval, heartbeat_retry,
         do_register if repair_heartbeat_miss else None,
+        repair_lock,
     ))
     if health_check:
-        _start_health_consumer(ee, zk, do_register, health_check)
+        _start_health_consumer(ee, zk, do_register, health_check, repair_lock)
+
+    # Session lifecycle supervisor consumer (ISSUE 3): a reborn session
+    # holds none of the old session's ephemerals — re-run the idempotent
+    # pipeline, unless health deliberately deregistered the host.  One
+    # long-lived task consumes a signal (not a task per event), so
+    # back-to-back expiries cannot stack duplicate pipelines.
+    rebirth_signal = asyncio.Event()
+    zk.on("session_reborn", lambda _sid: rebirth_signal.set())
+    ee._track(_rebirth_loop(ee, zk, do_register, repair_lock, rebirth_signal))
+
+    if reconcile:
+        from registrar_tpu.reconcile import Reconciler
+
+        ee.reconciler = Reconciler(
+            zk, ee, registration,
+            admin_ip=admin_ip, hostname=hostname,
+            interval_s=reconcile.get("interval_seconds", 60.0),
+            repair=bool(reconcile.get("repair", False)),
+            repair_fn=lambda epoch: _reregister_guarded(
+                ee, zk, do_register, repair_lock, expect_epoch=epoch
+            ),
+            lock=repair_lock,
+        )
+        ee._track(ee.reconciler.run())
     ee.emit("register", znodes)
+
+
+#: post-rebirth re-registration retry: unbounded like the connect path
+#: (a live session with NO registration is a silent DNS outage — strictly
+#: worse than the exit(1)+supervisor-restart the feature replaces, so the
+#: agent must never give up while the client is alive), decorrelated
+#: jitter so a fleet reborn by the same ensemble event does not re-run
+#: its pipelines in lockstep.
+REBIRTH_REREGISTER_RETRY = RetryPolicy(
+    max_attempts=float("inf"), initial_delay=0.5, max_delay=30.0,
+    jitter="decorrelated",
+)
+
+
+async def _rebirth_loop(ee, zk, do_register, lock, signal) -> None:
+    """Consume ``session_reborn`` signals: re-run the idempotent pipeline
+    until it lands, with jittered backoff across transient failures.
+
+    A single attempt is not enough: rebirths happen exactly when the
+    ensemble is flaky, so the first pipeline run frequently dies on the
+    same turbulence that killed the session — and nothing else would
+    retry it (the heartbeat loop sees NO_NODE but only repairs with the
+    opt-in ``repairHeartbeatMiss``).  The loop stops retrying when the
+    registration is refreshed (by this loop or any other recovery path —
+    ``_reregister_guarded`` reports both as True), when health holds the
+    host down (``on_recover`` owns the eventual re-registration), or
+    when the client/agent is gone.  A new expiry mid-retry just re-sets
+    the signal; the running retry chain continues against the newest
+    session, since ``do_register`` always uses the live client.
+    """
+    while not ee.stopped:
+        await signal.wait()
+        signal.clear()
+        delays = REBIRTH_REREGISTER_RETRY.schedule()
+        while not ee.stopped and not zk.closed:
+            try:
+                done = await _reregister_guarded(ee, zk, do_register, lock)
+            except asyncio.CancelledError:
+                raise
+            except Exception as err:  # noqa: BLE001
+                delay = next(delays)
+                log.warning(
+                    "post-rebirth re-registration failed (%r); "
+                    "retrying in %.1fs", err, delay,
+                )
+                ee.emit("error", err)
+                await asyncio.sleep(delay)
+                continue
+            if not done:  # down/stopped: on_recover owns the comeback
+                log.debug("post-rebirth re-registration skipped (down)")
+            break
+
+
+async def _reregister_guarded(
+    ee, zk, do_register, lock, expect_epoch: Optional[int] = None
+) -> bool:
+    """Run the registration pipeline under the single-flight lock,
+    honoring a health deregistration that lands at any point.
+
+    Returns True when the registration was refreshed (``ee.znodes``
+    updated, ``register`` emitted); False when the host is down/stopped —
+    including the race where health crosses its threshold while the
+    pipeline (1 s settle + RPCs) is in flight, in which case the freshly
+    created znodes are rolled back out rather than resurrecting a host
+    health just declared dead.  Pipeline errors propagate to the caller.
+
+    ``expect_epoch`` is the ``ee.epoch`` the caller observed when it
+    decided repair was needed: if another recovery actor refreshed the
+    registration while this one waited on the lock, the stale repair is
+    skipped (returns True — the registration IS fresh) instead of
+    running the pipeline's delete+recreate over it.
+    """
+    if expect_epoch is None:
+        expect_epoch = ee.epoch
+    if ee.down or ee.stopped:
+        return False
+    async with lock:
+        if ee.down or ee.stopped:
+            return False
+        if ee.epoch != expect_epoch:
+            log.debug(
+                "re-registration skipped: epoch moved %d -> %d while "
+                "waiting (another recovery path already repaired)",
+                expect_epoch, ee.epoch,
+            )
+            return True
+        new_znodes = await do_register()
+        if ee.down or ee.stopped:
+            log.debug("re-registration rolled back (health down/stopped)")
+            try:
+                await register_mod.unregister(zk, new_znodes)
+            except Exception as u_err:  # noqa: BLE001
+                ee.emit("error", u_err)
+            return False
+        ee.znodes = new_znodes
+        ee.epoch += 1
+        log.debug("re-registered %s (epoch %d)", ee.znodes, ee.epoch)
+        ee.emit("register", new_znodes)
+        return True
 
 
 async def _heartbeat_loop(
@@ -185,6 +352,7 @@ async def _heartbeat_loop(
     interval: float,
     retry: Optional[RetryPolicy] = None,
     repair=None,
+    lock: Optional[asyncio.Lock] = None,
 ) -> None:
     """Hot loop #1 (SURVEY.md §3.2): self-rescheduling znode liveness probe.
 
@@ -192,8 +360,11 @@ async def _heartbeat_loop(
     pipeline; invoked when a probe fails with NO_NODE (znodes vanished
     without our session expiring — e.g. an operator deleted them, or a
     reattach raced a cleanup) unless the health checker holds the host
-    down.  None = reference behavior: failures only back off.
+    down.  None = reference behavior: failures only back off.  ``lock``
+    is the agent-wide single-flight guard the repair runs under.
     """
+    if lock is None:
+        lock = asyncio.Lock()
     while not ee.stopped:
         try:
             await zk.heartbeat(ee.znodes, retry=retry)
@@ -202,6 +373,11 @@ async def _heartbeat_loop(
         except Exception as err:  # noqa: BLE001
             log.debug("zk.heartbeat(%s) failed: %r", ee.znodes, err)
             ee.emit("heartbeatFailure", err)
+            # Snapshot the registration epoch at the moment the miss was
+            # observed: if another recovery path re-registers while the
+            # confirm probe / lock wait is in flight, the repair below
+            # becomes a no-op instead of a delete+recreate over it.
+            epoch_at_miss = ee.epoch
             if (
                 repair is not None
                 and not ee.down
@@ -211,32 +387,16 @@ async def _heartbeat_loop(
                 and await _confirm_nodes_missing(zk, ee)
             ):
                 try:
-                    new_znodes = await repair()
+                    repaired = await _reregister_guarded(
+                        ee, zk, repair, lock, expect_epoch=epoch_at_miss
+                    )
                 except asyncio.CancelledError:
                     raise
                 except Exception as r_err:  # noqa: BLE001
                     log.debug("heartbeat repair failed: %r", r_err)
                     ee.emit("error", r_err)
                 else:
-                    if ee.down or ee.stopped:
-                        # The health checker crossed its threshold while the
-                        # repair's pipeline (1 s settle + RPCs) was in
-                        # flight: honor the deregistration — roll the fresh
-                        # znodes back out rather than resurrecting a host
-                        # health just declared down.
-                        log.debug(
-                            "heartbeat repair rolled back (health down)"
-                        )
-                        try:
-                            await register_mod.unregister(zk, new_znodes)
-                        except Exception as u_err:  # noqa: BLE001
-                            ee.emit("error", u_err)
-                    else:
-                        ee.znodes = new_znodes
-                        log.debug(
-                            "heartbeat repair re-registered %s", ee.znodes
-                        )
-                        ee.emit("register", ee.znodes)
+                    if repaired:
                         await asyncio.sleep(interval)
                         continue
             await asyncio.sleep(max(interval, HEARTBEAT_FAILURE_BACKOFF_S))
@@ -275,10 +435,22 @@ def _start_health_consumer(
     zk: ZKClient,
     do_register,
     health_check: Mapping[str, Any],
+    lock: Optional[asyncio.Lock] = None,
 ) -> None:
-    """Hot loop #2 (SURVEY.md §3.3): health stream -> deregister/re-register."""
+    """Hot loop #2 (SURVEY.md §3.3): health stream -> deregister/re-register.
+
+    Transitions run under the agent-wide single-flight ``lock`` so a
+    rebirth/reconciler/heartbeat repair can never interleave its pipeline
+    with a deliberate deregistration.  A failed ``unregister`` leaves
+    ``ee.down`` latched with the znodes intact — the reconciler's
+    down-state sweep (desired = absent) finishes the deregistration on a
+    later tick (ISSUE 3 satellite fix; without a reconciler the error is
+    surfaced for the operator, the pre-existing behavior).
+    """
     check = create_health_check(**health_check)
     ee._health = check
+    if lock is None:
+        lock = asyncio.Lock()
     transitioning = False
 
     async def on_fail(err: Exception) -> None:
@@ -289,7 +461,8 @@ def _start_health_consumer(
             log.debug("healthcheck failed, deregistering (znodes=%s)", ee.znodes)
             ee.emit("fail", err)
             try:
-                deleted = await register_mod.unregister(zk, ee.znodes)
+                async with lock:
+                    deleted = await register_mod.unregister(zk, ee.znodes)
             except Exception as u_err:  # noqa: BLE001
                 log.debug("healthcheck: unregister failed: %r", u_err)
                 ee.emit("error", u_err)
@@ -304,12 +477,14 @@ def _start_health_consumer(
         try:
             ee.emit("ok")
             try:
-                znodes = await do_register()
+                async with lock:
+                    znodes = await do_register()
             except Exception as r_err:  # noqa: BLE001
                 log.debug("register: reregister failed: %r", r_err)
                 ee.emit("error", r_err)
             else:
                 ee.znodes = znodes
+                ee.epoch += 1
                 ee.down = False
                 ee.emit("register", znodes)
         finally:

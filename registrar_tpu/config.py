@@ -32,9 +32,17 @@ etc/config.coal.json)::
                                                #  heartbeat finds the znodes
                                                #  gone (SURVEY.md §3.2 note)
       "metrics": {"port": 9090,                # opt-in extension: Prometheus
-                  "host": "127.0.0.1"}         #  /metrics endpoint (the
-    }                                          #  node-artedi analog,
+                  "host": "127.0.0.1"},        #  /metrics endpoint (the
+                                               #  node-artedi analog,
                                                #  SURVEY.md §5)
+      "surviveSessionExpiry": false,           # opt-in (ISSUE 3): rebuild a
+                                               #  fresh ZK session in-process
+                                               #  on expiry instead of exit(1)
+      "maxSessionRebirths": 5,                 # rebirth circuit-breaker bound
+                                               #  (per 5-minute window)
+      "reconcile": {"intervalSeconds": 60,     # opt-in (ISSUE 3): level-
+                    "repair": false}           #  triggered drift reconciler;
+    }                                          #  NOTE: seconds, not ms
 
 All reference keys are camelCase and all durations are milliseconds; this
 module translates them into the seconds-based snake_case surface of the
@@ -89,6 +97,17 @@ class MetricsConfig:
     host: str = "127.0.0.1"
 
 
+@dataclass
+class ReconcileConfig:
+    """The ``reconcile`` block: the level-triggered registration
+    reconciler (ISSUE 3, :mod:`registrar_tpu.reconcile`).  NOTE the unit
+    departure: ``intervalSeconds`` is SECONDS (the name carries the
+    unit), unlike the reference-derived millisecond keys."""
+
+    interval_s: float = 60.0
+    repair: bool = False
+
+
 #: top-level keys the daemon understands (reference keys + extensions);
 #: anything else is reported in Config.unknown_keys so the mainline can
 #: warn about probable typos ("healthcheck" vs "healthCheck") without
@@ -97,6 +116,7 @@ KNOWN_TOP_LEVEL_KEYS = frozenset(
     {
         "adminIp", "zookeeper", "registration", "healthCheck", "logLevel",
         "maxAttempts", "repairHeartbeatMiss", "metrics",
+        "surviveSessionExpiry", "maxSessionRebirths", "reconcile",
     }
 )
 
@@ -112,6 +132,13 @@ class Config:
     heartbeat_retry: RetryPolicy = field(default_factory=lambda: HEARTBEAT_RETRY)
     repair_heartbeat_miss: bool = False
     metrics: Optional[MetricsConfig] = None
+    #: opt-in session lifecycle supervisor (ISSUE 3): survive expiry by
+    #: building a fresh session in-process instead of exit(1)
+    survive_session_expiry: bool = False
+    #: rebirth circuit-breaker bound (None = client default, 5 / 5 min)
+    max_session_rebirths: Optional[int] = None
+    #: opt-in level-triggered reconciler (ISSUE 3)
+    reconcile: Optional[ReconcileConfig] = None
     #: unrecognized top-level keys (ignored, like the reference — but
     #: surfaced so the daemon can warn about probable typos)
     unknown_keys: Tuple[str, ...] = ()
@@ -267,6 +294,41 @@ def parse_config(raw: Mapping[str, Any]) -> Config:
     if not isinstance(repair, bool):
         raise ConfigError("config.repairHeartbeatMiss must be a boolean")
 
+    survive = raw.get("surviveSessionExpiry", False)
+    if not isinstance(survive, bool):
+        raise ConfigError("config.surviveSessionExpiry must be a boolean")
+
+    max_rebirths = raw.get("maxSessionRebirths")
+    if max_rebirths is not None and (
+        not isinstance(max_rebirths, int)
+        or isinstance(max_rebirths, bool)
+        or max_rebirths < 1
+    ):
+        raise ConfigError("config.maxSessionRebirths must be a positive integer")
+
+    reconcile = None
+    rec_raw = raw.get("reconcile")
+    if rec_raw is not None:
+        if not isinstance(rec_raw, Mapping):
+            raise ConfigError("config.reconcile must be an object")
+        interval = rec_raw.get("intervalSeconds", 60)
+        if (
+            not isinstance(interval, (int, float))
+            or isinstance(interval, bool)
+            or not math.isfinite(interval)
+            or interval <= 0
+        ):
+            raise ConfigError(
+                "config.reconcile.intervalSeconds must be a positive "
+                "number (seconds)"
+            )
+        rec_repair = rec_raw.get("repair", False)
+        if not isinstance(rec_repair, bool):
+            raise ConfigError("config.reconcile.repair must be a boolean")
+        reconcile = ReconcileConfig(
+            interval_s=float(interval), repair=rec_repair
+        )
+
     metrics = None
     metrics_raw = raw.get("metrics")
     if metrics_raw is not None:
@@ -294,6 +356,9 @@ def parse_config(raw: Mapping[str, Any]) -> Config:
         heartbeat_retry=heartbeat_retry,
         repair_heartbeat_miss=repair,
         metrics=metrics,
+        survive_session_expiry=survive,
+        max_session_rebirths=max_rebirths,
+        reconcile=reconcile,
         unknown_keys=tuple(
             sorted(set(raw) - KNOWN_TOP_LEVEL_KEYS)
         ),

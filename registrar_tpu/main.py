@@ -15,7 +15,10 @@ Behavior parity:
   * ``session_expired`` => log fatal + ``exit(1)`` so the supervisor
     (systemd/SMF) restarts the process with a fresh session — crash-restart
     is the load-bearing recovery design (reference main.js:141-144,
-    SURVEY.md §3.4);
+    SURVEY.md §3.4).  The opt-in ``surviveSessionExpiry`` config key
+    (ISSUE 3) absorbs expiry in-process instead: the client builds a
+    fresh session, the agent re-registers, and exit(1) only remains as
+    the fallback when the rebirth circuit breaker trips;
   * every lifecycle event is logged, with heartbeat failures edge-triggered
     through an ``is_down`` latch so a long outage logs once
     (reference main.js:149,187-198).
@@ -147,6 +150,8 @@ async def run(cfg: Config, *, _exit=sys.exit) -> None:
         connect_timeout_ms=cfg.zookeeper.connect_timeout_ms,
         chroot=cfg.zookeeper.chroot,
         request_timeout_ms=cfg.zookeeper.request_timeout_ms,
+        survive_session_expiry=cfg.survive_session_expiry,
+        max_session_rebirths=cfg.max_session_rebirths,
     )
 
     zk.on("close", lambda *a: log.warning("zookeeper: disconnected"))
@@ -168,8 +173,18 @@ async def run(cfg: Config, *, _exit=sys.exit) -> None:
         exit_code = 1
         stopping.set()
 
+    # With surviveSessionExpiry, expiry is absorbed in-process and
+    # announced as session_reborn; session_expired then only fires
+    # terminally (feature off, or the rebirth circuit breaker tripped) —
+    # either way the reference's crash-restart path below still applies.
     zk.on("session_expired",
           lambda *_a: _die("ZooKeeper session_expired event; exiting"))
+    zk.on("session_reborn", lambda sid: log.warning(
+        "zookeeper: session expired; fresh session established in-process",
+        extra={"zdata": {"session": f"0x{sid:x}"}}))
+    zk.on("rebirth_breaker_tripped", lambda n: log.error(
+        "zookeeper: session rebirth circuit breaker tripped",
+        extra={"zdata": {"rebirths_in_window": n}}))
 
     ee = register_plus(
         zk,
@@ -179,6 +194,14 @@ async def run(cfg: Config, *, _exit=sys.exit) -> None:
         heartbeat_interval=cfg.heartbeat_interval_s,
         heartbeat_retry=cfg.heartbeat_retry,
         repair_heartbeat_miss=cfg.repair_heartbeat_miss,
+        reconcile=(
+            {
+                "interval_seconds": cfg.reconcile.interval_s,
+                "repair": cfg.reconcile.repair,
+            }
+            if cfg.reconcile is not None
+            else None
+        ),
     )
 
     ee.on("fail", lambda err: log.error(
@@ -200,6 +223,13 @@ async def run(cfg: Config, *, _exit=sys.exit) -> None:
     ee.on("unregister", lambda err, nodes: log.warning(
         "registrar: unregistered",
         extra={"zdata": {"err": err, "znodes": nodes}}))
+    ee.on("drift", lambda d: log.warning(
+        "registrar: drift detected",
+        extra={"zdata": {"path": d.path, "reason": d.reason,
+                         "detail": d.detail}}))
+    ee.on("driftRepaired", lambda d: log.info(
+        "registrar: drift repaired",
+        extra={"zdata": {"path": d.path, "reason": d.reason}}))
 
     # Edge-triggered heartbeat logging (reference main.js:149,187-198).
     is_down = False

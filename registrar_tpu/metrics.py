@@ -26,6 +26,14 @@ Exported metrics (all prefixed ``registrar_``):
     registrar_znodes_owned              znodes this instance maintains
     registrar_zk_connected              1 while the ZK session is connected
     registrar_uptime_seconds            seconds since instrumentation started
+    registrar_session_rebirths_total    fresh in-process sessions after expiry
+                                        (surviveSessionExpiry, ISSUE 3)
+    registrar_rebirth_breaker_trips_total  rebirth circuit-breaker trips
+                                        (fell back to terminal expiry)
+    registrar_drift_total{reason}       reconciler drift detected, by reason
+    registrar_drift_repaired_total{reason}  reconciler drift converged
+    registrar_reconcile_sweeps_total    reconcile sweeps completed
+    registrar_reconcile_sweep_seconds   duration of the last reconcile sweep
 """
 
 from __future__ import annotations
@@ -34,6 +42,8 @@ import asyncio
 import logging
 import time
 from typing import Callable, Dict, List, Optional, Tuple
+
+from registrar_tpu import reconcile as reconcile_mod
 
 log = logging.getLogger("registrar_tpu.metrics")
 
@@ -279,6 +289,31 @@ def instrument(ee, zk, registry: Optional[MetricsRegistry] = None) -> MetricsReg
     uptime = reg.gauge(
         "registrar_uptime_seconds", "Seconds since instrumentation started"
     )
+    rebirths = reg.counter(
+        "registrar_session_rebirths_total",
+        "Fresh ZK sessions established in-process after an expiry "
+        "(surviveSessionExpiry)",
+    )
+    breaker_trips = reg.counter(
+        "registrar_rebirth_breaker_trips_total",
+        "Session-rebirth circuit breaker trips (fell back to terminal "
+        "session expiry)",
+    )
+    drift = reg.counter(
+        "registrar_drift_total",
+        "Registration drift detected by the reconciler, by reason",
+    )
+    drift_repaired = reg.counter(
+        "registrar_drift_repaired_total",
+        "Registration drift converged by the reconciler, by reason",
+    )
+    sweeps = reg.counter(
+        "registrar_reconcile_sweeps_total", "Reconcile sweeps completed"
+    )
+    sweep_seconds = reg.gauge(
+        "registrar_reconcile_sweep_seconds",
+        "Duration of the last reconcile sweep (seconds)",
+    )
 
     start = time.monotonic()
     uptime.set_function(lambda: time.monotonic() - start)
@@ -294,7 +329,22 @@ def instrument(ee, zk, registry: Optional[MetricsRegistry] = None) -> MetricsReg
         heartbeats.inc(0, labels={"status": status})
     for to in ("down", "up"):
         transitions.inc(0, labels={"to": to})
+    for reason in reconcile_mod.REASONS:
+        drift.inc(0, labels={"reason": reason})
+        drift_repaired.inc(0, labels={"reason": reason})
 
+    def on_sweep(summary) -> None:
+        sweeps.inc()
+        sweep_seconds.set(float(summary.get("duration", 0.0)))
+
+    zk.on("session_reborn", lambda *_a: rebirths.inc())
+    zk.on("rebirth_breaker_tripped", lambda *_a: breaker_trips.inc())
+    ee.on("drift", lambda d: drift.inc(labels={"reason": d.reason}))
+    ee.on(
+        "driftRepaired",
+        lambda d: drift_repaired.inc(labels={"reason": d.reason}),
+    )
+    ee.on("reconcile", on_sweep)
     ee.on("register", lambda *_a: registrations.inc())
     ee.on("unregister", lambda *_a: unregistrations.inc())
     ee.on("heartbeat", lambda *_a: heartbeats.inc(labels={"status": "ok"}))

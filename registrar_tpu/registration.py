@@ -103,6 +103,44 @@ def znode_paths(
     return nodes
 
 
+def registration_payloads(
+    registration: Mapping[str, Any],
+    admin_ip: Optional[str] = None,
+    hostname: Optional[str] = None,
+):
+    """The registration's desired znode set and payload bytes:
+    ``(host_paths, host_payload, service_path, service_payload)`` —
+    service fields are None when no service is configured.
+
+    The ONE place this is computed: the write pipeline
+    (:func:`_register_once`) and the reconciler's desired-state diff
+    (:func:`registrar_tpu.reconcile.desired_records`) both call it, so
+    the bytes the pipeline writes and the bytes the sweep expects can
+    never drift apart (a formula divergence would otherwise surface as
+    permanent false ``payload`` drift — and, with repair on, a rewrite
+    of the live registration every interval).
+    """
+    service = registration.get("service")
+    service_payload = (
+        payload_bytes(service_record(service)) if service is not None else None
+    )
+    nodes = znode_paths(registration, hostname)
+    address = admin_ip if admin_ip else default_address()
+    ports = registration.get("ports")
+    if ports is None and service is not None:
+        ports = [service["service"]["port"]]
+    record_payload = payload_bytes(
+        host_record(
+            registration["type"], address,
+            ttl=registration.get("ttl"), ports=ports,
+        )
+    )
+    service_path = (
+        domain_to_path(registration["domain"]) if service is not None else None
+    )
+    return nodes, record_payload, service_path, service_payload
+
+
 async def _fanout(coros) -> None:
     """Await a stage's parallel ops; a single op (the common host-type
     registration: one znode, one parent) runs inline without the Task +
@@ -163,22 +201,9 @@ async def _register_once(
     settle_delay: float,
 ) -> List[str]:
     """One pass of the five-stage pipeline (validated input)."""
-    service = registration.get("service")
-    service_payload = (
-        payload_bytes(service_record(service)) if service is not None else None
+    nodes, record_payload, path, service_payload = registration_payloads(
+        registration, admin_ip, hostname
     )
-
-    path = domain_to_path(registration["domain"])
-    nodes = znode_paths(registration, hostname)
-    address = admin_ip if admin_ip else default_address()
-
-    ports = registration.get("ports")
-    if ports is None and service is not None:
-        ports = [service["service"]["port"]]
-    record = host_record(
-        registration["type"], address, ttl=registration.get("ttl"), ports=ports
-    )
-    record_payload = payload_bytes(record)
 
     log.debug("register: entered (domain=%s nodes=%s)", registration["domain"], nodes)
 

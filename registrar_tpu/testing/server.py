@@ -17,7 +17,11 @@ subset our client uses), with genuine session semantics:
 Because the client under test talks to this server over an actual socket,
 the full wire path (framing, jute encoding, xid bookkeeping, watch
 dispatch) is exercised, not mocked.  Tests can also force failures:
-:meth:`ZKServer.expire_session`, :meth:`ZKServer.drop_connections`.
+:meth:`ZKServer.expire_session`, :meth:`ZKServer.drop_connections`, and
+the ISSUE 3 state-corruption controls :meth:`ZKServer.corrupt_node`
+(out-of-band payload overwrite) and :meth:`ZKServer.seize_node`
+(ephemeralOwner rewrite) that mint the drift classes the reconciler
+sweeps for.
 
 Run standalone for manual end-to-end runs of the daemon:
 
@@ -576,6 +580,38 @@ class ZKServer:
         """Sever all client TCP connections without expiring sessions."""
         for conn in list(self._conns):
             await conn.close()
+
+    async def corrupt_node(self, path: str, data: bytes) -> None:
+        """Overwrite a znode's payload out-of-band (ISSUE 3 control).
+
+        Models an operator's ``zkcli set`` / a tool clobbering a record:
+        a genuine setData (version bump, mzxid, data watches fire), just
+        not issued by the owner — exactly the drift the reconciler's
+        ``payload``/``staleService`` sweep exists to catch.  Raises
+        ZKError(NO_NODE) when the path does not exist.
+        """
+        await self._set_data_node(path, data, -1)
+
+    def seize_node(self, path: str, owner: int) -> None:
+        """Rewrite a node's ephemeralOwner (ISSUE 3 control).
+
+        Models the ownership corruptions a live run can be left with — a
+        zombie predecessor's stale znode (owner = a dead/foreign session
+        id), or a node flattened to persistent (owner = 0) by a bad
+        restore.  Session ephemeral-sets are kept coherent so the expiry
+        sweeper's behavior stays honest.  KeyError when the path does
+        not exist.
+        """
+        node = self._resolve(path)
+        if node.ephemeral_owner:
+            prev = self.sessions.get(node.ephemeral_owner)
+            if prev is not None:
+                prev.ephemerals.discard(path)
+        node.ephemeral_owner = owner
+        if owner:
+            sess = self.sessions.get(owner)
+            if sess is not None:
+                sess.ephemerals.add(path)
 
     def get_node(self, path: str) -> Optional[ZNode]:
         """Direct tree access for assertions (bypasses the protocol)."""

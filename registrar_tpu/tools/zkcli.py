@@ -14,6 +14,7 @@ one command instead of manual tree-walking::
     python -m registrar_tpu.tools.zkcli -s 127.0.0.1:2181 resolve authcache.emy-10.joyent.us
     python -m registrar_tpu.tools.zkcli -s 127.0.0.1:2181 resolve -t SRV _http._tcp.example.joyent.us
     python -m registrar_tpu.tools.zkcli -s 127.0.0.1:2181 admin ruok
+    python -m registrar_tpu.tools.zkcli verify -f /opt/registrar/etc/config.json
     python -m registrar_tpu.tools.zkcli -s 127.0.0.1:2181 getacl /us/joyent
     python -m registrar_tpu.tools.zkcli -s 127.0.0.1:2181 --auth digest:ops:pw \
         setacl /us/joyent/locked digest:ops:HASH:cdrwa world:anyone:r
@@ -34,6 +35,8 @@ Because the session persists between commands, ``create -e`` ephemerals
 live until the prompt exits — handy for rehearsing registrar failover.
 
 Exit status: 0 on success, 1 on ZK errors (e.g. no such node), 2 on usage.
+``verify`` refines this into its audit contract: 0 in-sync, 1 drift
+detected, 2 unreachable — cron- and runbook-friendly (ISSUE 3 satellite).
 """
 
 from __future__ import annotations
@@ -502,6 +505,74 @@ async def _cmd_setacl(zk: ZKClient, args) -> int:
     return 0
 
 
+async def _cmd_verify(args) -> int:
+    """Read-only drift audit: diff live ZooKeeper state against a
+    registrar config's desired records (the reconciler's sweep,
+    :func:`registrar_tpu.reconcile.audit`).
+
+    Exit status is the cron/runbook contract: 0 in-sync, 1 drift
+    detected, 2 unreachable (ensemble down, or the config itself
+    unreadable/invalid — either way the audit could not run).  Connects
+    with the config's own ``zookeeper`` block (servers, chroot), not the
+    ``-s`` flag, so the audit sees exactly what the daemon would.
+    """
+    from registrar_tpu import reconcile
+    from registrar_tpu.config import ConfigError, load_config
+
+    try:
+        cfg = load_config(args.file)
+    except ConfigError as e:
+        print(f"zkcli: verify: {e}", file=sys.stderr)
+        return 2
+    zk = ZKClient(
+        cfg.zookeeper.servers,
+        timeout_ms=cfg.zookeeper.timeout_ms,
+        connect_timeout_ms=cfg.zookeeper.connect_timeout_ms,
+        chroot=cfg.zookeeper.chroot,
+        reconnect=False,
+        # The audit itself must be bounded too, or a server that accepts
+        # the handshake and then stalls replies hangs the cron job
+        # forever instead of exiting 2: honor the config's own
+        # per-operation deadline, else derive one from --timeout.
+        request_timeout_ms=(
+            cfg.zookeeper.request_timeout_ms
+            if cfg.zookeeper.request_timeout_ms is not None
+            else max(int(args.timeout * 1000), 1)
+        ),
+    )
+    try:
+        try:
+            await asyncio.wait_for(zk.connect(), timeout=args.timeout)
+        except Exception as e:  # noqa: BLE001 - probe failure, not a bug
+            print(
+                f"zkcli: verify: cannot connect to "
+                f"{cfg.zookeeper.servers}: {e!r}", file=sys.stderr,
+            )
+            return 2
+        try:
+            drifts = await reconcile.audit(
+                zk, cfg.registration,
+                admin_ip=cfg.admin_ip, hostname=args.hostname,
+            )
+        except (ZKError, ConnectionError, OSError, ValueError) as e:
+            print(f"zkcli: verify: audit failed: {e}", file=sys.stderr)
+            return 2
+    finally:
+        await zk.close()
+    if not drifts:
+        print(f"in sync: {args.file} matches the live ensemble")
+        return 0
+    for d in drifts:
+        detail = f"  ({d.detail})" if d.detail else ""
+        print(f"drift: {d.reason}  {d.path}{detail}")
+    rollup = ", ".join(
+        f"{reason}={count}"
+        for reason, count in sorted(reconcile.summarize(drifts).items())
+    )
+    print(f"{len(drifts)} drift(s): {rollup}", file=sys.stderr)
+    return 1
+
+
 async def _cmd_resolve(zk: ZKClient, args) -> int:
     res = await binderview.resolve(zk, args.name, args.qtype)
     if res.empty:
@@ -659,6 +730,27 @@ def _register_commands(sub) -> None:
         help="expected aversion (default: unconditional)",
     )
     p.set_defaults(fn=_cmd_setacl)
+
+    p = sub.add_parser(
+        "verify",
+        help="diff live ZooKeeper state against a registrar config's "
+        "desired records, read-only (exit 0 in-sync / 1 drift / "
+        "2 unreachable) — connects per the config's own zookeeper block",
+    )
+    p.add_argument(
+        "-f", "--file", required=True, metavar="CONFIG",
+        help="registrar config file (the daemon's -f argument)",
+    )
+    p.add_argument(
+        "--hostname", default=None,
+        help="audit this hostname's records (default: this machine's "
+        "hostname, matching what the daemon would register)",
+    )
+    p.add_argument(
+        "--timeout", type=float, default=10.0, metavar="SECONDS",
+        help="connect budget before reporting unreachable (default 10)",
+    )
+    p.set_defaults(fn=_cmd_verify, raw=True)
 
     p = sub.add_parser(
         "resolve", help="answer a DNS query the way Binder would"

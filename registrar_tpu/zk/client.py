@@ -19,6 +19,19 @@ Connection/session model:
     re-arming watches via SetWatches.  If the server no longer knows the
     session it emits ``session_expired`` — the daemon's policy is to exit
     and let the supervisor restart it (reference main.js:141-144).
+  * Session lifecycle supervisor (ISSUE 3, opt-in
+    ``survive_session_expiry``): instead of the terminal
+    ``session_expired``, an expiry resets the client to a fresh-session
+    handshake (session_id 0, blank passwd, zxid 0) and the normal
+    jittered reconnect machinery establishes a *new* session in-process,
+    announced via ``session_reborn``.  The old session's ephemerals are
+    gone — re-running the registration pipeline is the orchestrator's
+    job (agent.py consumes the event).  A ``max_session_rebirths``-per-
+    :data:`REBIRTH_WINDOW_S` circuit breaker guards against expiry
+    storms (a flapping ensemble, a mis-sized session timeout): when it
+    trips, the client falls back to the reference-exact terminal
+    ``session_expired`` so the supervisor restart path still exists.
+    Default off: expiry is terminal, byte-identical to the reference.
   * Network-fault armor (ISSUE 2): optional per-operation deadlines
     (``request_timeout_ms`` -> :class:`OperationTimeoutError`, connection
     torn down because a FIFO pipeline cannot skip a reply), a bounded
@@ -69,6 +82,16 @@ from registrar_tpu.zk.protocol import (
 
 log = logging.getLogger("registrar_tpu.zk.client")
 
+#: Sliding window (seconds) for the session-rebirth circuit breaker: more
+#: than ``max_session_rebirths`` fresh sessions within it means expiry is
+#: systemic (flapping ensemble, mis-sized session timeout) and in-process
+#: recovery is just churning DNS — fall back to the reference's terminal
+#: ``session_expired`` so the supervisor restart path takes over.
+REBIRTH_WINDOW_S = 300.0
+
+#: default ``max_session_rebirths`` (per :data:`REBIRTH_WINDOW_S`)
+DEFAULT_MAX_SESSION_REBIRTHS = 5
+
 
 class ZKClient(EventEmitter):
     """One logical ZooKeeper session over a sequence of TCP connections.
@@ -88,6 +111,8 @@ class ZKClient(EventEmitter):
         chroot: Optional[str] = None,
         request_timeout_ms: Optional[int] = None,
         connect_pass_timeout_ms: Optional[int] = None,
+        survive_session_expiry: bool = False,
+        max_session_rebirths: Optional[int] = None,
     ):
         """``request_timeout_ms``: per-operation deadline.  When set, every
         awaited reply is bounded; on expiry the connection is torn down
@@ -103,7 +128,17 @@ class ZKClient(EventEmitter):
         :meth:`connect` over the server list.  Without it, each candidate
         gets ``connect_timeout_ms`` and a long list of blackholed servers
         can stall a reconnect far past the session timeout; the default
-        bound is the session timeout itself (``timeout_ms``)."""
+        bound is the session timeout itself (``timeout_ms``).
+
+        ``survive_session_expiry``: opt into the in-process session
+        lifecycle supervisor (module docstring) — expiry resets to a
+        fresh-session handshake and the reconnect machinery builds a new
+        session, announced via ``session_reborn``, instead of the
+        terminal ``session_expired``.  ``max_session_rebirths`` bounds
+        rebirths per :data:`REBIRTH_WINDOW_S` (default
+        :data:`DEFAULT_MAX_SESSION_REBIRTHS`); past it the breaker trips
+        (``rebirth_breaker_tripped`` event) and expiry is terminal
+        again."""
         super().__init__()
         servers = list(servers)
         if not servers:
@@ -136,6 +171,20 @@ class ZKClient(EventEmitter):
         # Default reconnects use decorrelated jitter (RECONNECT_RETRY): a
         # fleet dropped by an ensemble restart must not retry in lockstep.
         self.reconnect_policy = reconnect_policy or RECONNECT_RETRY
+        self.survive_session_expiry = survive_session_expiry
+        if max_session_rebirths is not None and max_session_rebirths < 1:
+            raise ValueError("max_session_rebirths must be >= 1")
+        self.max_session_rebirths = (
+            max_session_rebirths
+            if max_session_rebirths is not None
+            else DEFAULT_MAX_SESSION_REBIRTHS
+        )
+        #: total fresh sessions established in-process after an expiry
+        self.rebirths = 0
+        #: monotonic stamps of recent rebirths (circuit-breaker window)
+        self._rebirth_times: Deque[float] = deque()
+        #: an expiry was absorbed; the next successful connect is a rebirth
+        self._rebirth_pending = False
 
         self.session_id = 0
         self.session_passwd = b"\x00" * 16
@@ -271,6 +320,14 @@ class ZKClient(EventEmitter):
             raise SessionExpiredError()
 
         reattached = self.session_id == resp.session_id and self.session_id != 0
+        # NOT consumed yet: the handshake tail below (auth replay, watch
+        # re-arm) still awaits, and a drop there aborts this attempt —
+        # the flag must survive so the NEXT attempt (which will reattach
+        # the fresh session, session_id != 0 now) still announces the
+        # rebirth.  Consuming early silently loses session_reborn and
+        # the agent never re-registers (a live session with no
+        # registration — the outage this feature exists to prevent).
+        reborn = self._rebirth_pending
         self.session_id = resp.session_id
         self.session_passwd = resp.passwd
         self.negotiated_timeout_ms = resp.timeout_ms
@@ -282,7 +339,12 @@ class ZKClient(EventEmitter):
         self._read_task = asyncio.create_task(self._read_loop())
         self._ping_task = asyncio.create_task(self._ping_loop())
         await self._replay_auths()
-        if reattached:
+        if reattached or reborn:
+            # A reborn session re-arms its watch registrations too: the
+            # listeners are still alive and SetWatches with zxid 0 makes
+            # the server deliver (conservatively) any transition the
+            # watched paths saw, so no watcher silently goes dead across
+            # the session boundary.
             await self._rearm_watches()
         log.debug(
             "connected to %s:%d session=0x%x timeout=%dms",
@@ -290,6 +352,14 @@ class ZKClient(EventEmitter):
         )
         self.emit("state", "connected")
         self.emit("connect")
+        if reborn:
+            self._rebirth_pending = False  # consumed only on full success
+            self.rebirths += 1
+            log.warning(
+                "session reborn: fresh session 0x%x established in-process "
+                "(rebirth %d)", self.session_id, self.rebirths,
+            )
+            self.emit("session_reborn", self.session_id)
 
     async def _replay_auths(self) -> None:
         """Re-send stored credentials on a fresh connection.
@@ -405,10 +475,12 @@ class ZKClient(EventEmitter):
                     "reconnect attempt %d failed (%r); retrying in %.1fs",
                     n, err, delay,
                 ),
-                # An expired/closed session cannot be resurrected by retrying.
-                retryable=lambda err: not (
-                    isinstance(err, SessionExpiredError) or self._closed
-                ),
+                # A terminally expired/closed session cannot be resurrected
+                # by retrying — but a SURVIVED expiry leaves the client
+                # open (session reset to 0 by _emit_expired), and the next
+                # attempt performs the fresh-session handshake, so only
+                # _closed gates here.
+                retryable=lambda err: not self._closed,
             )
         except SessionExpiredError:
             pass  # _emit_expired already fired
@@ -421,6 +493,42 @@ class ZKClient(EventEmitter):
             log.exception("reconnect loop gave up")
 
     def _emit_expired(self) -> None:
+        """The server disowned our session: rebirth or terminal expiry.
+
+        With ``survive_session_expiry`` (and the circuit breaker not
+        tripped) the client resets to a fresh-session handshake — the
+        caller still raises :class:`SessionExpiredError` for the attempt
+        in flight, but the client stays open and the reconnect loop's
+        next attempt connects with session_id 0, establishing a new
+        session (``session_reborn`` fires from _connect_one).  Otherwise:
+        the reference-exact terminal path — closed + ``session_expired``.
+        """
+        if self.survive_session_expiry and not self._closed:
+            now = time.monotonic()
+            while (
+                self._rebirth_times
+                and now - self._rebirth_times[0] > REBIRTH_WINDOW_S
+            ):
+                self._rebirth_times.popleft()
+            if len(self._rebirth_times) < self.max_session_rebirths:
+                self._rebirth_times.append(now)
+                old = self.session_id
+                self.session_id = 0
+                self.session_passwd = b"\x00" * 16
+                self.last_zxid = 0
+                self._rebirth_pending = True
+                log.warning(
+                    "session 0x%x expired; rebuilding a fresh session "
+                    "in-process (surviveSessionExpiry)", old,
+                )
+                self.emit("state", "session_lost")
+                return
+            log.error(
+                "session rebirth circuit breaker tripped (%d rebirths in "
+                "%.0fs); falling back to terminal session_expired",
+                len(self._rebirth_times), REBIRTH_WINDOW_S,
+            )
+            self.emit("rebirth_breaker_tripped", len(self._rebirth_times))
         self._closed = True
         self.emit("state", "session_expired")
         self.emit("session_expired")
@@ -1057,16 +1165,36 @@ class ZKClient(EventEmitter):
                     raise res
             if post_err is not None:
                 raise post_err
+            # Ownership sweep (ISSUE 3 satellite): the EXISTS replies
+            # already carry each node's stat, and a bare existence probe
+            # passed forever on an ephemeral held by a FOREIGN session —
+            # a zombie predecessor's stale znode, or a hijacking
+            # duplicate registering our hostname.  Persistent nodes (the
+            # service record, ephemeralOwner 0) are exempt; the NO_NODE
+            # and transport-error paths above are byte-identical to the
+            # pre-check behavior.
+            for node, res in zip(nodes, results):
+                stat = proto.ExistsResponse.read(res).stat
+                if (
+                    stat.ephemeral_owner
+                    and stat.ephemeral_owner != self.session_id
+                ):
+                    raise OwnershipError(
+                        node, stat.ephemeral_owner, self.session_id
+                    )
 
         await call_with_backoff(
             check,
             retry or HEARTBEAT_RETRY,
             # An expired session cannot heartbeat its way back: retrying
             # just burns the bounded attempts while the daemon should
-            # already be exiting for its supervisor restart.  Everything
-            # else keeps the reference's retry-all behavior.
+            # already be exiting for its supervisor restart.  A foreign-
+            # owned ephemeral is just as un-retryable — the other session
+            # holds it until IT dies.  Everything else keeps the
+            # reference's retry-all behavior.
             retryable=lambda err: not (
-                isinstance(err, ZKError) and err.code == Err.SESSION_EXPIRED
+                isinstance(err, OwnershipError)
+                or (isinstance(err, ZKError) and err.code == Err.SESSION_EXPIRED)
             ),
         )
 
@@ -1133,6 +1261,32 @@ class SessionExpiredError(ZKError):
         super().__init__(Err.SESSION_EXPIRED)
 
 
+class OwnershipError(ZKError):
+    """An owned znode's ephemeral is held by a FOREIGN session.
+
+    Raised by the heartbeat sweep (ISSUE 3 satellite): the node exists —
+    so a bare existence probe reads it as alive forever — but its
+    ``ephemeralOwner`` is not our session, meaning this registrar does
+    not control its lifetime (a zombie predecessor's stale znode, an
+    operator's hand-made node, a duplicate instance claiming the same
+    hostname).  Not retryable (the foreign session holds the node until
+    it dies), and deliberately never "repaired" by deleting the node:
+    two live claimants for one hostname is an operator problem — see
+    docs/DESIGN.md "Why repair never steals".
+    """
+
+    def __init__(self, path: str, owner: int, session_id: int):
+        self.owner = owner
+        self.session = session_id
+        super().__init__(Err.RUNTIME_INCONSISTENCY, path)
+        # Repeatable diagnosis beats the generic code string: name both
+        # sessions in the message operators will grep for.
+        self.args = (
+            f"{path} ephemeral is owned by foreign session 0x{owner:x} "
+            f"(ours: 0x{session_id:x})",
+        )
+
+
 class OperationTimeoutError(ZKError):
     """A per-operation deadline (``request_timeout_ms``) expired.
 
@@ -1154,6 +1308,8 @@ async def create_zk_client(
     retry_policy: Optional[RetryPolicy] = None,
     chroot: Optional[str] = None,
     request_timeout_ms: Optional[int] = None,
+    survive_session_expiry: bool = False,
+    max_session_rebirths: Optional[int] = None,
 ) -> ZKClient:
     """Create and connect a client, retrying forever (reference lib/zk.js:62-127).
 
@@ -1170,6 +1326,8 @@ async def create_zk_client(
         reconnect_policy=retry_policy,  # None -> jittered RECONNECT_RETRY
         chroot=chroot,
         request_timeout_ms=request_timeout_ms,
+        survive_session_expiry=survive_session_expiry,
+        max_session_rebirths=max_session_rebirths,
     )
 
     def backoff_log(number: int, delay: float, err: Exception) -> None:

@@ -45,6 +45,7 @@ import random
 import sys
 
 from registrar_tpu import binderview
+from registrar_tpu.agent import register_plus
 from registrar_tpu.records import parse_payload
 from registrar_tpu.registration import register, unregister
 from registrar_tpu.retry import RetryPolicy
@@ -382,6 +383,182 @@ async def test_chaos_churn_converges():
                     await w.client.close()
             for proxy in proxies:
                 await proxy.stop()
+
+
+class _RebornWorker:
+    """One full agent stack (register_plus + surviveSessionExpiry client +
+    repairing reconciler) riding out the expiry storm in-process."""
+
+    def __init__(self, i: int, addresses):
+        self.i = i
+        self.hostname = f"reborn{i}"
+        self.admin_ip = f"10.9.1.{i + 1}"
+        self.addresses = addresses
+        self.client: ZKClient = None
+        self.ee = None
+        #: terminal session_expired events — the "process exit" analog
+        #: (main.py's _die fires exactly on this event)
+        self.terminal_expiries = 0
+
+    async def start(self) -> None:
+        self.client = ZKClient(
+            self.addresses,
+            timeout_ms=8000,
+            connect_timeout_ms=500,
+            survive_session_expiry=True,
+            # the storm deliberately expires sessions far faster than any
+            # production incident; the breaker must not be the variable
+            # under test here (it has its own deterministic test)
+            max_session_rebirths=10_000,
+            reconnect_policy=FAST_RECONNECT,
+        )
+        await self.client.connect()
+
+        def on_terminal(*_a):
+            self.terminal_expiries += 1
+
+        self.client.on("session_expired", on_terminal)
+        self.ee = register_plus(
+            self.client,
+            _reg(),
+            admin_ip=self.admin_ip,
+            hostname=self.hostname,
+            settle_delay=0.01,
+            heartbeat_interval=0.1,
+            heartbeat_retry=RetryPolicy(
+                max_attempts=1, initial_delay=0.01, max_delay=0.01
+            ),
+            register_retry=RetryPolicy(
+                max_attempts=5, initial_delay=0.02, max_delay=0.2,
+                jitter="decorrelated",
+            ),
+            reconcile={"interval_seconds": 0.1, "repair": True},
+        )
+        await self.ee.wait_for("register", timeout=10)
+
+    async def stop(self) -> None:
+        if self.ee is not None:
+            self.ee.stop()
+        if self.client is not None and not self.client.closed:
+            await self.client.close()
+
+
+async def test_chaos_storm_forced_expiry_survived_in_process():
+    """ISSUE 3 acceptance: force-expire sessions mid-storm; the fleet
+    (surviveSessionExpiry + reconcile.repair + the rebirth consumer)
+    reconverges to the exact znode contract with ZERO process exits —
+    no client ever sees the terminal session_expired, nobody rebuilds a
+    client by hand (the reference fleet would have crash-restarted once
+    per expiry event).  CHAOS_SEED-reproducible like the main storm.
+    """
+    seed = int(os.environ.get("CHAOS_SEED", random.randrange(2**32)))
+    churn_s = float(os.environ.get("CHAOS_SECONDS", "2.5"))
+    print(f"CHAOS_SEED={seed} CHAOS_SECONDS={churn_s} (expiry storm)",
+          file=sys.stderr)
+    rng = random.Random(seed)
+
+    async with ZKEnsemble(ENSEMBLE, tick_ms=20) as ens:
+        workers = [_RebornWorker(i, ens.addresses) for i in range(N_WORKERS)]
+        for w in workers:
+            await w.start()
+        try:
+            stop = asyncio.Event()
+            events: list = []
+
+            async def expiry_storm() -> None:
+                while not stop.is_set():
+                    await asyncio.sleep(rng.uniform(0.02, 0.08))
+                    live = [
+                        i for i, m in enumerate(ens.servers)
+                        if m is not None and m._server is not None
+                    ]
+                    dead = [i for i in range(ENSEMBLE) if i not in live]
+                    roll = rng.random()
+                    if roll < 0.5 and live:
+                        # THE event under test: a forced session expiry
+                        sids = sorted(
+                            s.session_id
+                            for s in ens.state.sessions.values()
+                            if s.connected
+                        )
+                        if sids:
+                            idx = rng.randrange(len(sids))
+                            await ens.servers[live[0]].expire_session(
+                                sids[idx]
+                            )
+                            events.append(("expire", idx))
+                    elif roll < 0.65 and len(live) > 1:
+                        i = rng.choice(live)
+                        await ens.kill(i)
+                        events.append(("kill", i))
+                    elif roll < 0.85 and dead:
+                        i = rng.choice(dead)
+                        await ens.restart(i)
+                        events.append(("restart", i))
+                    elif live:
+                        i = rng.choice(live)
+                        await ens.servers[i].drop_connections()
+                        events.append(("drop", i))
+                for i in range(ENSEMBLE):
+                    await ens.restart(i)
+
+            storm = asyncio.create_task(expiry_storm())
+            await asyncio.sleep(churn_s)
+            stop.set()
+            await storm
+            assert any(ev[0] == "expire" for ev in events), events
+
+            # -- convergence: exact §2.6 contract, in-process ------------
+            deadline = asyncio.get_running_loop().time() + 30
+            pending = set(range(N_WORKERS))
+            while pending:
+                assert asyncio.get_running_loop().time() < deadline, (
+                    f"workers {sorted(pending)} never reconverged; "
+                    f"events={events}"
+                )
+                for i in sorted(pending):
+                    w = workers[i]
+                    node = ens.get_node(f"{PATH}/{w.hostname}")
+                    if (
+                        node is not None
+                        and w.client.connected
+                        and node.ephemeral_owner == w.client.session_id
+                    ):
+                        pending.discard(i)
+                await asyncio.sleep(0.05)
+
+            # zero process exits: nobody saw the terminal event, every
+            # client object survived the whole storm in-process
+            for w in workers:
+                assert w.terminal_expiries == 0, f"worker {w.i} went terminal"
+                assert not w.client.closed
+            total_rebirths = sum(w.client.rebirths for w in workers)
+            expiries = sum(1 for ev in events if ev[0] == "expire")
+            print(
+                f"expiry storm: {expiries} forced expiries, "
+                f"{total_rebirths} rebirths, {len(events)} faults",
+                file=sys.stderr,
+            )
+
+            # the persistent service record survived, persistent
+            svc = ens.get_node(PATH)
+            assert svc is not None and svc.ephemeral_owner == 0
+            assert parse_payload(svc.data)["type"] == "service"
+
+            # no ephemeral anywhere belongs to a dead session
+            orphans = _orphan_ephemerals(ens)
+            assert not orphans, f"orphan ephemerals: {orphans}"
+
+            # and the Binder view answers with exactly the live fleet
+            res = await binderview.resolve(
+                workers[0].client, DOMAIN, "A"
+            )
+            assert sorted(a.data for a in res.answers) == sorted(
+                w.admin_ip for w in workers
+            )
+        finally:
+            for w in workers:
+                await w.stop()
 
 
 async def test_chaos_repeats_with_fixed_seed():

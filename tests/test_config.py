@@ -63,6 +63,10 @@ class TestParse:
         assert cfg.zookeeper.chroot == "/tenants/example"
         assert cfg.metrics.port == 9090
         assert cfg.health_check["stdout_match"]["invert"] is True
+        assert cfg.survive_session_expiry is False  # documented, parity off
+        assert cfg.max_session_rebirths == 5
+        assert cfg.reconcile.interval_s == 60.0
+        assert cfg.reconcile.repair is False
 
     def test_request_timeout_opt_in(self):
         # Per-operation deadline (ISSUE 2): off by default (reference
@@ -102,6 +106,52 @@ class TestParse:
             }
         )
         assert cfg.repair_heartbeat_miss is True
+
+    def test_survive_session_expiry_opt_in(self):
+        # ISSUE 3: off by default (reference behavior: expiry = exit(1)).
+        base = {
+            "registration": {"domain": "a.b", "type": "host"},
+            "zookeeper": {"servers": [{"host": "h", "port": 1}]},
+        }
+        cfg = parse_config(base)
+        assert cfg.survive_session_expiry is False
+        assert cfg.max_session_rebirths is None  # client default applies
+        assert cfg.reconcile is None
+        cfg = parse_config(
+            {**base, "surviveSessionExpiry": True, "maxSessionRebirths": 3}
+        )
+        assert cfg.survive_session_expiry is True
+        assert cfg.max_session_rebirths == 3
+        with pytest.raises(ConfigError):
+            parse_config({**base, "surviveSessionExpiry": "yes"})
+        with pytest.raises(ConfigError):
+            parse_config({**base, "maxSessionRebirths": 0})
+        with pytest.raises(ConfigError):
+            parse_config({**base, "maxSessionRebirths": True})
+
+    def test_reconcile_block(self):
+        # ISSUE 3: seconds-based (the name carries the unit), repair off
+        # by default (detect-only).
+        base = {
+            "registration": {"domain": "a.b", "type": "host"},
+            "zookeeper": {"servers": [{"host": "h", "port": 1}]},
+        }
+        cfg = parse_config({**base, "reconcile": {}})
+        assert cfg.reconcile.interval_s == 60.0
+        assert cfg.reconcile.repair is False
+        cfg = parse_config(
+            {**base, "reconcile": {"intervalSeconds": 2.5, "repair": True}}
+        )
+        assert cfg.reconcile.interval_s == 2.5
+        assert cfg.reconcile.repair is True
+        with pytest.raises(ConfigError):
+            parse_config({**base, "reconcile": 60})
+        with pytest.raises(ConfigError):
+            parse_config({**base, "reconcile": {"intervalSeconds": 0}})
+        with pytest.raises(ConfigError):
+            parse_config({**base, "reconcile": {"intervalSeconds": True}})
+        with pytest.raises(ConfigError):
+            parse_config({**base, "reconcile": {"repair": "on"}})
 
     def test_top_level_admin_ip_shim(self):
         # reference main.js:146-147

@@ -1,10 +1,12 @@
 """Kitchen-sink daemon run: every opt-in extension enabled together.
 
 Each extension is tested in isolation elsewhere; this guards their
-*interactions* — chroot + metrics + repairHeartbeatMiss + healthCheck in
-one `main.run()` — since option combinations are where integration bugs
-hide (e.g. repair re-registering through the chrooted client, metrics
-counting a health transition that raced a repair).
+*interactions* — chroot + metrics + repairHeartbeatMiss + healthCheck +
+surviveSessionExpiry + reconcile in one `main.run()` — since option
+combinations are where integration bugs hide (e.g. repair re-registering
+through the chrooted client, metrics counting a health transition that
+raced a repair, a reborn session re-registering under the chroot while
+the reconciler sweeps).
 """
 
 import asyncio
@@ -55,6 +57,8 @@ class TestAllOptionsTogether:
                 "repairHeartbeatMiss": True,
                 "maxAttempts": 1,  # surface NO_NODE without 15 s of retries
                 "metrics": {"port": mport},
+                "surviveSessionExpiry": True,
+                "reconcile": {"intervalSeconds": 0.2, "repair": True},
             }
         )
         task = asyncio.create_task(run(cfg, _exit=lambda code: None))
@@ -119,6 +123,46 @@ class TestAllOptionsTogether:
             await wait_for(back)
             _, _, body = await _http_get("127.0.0.1", mport, "/metrics")
             assert "registrar_health_down 0" in body
+
+            # 6. A forced session expiry is absorbed IN-PROCESS: the
+            #    registration returns under a fresh session through the
+            #    chroot, the daemon never exits, metrics count the rebirth.
+            st = await observer.stat(hostnode)
+            old_owner = st.ephemeral_owner
+            await zk_server.expire_session(old_owner)
+
+            async def reborn():
+                new = await observer.exists(hostnode)
+                return new is not None and new.ephemeral_owner not in (
+                    0, old_owner
+                )
+
+            await wait_for(reborn)
+            assert not task.done(), "daemon exited on a survivable expiry"
+            _, _, body = await _http_get("127.0.0.1", mport, "/metrics")
+            assert "registrar_session_rebirths_total 1" in body
+            assert "registrar_rebirth_breaker_trips_total 0" in body
+
+            # 7. Out-of-band payload drift converges through the chrooted
+            #    reconciler sweep, back to the exact contract bytes.
+            want, _ = await observer.get(hostnode)
+            await zk_server.corrupt_node(hostnode, b'{"evil":1}')
+
+            async def contract_restored():
+                got = await observer.exists(hostnode)
+                if got is None:
+                    return False
+                data, _ = await observer.get(hostnode)
+                return data == want
+
+            await wait_for(contract_restored)
+            _, _, body = await _http_get("127.0.0.1", mport, "/metrics")
+            repaired = {
+                line.rsplit(" ", 1)[0]: float(line.rsplit(" ", 1)[1])
+                for line in body.splitlines()
+                if line.startswith("registrar_drift_repaired_total{")
+            }
+            assert repaired['registrar_drift_repaired_total{reason="payload"}'] >= 1
         finally:
             task.cancel()
             try:

@@ -273,6 +273,71 @@ class TestEndToEnd:
             proc.stdout.close()
             await server.stop()
 
+    async def test_daemon_survives_forced_session_expiry_in_process(
+        self, tmp_path
+    ):
+        # ISSUE 3 acceptance, daemon-level: with surviveSessionExpiry +
+        # reconcile.repair, a forced expiry must NOT exit(1) — the real
+        # subprocess rides it out, re-registering under a fresh session.
+        # (Reference parity when off is pinned by the SIGKILL e2e above:
+        # expiry-driven ephemeral cleanup still works.)
+        server = await ZKServer(max_session_timeout_ms=30000).start()
+        observer = await ZKClient([server.address]).connect()
+        config = {
+            "registration": {"domain": "reborn.e2e.registrar", "type": "host",
+                             "heartbeatInterval": 100},
+            "adminIp": "10.66.66.69",
+            "zookeeper": {
+                "servers": [{"host": server.host, "port": server.port}],
+                "timeout": 5000,
+            },
+            "surviveSessionExpiry": True,
+            "reconcile": {"intervalSeconds": 0.2, "repair": True},
+            "logLevel": "debug",
+        }
+        cfg_path = tmp_path / "config.json"
+        cfg_path.write_text(json.dumps(config))
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "registrar_tpu", "-f", str(cfg_path)],
+            cwd=REPO, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            env={**os.environ, "PYTHONPATH": REPO},
+        )
+        try:
+            hostname = socket.gethostname()
+            node = f"/registrar/e2e/reborn/{hostname}"
+            for _ in range(100):
+                st = await observer.exists(node)
+                if st is not None:
+                    break
+                await asyncio.sleep(0.1)
+            else:
+                raise AssertionError("znode never appeared")
+            old_owner = st.ephemeral_owner
+            assert old_owner != 0
+
+            # Force the daemon's session to expire: the ephemeral dies
+            # with it, then must come back under a FRESH session with
+            # the daemon process still alive.
+            await server.expire_session(old_owner)
+            for _ in range(100):
+                st = await observer.exists(node)
+                if st is not None and st.ephemeral_owner != old_owner:
+                    break
+                await asyncio.sleep(0.1)
+            else:
+                raise AssertionError(
+                    "registration never reappeared under a fresh session"
+                )
+            assert st.ephemeral_owner != 0
+            assert proc.poll() is None, "daemon exited on survivable expiry"
+        finally:
+            if proc.poll() is None:
+                proc.kill()
+                proc.wait(timeout=10)
+            proc.stdout.close()
+            await observer.close()
+            await server.stop()
+
     async def test_daemon_exits_when_initial_registration_fails(self, tmp_path):
         # Reliability fix over the reference (which logs and idles broken,
         # lib/index.js:46-50): a failed initial registration exits(1) so

@@ -157,6 +157,65 @@ class TestInstrumentation:
             await client.close()
             await zk_server.stop()
 
+    async def test_rebirth_and_drift_metrics(self):
+        # ISSUE 3: session rebirths, drift detected/repaired by reason,
+        # and the reconcile sweep counters ride the same event surface.
+        from registrar_tpu.retry import RetryPolicy
+
+        zk_server = await ZKServer().start()
+        client = await ZKClient(
+            [zk_server.address],
+            survive_session_expiry=True,
+            reconnect_policy=RetryPolicy(
+                max_attempts=float("inf"), initial_delay=0.02, max_delay=0.1
+            ),
+        ).connect()
+        try:
+            ee = register_plus(
+                client,
+                {"domain": "metrics.test.us", "type": "host"},
+                admin_ip="10.0.0.1",
+                hostname="mbox",
+                heartbeat_interval=60,
+                settle_delay=0.01,
+                reconcile={"interval_seconds": 0.05, "repair": True},
+            )
+            reg = instrument(ee, client)
+            (znodes,) = await ee.wait_for("register", timeout=10)
+
+            # Pre-seeded zero series exist before anything drifts.
+            text = reg.render()
+            assert 'registrar_drift_total{reason="owner"} 0' in text
+            assert 'registrar_drift_repaired_total{reason="payload"} 0' in text
+            assert "registrar_session_rebirths_total 0" in text
+            assert "registrar_rebirth_breaker_trips_total 0" in text
+
+            # Mint one missing-node drift and let the reconciler repair it.
+            await client.unlink(znodes[0])
+            await ee.wait_for("driftRepaired", timeout=10)
+
+            # Force an expiry -> in-process rebirth -> re-registration.
+            rereg = asyncio.ensure_future(ee.wait_for("register", timeout=10))
+            await zk_server.expire_session(client.session_id)
+            await rereg
+
+            await ee.wait_for("reconcile", timeout=10)
+            assert reg.get("registrar_drift_total").value(
+                {"reason": "missing"}
+            ) >= 1
+            assert reg.get("registrar_drift_repaired_total").value(
+                {"reason": "missing"}
+            ) >= 1
+            assert reg.get("registrar_session_rebirths_total").value() == 1
+            assert reg.get("registrar_rebirth_breaker_trips_total").value() == 0
+            assert reg.get("registrar_reconcile_sweeps_total").value() >= 1
+            rendered = reg.render()
+            assert "registrar_reconcile_sweep_seconds" in rendered
+            ee.stop()
+        finally:
+            await client.close()
+            await zk_server.stop()
+
     async def test_busy_metrics_port_does_not_block_registration(self):
         """A busy port logs an error; registration must proceed anyway."""
         from registrar_tpu.config import parse_config

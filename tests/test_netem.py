@@ -369,6 +369,66 @@ class TestStopReadingDrainWedge:
             await server.stop()
 
 
+class TestRebirthUnderWireFaults:
+    async def test_session_rebirth_converges_through_a_faulty_wire(self):
+        # ISSUE 3 x ISSUE 2: the in-process session supervisor must ride
+        # the same reconnect armor as everything else.  The session is
+        # force-expired while the wire tears down every connection after
+        # a few frames (ResetAfter) — the rebirth's fresh-session
+        # handshake retries through the resets, and once the wire heals
+        # the full agent stack (rebirth consumer + repairing reconciler)
+        # reconverges to an owned registration with zero terminal
+        # expiries.
+        from registrar_tpu.agent import register_plus
+
+        server, proxy, client = await _proxied_pair(
+            survive_session_expiry=True,
+            max_session_rebirths=1000,
+            request_timeout_ms=1500,
+        )
+        try:
+            ee = register_plus(
+                client, REG, admin_ip="10.1.1.9", hostname="rebornnet",
+                settle_delay=0.01, heartbeat_interval=60,
+                register_retry=RetryPolicy(
+                    max_attempts=5, initial_delay=0.02, max_delay=0.2,
+                    jitter="decorrelated",
+                ),
+                reconcile={"interval_seconds": 0.1, "repair": True},
+            )
+            await ee.wait_for("register", timeout=10)
+            terminal = []
+            client.on("session_expired", lambda *a: terminal.append(1))
+
+            # every (re)connect attempt forwards ~one handshake frame's
+            # worth of bytes upstream, then the wire resets it
+            proxy.add(ResetAfter(n=64), direction=UP)
+            await server.expire_session(client.session_id)
+            # let the expiry + a few reset-mangled reconnect attempts play
+            # out on the faulted wire, then heal it
+            await asyncio.sleep(0.6)
+            proxy.clear()
+
+            deadline = asyncio.get_running_loop().time() + 20
+            node = f"{PATH}/rebornnet"
+            while True:
+                assert asyncio.get_running_loop().time() < deadline
+                n = server.get_node(node)
+                if (
+                    n is not None
+                    and client.connected
+                    and n.ephemeral_owner == client.session_id
+                ):
+                    break
+                await asyncio.sleep(0.05)
+            assert terminal == []  # never went terminal: zero process exits
+            assert client.rebirths >= 1
+            assert not _orphan_ephemerals(server)
+            ee.stop()
+        finally:
+            await _shutdown(server, proxy, client)
+
+
 class TestRegistrationRetryLayer:
     async def test_transient_fault_mid_pipeline_retries_to_convergence(self):
         # End-to-end acceptance: a blackholed wire mid-registration makes

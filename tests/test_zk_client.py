@@ -16,6 +16,7 @@ import pytest
 from registrar_tpu.retry import RetryPolicy
 from registrar_tpu.testing.server import ZKServer
 from registrar_tpu.zk.client import (
+    OwnershipError,
     ZKClient,
     create_zk_client,
 )
@@ -353,6 +354,193 @@ class TestHeartbeat:
         finally:
             await client.close()
             await server.stop()
+
+    async def test_heartbeat_foreign_ephemeral_raises_ownership_error(self):
+        # ISSUE 3 satellite: an ephemeral held by ANOTHER session passed
+        # the bare existence probe forever (zombie predecessor, hijacking
+        # duplicate) — it must now fail with the distinct OwnershipError,
+        # without burning the retry budget (the foreign session holds the
+        # node until it dies; retrying cannot help).
+        server, client = await _pair()
+        other = await ZKClient([server.address]).connect()
+        try:
+            await other.create("/hijacked", b"{}", CreateFlag.EPHEMERAL)
+            with pytest.raises(OwnershipError) as ei:
+                await client.heartbeat(["/hijacked"])
+            assert ei.value.path == "/hijacked"
+            assert ei.value.owner == other.session_id
+            assert ei.value.session == client.session_id
+            assert "0x%x" % other.session_id in str(ei.value)
+        finally:
+            await other.close()
+            await client.close()
+            await server.stop()
+
+    async def test_heartbeat_own_ephemeral_and_persistent_pass(self):
+        # The ownership sweep must not flag the normal shapes: our own
+        # ephemerals and the persistent service record (owner 0).
+        server, client = await _pair()
+        try:
+            await client.create("/own-eph", b"", CreateFlag.EPHEMERAL)
+            await client.create("/svc-rec", b"{}")  # persistent
+            await client.heartbeat(["/own-eph", "/svc-rec"])  # no raise
+        finally:
+            await client.close()
+            await server.stop()
+
+
+#: rebirth tests want convergence in milliseconds, not the 1-90 s
+#: production envelope
+_FAST_RECONNECT = RetryPolicy(
+    max_attempts=float("inf"), initial_delay=0.02, max_delay=0.1
+)
+
+
+class TestSessionRebirth:
+    """The in-process session lifecycle supervisor (ISSUE 3 tentpole)."""
+
+    async def test_expiry_without_opt_in_is_terminal(self):
+        # Reference parity: the default client treats expiry as the end —
+        # session_expired fires, the client is closed, no rebirth.
+        server, client = await _pair(reconnect_policy=_FAST_RECONNECT)
+        try:
+            reborn = []
+            client.on("session_reborn", reborn.append)
+            expired = asyncio.ensure_future(
+                client.wait_for("session_expired", timeout=10)
+            )
+            await server.expire_session(client.session_id)
+            await expired
+            await asyncio.sleep(0.2)  # a rebirth would land in here
+            assert client.closed
+            assert not client.connected
+            assert reborn == []
+            assert client.rebirths == 0
+        finally:
+            await client.close()
+            await server.stop()
+
+    async def test_expiry_builds_fresh_session_in_process(self):
+        server, client = await _pair(
+            survive_session_expiry=True, reconnect_policy=_FAST_RECONNECT
+        )
+        try:
+            expired = []
+            client.on("session_expired", lambda *a: expired.append(a))
+            old = client.session_id
+            reborn = asyncio.ensure_future(
+                client.wait_for("session_reborn", timeout=10)
+            )
+            await server.expire_session(old)
+            (new_sid,) = await reborn
+            assert new_sid == client.session_id != old
+            assert client.connected and not client.closed
+            assert client.rebirths == 1
+            assert expired == []  # the terminal event never fired
+            # the fresh session is fully usable
+            await client.create("/reborn-proof", b"", CreateFlag.EPHEMERAL)
+            st = await client.stat("/reborn-proof")
+            assert st.ephemeral_owner == new_sid
+        finally:
+            await client.close()
+            await server.stop()
+
+    async def test_watch_listeners_survive_a_rebirth(self):
+        # Watches registered before the expiry must not go silently dead:
+        # the reborn session re-arms them (SetWatches from zxid 0 —
+        # conservative delivery is fine, silence is not).
+        server, client = await _pair(
+            survive_session_expiry=True, reconnect_policy=_FAST_RECONNECT
+        )
+        try:
+            await client.create("/watched-across", b"v1")
+            events = []
+            client.watch("/watched-across", events.append)
+            await client.get("/watched-across", watch=True)
+            reborn = asyncio.ensure_future(
+                client.wait_for("session_reborn", timeout=10)
+            )
+            await server.expire_session(client.session_id)
+            await reborn
+            for _ in range(100):
+                if events:
+                    break
+                await asyncio.sleep(0.02)
+            assert events, "watch went dead across the rebirth"
+        finally:
+            await client.close()
+            await server.stop()
+
+    async def test_rebirth_survives_a_drop_in_the_handshake_tail(
+        self, monkeypatch
+    ):
+        # The fresh-session handshake's TAIL (auth replay, watch re-arm)
+        # can die on the same turbulence that expired the session.  The
+        # rebirth marker must survive the aborted attempt so the retry —
+        # which REATTACHES the already-created fresh session — still
+        # announces session_reborn; consuming it early loses the event
+        # and the agent never re-registers.
+        server, client = await _pair(
+            survive_session_expiry=True, reconnect_policy=_FAST_RECONNECT
+        )
+        try:
+            real_replay = client._replay_auths
+            fail = {"armed": False}
+
+            async def flaky_replay():
+                if fail["armed"]:
+                    fail["armed"] = False
+                    await client._teardown(expected=False)
+                    raise ConnectionError("handshake tail died")
+                await real_replay()
+
+            monkeypatch.setattr(client, "_replay_auths", flaky_replay)
+            reborn = asyncio.ensure_future(
+                client.wait_for("session_reborn", timeout=10)
+            )
+            fail["armed"] = True  # kill the tail of the NEXT connect
+            await server.expire_session(client.session_id)
+            (sid,) = await reborn
+            assert sid == client.session_id != 0
+            assert client.rebirths == 1
+            assert client.connected and not client.closed
+        finally:
+            await client.close()
+            await server.stop()
+
+    async def test_circuit_breaker_falls_back_to_terminal_expiry(self):
+        server, client = await _pair(
+            survive_session_expiry=True,
+            max_session_rebirths=2,
+            reconnect_policy=_FAST_RECONNECT,
+        )
+        try:
+            trips = []
+            client.on("rebirth_breaker_tripped", trips.append)
+            for _ in range(2):
+                reborn = asyncio.ensure_future(
+                    client.wait_for("session_reborn", timeout=10)
+                )
+                await server.expire_session(client.session_id)
+                await reborn
+            assert client.rebirths == 2
+            # The third expiry inside the window exceeds the bound: the
+            # reference-exact terminal path (exit(1) upstairs) applies.
+            expired = asyncio.ensure_future(
+                client.wait_for("session_expired", timeout=10)
+            )
+            await server.expire_session(client.session_id)
+            await expired
+            assert trips == [2]
+            assert client.closed
+            assert client.rebirths == 2  # no third rebirth
+        finally:
+            await client.close()
+            await server.stop()
+
+    def test_max_session_rebirths_validated(self):
+        with pytest.raises(ValueError):
+            ZKClient([("127.0.0.1", 2181)], max_session_rebirths=0)
 
 
 class TestConstructorValidation:

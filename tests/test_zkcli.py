@@ -307,6 +307,98 @@ class TestZkCli:
         assert "cannot connect" in proc.stderr
 
 
+class TestVerify:
+    """``zkcli verify -f config.json`` (ISSUE 3 satellite): the
+    reconciler's read-only diff with the 0/1/2 cron contract."""
+
+    def _config(self, tmp_path, server):
+        cfg = {
+            "registration": {
+                "domain": "cli.test.us",
+                "type": "load_balancer",
+                "service": {
+                    "type": "service",
+                    "service": {"srvce": "_http", "proto": "_tcp",
+                                "port": 80},
+                },
+            },
+            "adminIp": "10.5.5.5",
+            "zookeeper": {
+                "servers": [{"host": server.host, "port": server.port}],
+            },
+        }
+        path = tmp_path / "config.json"
+        path.write_text(json.dumps(cfg))
+        return path
+
+    def _verify(self, cfg_path):
+        return subprocess.run(
+            [sys.executable, "-m", "registrar_tpu.tools.zkcli",
+             "verify", "-f", str(cfg_path), "--hostname", "box0",
+             "--timeout", "5"],
+            cwd=REPO, capture_output=True, text=True, timeout=30,
+            env={**os.environ, "PYTHONPATH": REPO},
+        )
+
+    async def test_in_sync_exits_zero(self, tmp_path):
+        server = await ZKServer().start()
+        client = await _seed(server)
+        try:
+            cfg_path = self._config(tmp_path, server)
+            out = await asyncio.to_thread(self._verify, cfg_path)
+            assert out.returncode == 0, out.stderr
+            assert "in sync" in out.stdout
+        finally:
+            await client.close()
+            await server.stop()
+
+    async def test_drift_exits_one_and_names_reasons(self, tmp_path):
+        server = await ZKServer().start()
+        client = await _seed(server)
+        try:
+            cfg_path = self._config(tmp_path, server)
+            # two drift classes at once: corrupted host payload + a
+            # clobbered service record
+            await server.corrupt_node("/us/test/cli/box0", b'{"evil":1}')
+            await server.corrupt_node("/us/test/cli", b'{"type":"junk"}')
+            out = await asyncio.to_thread(self._verify, cfg_path)
+            assert out.returncode == 1, out.stderr
+            assert "drift: payload  /us/test/cli/box0" in out.stdout
+            assert "drift: staleService  /us/test/cli" in out.stdout
+            assert "payload=1" in out.stderr
+            assert "staleService=1" in out.stderr
+        finally:
+            await client.close()
+            await server.stop()
+
+    async def test_wedged_server_exits_two_not_hang(self, tmp_path):
+        # A server that accepts the handshake but never answers requests
+        # (freeze): the audit must be deadline-bounded and exit 2, not
+        # hang the cron job forever.
+        server = await ZKServer().start()
+        try:
+            cfg_path = self._config(tmp_path, server)
+            server.freeze = True
+            out = await asyncio.to_thread(self._verify, cfg_path)
+            assert out.returncode == 2, (out.stdout, out.stderr)
+        finally:
+            await server.stop()
+
+    async def test_unreachable_exits_two(self, tmp_path):
+        server = await ZKServer().start()
+        cfg_path = self._config(tmp_path, server)
+        await server.stop()
+        out = await asyncio.to_thread(self._verify, cfg_path)
+        assert out.returncode == 2
+        assert "cannot connect" in out.stderr
+
+    async def test_unreadable_config_exits_two(self, tmp_path):
+        out = await asyncio.to_thread(
+            self._verify, tmp_path / "missing.json"
+        )
+        assert out.returncode == 2
+
+
 def _run_repl(server, script, *cli_args):
     """Run zkcli with no subcommand (interactive prompt) feeding ``script``
     lines on stdin — how the docs' debugging transcripts are driven."""
