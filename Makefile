@@ -7,7 +7,7 @@ NAME = registrar
 RELEASE_TARBALL = $(NAME)-release.tar.gz
 RELSTAGEDIR = /tmp/$(NAME)-release
 
-.PHONY: all check check-core test test-jax chaos bench release publish clean
+.PHONY: all check check-core test test-jax chaos bench bench-cached release publish clean
 
 all: check test
 
@@ -29,7 +29,7 @@ check-core:
 	    registrar_tpu.testing.server, registrar_tpu.testing.netem, \
 	    registrar_tpu.config, \
 	    registrar_tpu.tools.zkcli, registrar_tpu.binderview, \
-	    registrar_tpu.metrics"
+	    registrar_tpu.zkcache, registrar_tpu.metrics"
 
 # Hermetic suite: jax-marked tests are deselected via pyproject addopts,
 # because jax backend init can take minutes in some environments.  (In the
@@ -55,6 +55,14 @@ chaos:
 
 bench:
 	$(PYTHON) bench.py
+
+# Cached-resolve slice (ISSUE 4): the zkcache coherence suite, then the
+# cached-latency/QPS/coherence-lag measurement with its in-process >=10x
+# check.  Run by the CI chaos job so the coherence-lag path is exercised
+# on every change, independent of the cross-round gate.
+bench-cached:
+	$(PYTHON) -m pytest tests/test_zkcache.py -x -q
+	$(PYTHON) bench.py --cached-only
 
 # Release tarball rooted at $(PREFIX) (the reference roots its tarball
 # at /opt/smartdc/registrar, Makefile:70-95).  The SMF manifest is

@@ -37,10 +37,16 @@ import time
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
 from registrar_tpu import binderview  # noqa: E402
+from registrar_tpu.records import (  # noqa: E402
+    domain_to_path,
+    host_record,
+    payload_bytes,
+)
 from registrar_tpu.registration import register, unregister  # noqa: E402
 from registrar_tpu.testing.server import ZKServer  # noqa: E402
 from registrar_tpu.zk.client import ZKClient  # noqa: E402
 from registrar_tpu.zk.protocol import CreateFlag  # noqa: E402
+from registrar_tpu.zkcache import ZKCache  # noqa: E402
 
 REGISTRATION = {
     "domain": "bench.emy-10.joyent.us",
@@ -53,6 +59,131 @@ REGISTRATION = {
 }
 
 BASELINE_FLOOR_MS = 1000.0  # reference lib/register.js:232-235 settle delay
+
+FLEET_DOMAIN = "fleet.bench.emy-10.joyent.us"
+FLEET_REG = {
+    "domain": FLEET_DOMAIN,
+    "type": "load_balancer",
+    "service": {
+        "type": "service",
+        "service": {"srvce": "_http", "proto": "_tcp", "port": 80},
+    },
+}
+
+
+async def _register_fleet(client, n: int = 50) -> None:
+    """The biggest realistic Binder answer: a large stateless fleet
+    behind one domain (shared by the live and cached resolve benches)."""
+    for i in range(n):
+        await register(
+            client, FLEET_REG, admin_ip=f"10.1.{i // 256}.{i % 256}",
+            hostname=f"inst{i}", settle_delay=0,
+        )
+
+
+async def _cached_metrics(
+    client, observer, live_a_ms: float, live_srv_ms: float, iters: int = 1000
+) -> dict:
+    """Measure the ISSUE-4 watch-coherent cache over the 50-instance
+    fleet: warm resolve latency (A + SRV), sustained cached QPS, and the
+    write→cache-visible coherence lag.
+
+    Enforces the acceptance bound inline: a warm cached resolve must be
+    ≥10× faster than the live-read path measured in the same run — if
+    the cache ever quietly falls back to RPCs, the run fails loudly
+    rather than letting the gate's tolerance absorb it.
+    """
+    srv_name = f"_http._tcp.{FLEET_DOMAIN}"
+    cache = ZKCache(observer)
+    try:
+        # Cold fills (and the correctness gate on what we time below).
+        res_a = await binderview.resolve(cache, FLEET_DOMAIN, "A")
+        res_srv = await binderview.resolve(cache, srv_name, "SRV")
+        if len(res_a.answers) != 50 or len(res_srv.answers) != 50:
+            raise RuntimeError(
+                "cached resolve did not see all 50 instances "
+                f"(A={len(res_a.answers)} SRV={len(res_srv.answers)})"
+            )
+
+        # Median of bursts, like the concurrency metric (docs/PERF.md
+        # round-4 post-mortem): a single burst of sub-100µs resolves is
+        # scheduler-noise-dominated; the median across bursts tracks the
+        # code.
+        burst = max(iters // 5, 1)
+
+        async def med_burst(name: str, qtype: str) -> float:
+            rates = []
+            for _ in range(5):
+                t0 = time.perf_counter()
+                for _ in range(burst):
+                    res = await binderview.resolve(cache, name, qtype)
+                rates.append((time.perf_counter() - t0) * 1000.0 / burst)
+                if len(res.answers) != 50:
+                    raise RuntimeError("cached resolve lost instances")
+            return sorted(rates)[len(rates) // 2]
+
+        cached_a_ms = await med_burst(FLEET_DOMAIN, "A")
+        cached_srv_ms = await med_burst(srv_name, "SRV")
+        if not cache.authoritative or cache.stats["bypasses"]:
+            raise RuntimeError(
+                "cached bench ran degraded — the timed path was not the "
+                "in-memory hot path"
+            )
+        if cached_a_ms * 10 > live_a_ms or cached_srv_ms * 10 > live_srv_ms:
+            raise RuntimeError(
+                "cached resolve is not >=10x faster than live "
+                f"(A {cached_a_ms:.4f} vs {live_a_ms:.4f} ms, "
+                f"SRV {cached_srv_ms:.4f} vs {live_srv_ms:.4f} ms)"
+            )
+
+        # Sustained throughput, mixed A+SRV (the cached-QPS headline);
+        # median of bursts for the same noise-rejection reason.
+        qps_rounds = []
+        for _ in range(5):
+            t0 = time.perf_counter()
+            for _ in range(burst):
+                await binderview.resolve(cache, FLEET_DOMAIN, "A")
+                await binderview.resolve(cache, srv_name, "SRV")
+            qps_rounds.append((2 * burst) / (time.perf_counter() - t0))
+        qps = sorted(qps_rounds)[len(qps_rounds) // 2]
+
+        # Coherence lag: write an instance record, poll the CACHED view
+        # until the new address is served.  The clock covers the whole
+        # pipeline under test — commit, watch delivery, invalidation,
+        # live refill — i.e. how long a DNS answer can lag the truth.
+        inst_path = f"{domain_to_path(FLEET_DOMAIN)}/inst0"
+        lags = []
+        for rnd in range(11):
+            new_addr = f"10.3.{rnd}.9"
+            payload = payload_bytes(host_record("load_balancer", new_addr))
+            t0 = time.perf_counter()
+            await client.set_data(inst_path, payload)
+            deadline = t0 + 5.0
+            while True:
+                res = await binderview.resolve(cache, FLEET_DOMAIN, "A")
+                if any(a.data == new_addr for a in res.answers):
+                    break
+                if time.perf_counter() > deadline:
+                    raise RuntimeError(
+                        f"cache never converged on write round {rnd} — "
+                        "coherence is broken, not just slow"
+                    )
+                await asyncio.sleep(0)
+            lags.append((time.perf_counter() - t0) * 1000.0)
+        lags.sort()
+        coherence_ms = lags[len(lags) // 2]
+        # restore inst0 for any later consumer of the fleet tree
+        await client.set_data(
+            inst_path, payload_bytes(host_record("load_balancer", "10.1.0.0"))
+        )
+        return {
+            "resolve_a_cached_ms_50_instances": round(cached_a_ms, 4),
+            "resolve_srv_cached_ms_50_instances": round(cached_srv_ms, 4),
+            "cached_resolve_qps_50_instances": round(qps, 1),
+            "cache_coherence_lag_ms": round(coherence_ms, 3),
+        }
+    finally:
+        cache.close()
 
 
 async def _daemon_rss_mb(server) -> "float | None":
@@ -236,28 +367,15 @@ async def _bench() -> dict:
 
         # Resolution over a 50-instance service (the biggest realistic
         # Binder answer: a large stateless fleet behind one domain).
-        fleet_domain = "fleet.bench.emy-10.joyent.us"
-        fleet_reg = {
-            "domain": fleet_domain,
-            "type": "load_balancer",
-            "service": {
-                "type": "service",
-                "service": {"srvce": "_http", "proto": "_tcp", "port": 80},
-            },
-        }
-        for i in range(50):
-            await register(
-                client, fleet_reg, admin_ip=f"10.1.{i // 256}.{i % 256}",
-                hostname=f"inst{i}", settle_delay=0,
-            )
+        await _register_fleet(client)
         t0 = time.perf_counter()
         for _ in range(iters):
-            res_a = await binderview.resolve(observer, fleet_domain, "A")
+            res_a = await binderview.resolve(observer, FLEET_DOMAIN, "A")
         fleet_a_ms = (time.perf_counter() - t0) * 1000.0 / iters
         t0 = time.perf_counter()
         for _ in range(iters):
             res_srv = await binderview.resolve(
-                observer, f"_http._tcp.{fleet_domain}", "SRV"
+                observer, f"_http._tcp.{FLEET_DOMAIN}", "SRV"
             )
         fleet_srv_ms = (time.perf_counter() - t0) * 1000.0 / iters
         if len(res_a.answers) != 50 or len(res_srv.answers) != 50:
@@ -265,6 +383,11 @@ async def _bench() -> dict:
                 "fleet resolve did not see all 50 instances "
                 f"(A={len(res_a.answers)} SRV={len(res_srv.answers)})"
             )
+
+        # Cached resolves + coherence lag (ISSUE 4): the same fleet
+        # served from the watch-coherent in-memory cache.
+        cached = await _cached_metrics(client, observer, fleet_a_ms,
+                                       fleet_srv_ms)
 
         # Watch fan-out: 50 sessions watching one node; time from a
         # write to the last notification arriving.  Median of 5 rounds —
@@ -327,6 +450,53 @@ async def _bench() -> dict:
                 "resolve_srv_ms_50_instances": round(fleet_srv_ms, 3),
                 "watch_fanout_ms_50_watchers": round(fanout_ms, 3),
                 "daemon_rss_mb": daemon_rss_mb,
+                **cached,
+            },
+        }
+    finally:
+        await observer.close()
+        await client.close()
+        await server.stop()
+
+
+async def _bench_cached() -> dict:
+    """``--cached-only``: the cached-resolve + coherence-lag slice.
+
+    The hook behind ``make bench-cached`` (and the CI chaos job): stand
+    up the 50-instance fleet, measure the live path briefly (the 10×
+    comparison base), then run the full cached/coherence measurement.
+    Prints the same one-JSON-line shape; never gated (the full-run
+    metrics are absent by design — the cross-round gate belongs to
+    ``python bench.py``).
+    """
+    server = await ZKServer().start()
+    client = await ZKClient([server.address]).connect()
+    observer = await ZKClient([server.address]).connect()
+    try:
+        await _register_fleet(client)
+        live_iters = 50
+        t0 = time.perf_counter()
+        for _ in range(live_iters):
+            await binderview.resolve(observer, FLEET_DOMAIN, "A")
+        live_a_ms = (time.perf_counter() - t0) * 1000.0 / live_iters
+        t0 = time.perf_counter()
+        for _ in range(live_iters):
+            await binderview.resolve(
+                observer, f"_http._tcp.{FLEET_DOMAIN}", "SRV"
+            )
+        live_srv_ms = (time.perf_counter() - t0) * 1000.0 / live_iters
+        cached = await _cached_metrics(client, observer, live_a_ms,
+                                       live_srv_ms)
+        return {
+            "metric": "resolve_a_cached_ms_50_instances",
+            "value": cached["resolve_a_cached_ms_50_instances"],
+            "unit": "ms",
+            "extra": {
+                "baseline": "live-read path measured in the same run; "
+                "the cached path must be >=10x faster or this run fails",
+                "resolve_a_ms_50_instances": round(live_a_ms, 3),
+                "resolve_srv_ms_50_instances": round(live_srv_ms, 3),
+                **cached,
             },
         }
     finally:
@@ -516,6 +686,9 @@ def main() -> int:
         repin()
         print(f"bench: wrote {BASELINE_PATH} from {HISTORY_PATH}",
               file=sys.stderr)
+        return 0
+    if "--cached-only" in sys.argv[1:]:
+        print(json.dumps(asyncio.run(_bench_cached())))
         return 0
     if "--check-baseline" in sys.argv[1:]:
         problems = check_baseline()

@@ -55,7 +55,9 @@ _EXPORTS = {
     "MetricsRegistry": "registrar_tpu.metrics",
     "MetricsServer": "registrar_tpu.metrics",
     "instrument": "registrar_tpu.metrics",
+    "instrument_cache": "registrar_tpu.metrics",
     "resolve": "registrar_tpu.binderview",
+    "ZKCache": "registrar_tpu.zkcache",
 }
 
 
@@ -84,6 +86,8 @@ __all__ = [
     "MetricsRegistry",
     "MetricsServer",
     "instrument",
+    "instrument_cache",
     "resolve",
+    "ZKCache",
     "__version__",
 ]

@@ -20,11 +20,21 @@ resolves to exactly the answers Binder would serve:
 
 Used by the ``resolve`` subcommand of the zkcli operator tool and by
 tests/test_binderview.py (which pins the README's worked dig examples).
+
+Read source: every function takes any object exposing the two-call read
+surface ``read_node(path)`` / ``get_many(paths)`` — a
+:class:`~registrar_tpu.zk.client.ZKClient` (live reads; the record get
+and children listing ride ONE pipelined flush, so an uncached resolve
+costs two round trips, not three) or a
+:class:`~registrar_tpu.zkcache.ZKCache` (watch-coherent memory; a warm
+resolve touches the server zero times — the ``zkcli resolve --cached``
+/ ``serve-view`` hot path, ISSUE 4).
 """
 
 from __future__ import annotations
 
 from dataclasses import dataclass, field
+from functools import lru_cache
 from typing import Any, Dict, List, Optional
 
 from registrar_tpu.records import (
@@ -32,8 +42,6 @@ from registrar_tpu.records import (
     domain_to_path,
     parse_payload,
 )
-from registrar_tpu.zk.client import ZKClient
-from registrar_tpu.zk.protocol import Err, ZKError
 
 #: Binder's fallback TTL when no record supplies one (typical deploys use
 #: 30 s answers, reference README.md:87-89).
@@ -95,7 +103,17 @@ def _service_ttl(record: Dict[str, Any]) -> int:
     return DEFAULT_TTL
 
 
+@lru_cache(maxsize=8192)
 def _record_from_bytes(data: bytes) -> Optional[Dict[str, Any]]:
+    """Parse a znode payload into a record dict (None when unusable).
+
+    Memoized on the payload bytes: the watch-coherent cache serves the
+    same payload object for every warm resolve, and re-running
+    ``json.loads`` over 50 instance records per DNS answer would
+    dominate the in-memory hot path.  Consumers treat the returned dict
+    as immutable (every reader here only ``.get``s); a changed record
+    arrives as fresh bytes and misses the memo.
+    """
     if not data:
         return None
     try:
@@ -103,16 +121,6 @@ def _record_from_bytes(data: bytes) -> Optional[Dict[str, Any]]:
     except ValueError:
         return None
     return record if isinstance(record, dict) else None
-
-
-async def _get_record(zk: ZKClient, path: str) -> Optional[Dict[str, Any]]:
-    try:
-        data, _ = await zk.get(path)
-    except ZKError as err:
-        if err.code == Err.NO_NODE:
-            return None
-        raise
-    return _record_from_bytes(data)
 
 
 def _queryable_directly(rtype: str) -> bool:
@@ -132,12 +140,11 @@ def _host_address(record: Dict[str, Any]) -> Optional[str]:
     return None
 
 
-async def _service_instances(zk: ZKClient, path: str):
+async def _service_instances(src, path: str, children: List[str]):
     """Fetch the usable child host records of a service node (one
     pipelined getData burst — one write and one reply sweep, not N
-    task-scheduled round-trips)."""
-    children = await zk.get_children(path)
-    replies = await zk.get_many(f"{path}/{child}" for child in children)
+    task-scheduled round-trips; zero round trips from a warm cache)."""
+    replies = await src.get_many(f"{path}/{child}" for child in children)
     records = [
         None if reply is None else _record_from_bytes(reply[0])
         for reply in replies
@@ -155,12 +162,16 @@ async def _service_instances(zk: ZKClient, path: str):
     return instances
 
 
-async def resolve_a(zk: ZKClient, name: str) -> Resolution:
+async def resolve_a(src, name: str) -> Resolution:
     """Answer an A query for ``name`` the way Binder would."""
     name = name.rstrip(".").lower()
     path = domain_to_path(name)
-    record = await _get_record(zk, path)
+    node = await src.read_node(path)
     res = Resolution()
+    if node is None:
+        return res
+    data, _stat, children = node
+    record = _record_from_bytes(data)
     if record is None:
         return res
 
@@ -177,12 +188,12 @@ async def resolve_a(zk: ZKClient, name: str) -> Resolution:
     # Service lookup: one A per usable instance (README.md:522-534); the
     # A TTL is min(service-chain TTL, host-record TTL) (README.md:752-757).
     svc_ttl = _service_ttl(record)
-    for _child, rec, addr in await _service_instances(zk, path):
+    for _child, rec, addr in await _service_instances(src, path, children):
         res.answers.append(Answer(name, "A", min(svc_ttl, _host_ttl(rec)), addr))
     return res
 
 
-async def resolve_srv(zk: ZKClient, name: str) -> Resolution:
+async def resolve_srv(src, name: str) -> Resolution:
     """Answer an SRV query (``_service._proto.domain``) the way Binder would.
 
     Produces one SRV per port per instance plus A additionals for the
@@ -198,7 +209,11 @@ async def resolve_srv(zk: ZKClient, name: str) -> Resolution:
     srvce, proto = labels[0], labels[1]
     domain = ".".join(labels[2:])
     path = domain_to_path(domain)
-    record = await _get_record(zk, path)
+    node = await src.read_node(path)
+    if node is None:
+        return res
+    data, _stat, children = node
+    record = _record_from_bytes(data)
     if record is None or record.get("type") != "service":
         return res
     svc = record.get("service", {})
@@ -210,7 +225,7 @@ async def resolve_srv(zk: ZKClient, name: str) -> Resolution:
 
     svc_ttl = _service_ttl(record)
     default_port = inner.get("port")
-    for child, rec, addr in await _service_instances(zk, path):
+    for child, rec, addr in await _service_instances(src, path, children):
         target = f"{child}.{domain}"
         rec_inner = rec.get(rec.get("type"), {})
         ports = rec_inner.get("ports") if isinstance(rec_inner, dict) else None
@@ -231,11 +246,16 @@ async def resolve_srv(zk: ZKClient, name: str) -> Resolution:
     return res
 
 
-async def resolve(zk: ZKClient, name: str, qtype: str = "A") -> Resolution:
-    """Resolve ``name`` for query type ``qtype`` ("A" or "SRV")."""
+async def resolve(src, name: str, qtype: str = "A") -> Resolution:
+    """Resolve ``name`` for query type ``qtype`` ("A" or "SRV").
+
+    ``src`` is the read source: a connected
+    :class:`~registrar_tpu.zk.client.ZKClient` for live answers, or a
+    :class:`~registrar_tpu.zkcache.ZKCache` for the in-memory hot path.
+    """
     qtype = qtype.upper()
     if qtype == "A":
-        return await resolve_a(zk, name)
+        return await resolve_a(src, name)
     if qtype == "SRV":
-        return await resolve_srv(zk, name)
+        return await resolve_srv(src, name)
     raise ValueError(f"unsupported query type: {qtype}")

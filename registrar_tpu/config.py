@@ -41,8 +41,11 @@ etc/config.coal.json)::
       "maxSessionRebirths": 5,                 # rebirth circuit-breaker bound
                                                #  (per 5-minute window)
       "reconcile": {"intervalSeconds": 60,     # opt-in (ISSUE 3): level-
-                    "repair": false}           #  triggered drift reconciler;
-    }                                          #  NOTE: seconds, not ms
+                    "repair": false},          #  triggered drift reconciler;
+                                               #  NOTE: seconds, not ms
+      "cache": {"maxEntries": 4096}            # resolve-cache tuning for
+    }                                          #  zkcli serve-view (ISSUE 4);
+                                               #  the daemon ignores it
 
 All reference keys are camelCase and all durations are milliseconds; this
 module translates them into the seconds-based snake_case surface of the
@@ -98,6 +101,17 @@ class MetricsConfig:
 
 
 @dataclass
+class CacheConfig:
+    """The ``cache`` block (ISSUE 4): tuning for the watch-coherent
+    resolve cache (:mod:`registrar_tpu.zkcache`).  Consumed by ``zkcli
+    serve-view -f`` (the Binder's-eye watch loop); the daemon itself
+    never resolves, so its behavior is untouched — absent block =
+    feature defaults, reference parity exactly preserved."""
+
+    max_entries: int = 4096
+
+
+@dataclass
 class ReconcileConfig:
     """The ``reconcile`` block: the level-triggered registration
     reconciler (ISSUE 3, :mod:`registrar_tpu.reconcile`).  NOTE the unit
@@ -116,7 +130,7 @@ KNOWN_TOP_LEVEL_KEYS = frozenset(
     {
         "adminIp", "zookeeper", "registration", "healthCheck", "logLevel",
         "maxAttempts", "repairHeartbeatMiss", "metrics",
-        "surviveSessionExpiry", "maxSessionRebirths", "reconcile",
+        "surviveSessionExpiry", "maxSessionRebirths", "reconcile", "cache",
     }
 )
 
@@ -139,6 +153,8 @@ class Config:
     max_session_rebirths: Optional[int] = None
     #: opt-in level-triggered reconciler (ISSUE 3)
     reconcile: Optional[ReconcileConfig] = None
+    #: resolve-cache tuning for zkcli serve-view (ISSUE 4; None = defaults)
+    cache: Optional[CacheConfig] = None
     #: unrecognized top-level keys (ignored, like the reference — but
     #: surfaced so the daemon can warn about probable typos)
     unknown_keys: Tuple[str, ...] = ()
@@ -329,6 +345,22 @@ def parse_config(raw: Mapping[str, Any]) -> Config:
             interval_s=float(interval), repair=rec_repair
         )
 
+    cache = None
+    cache_raw = raw.get("cache")
+    if cache_raw is not None:
+        if not isinstance(cache_raw, Mapping):
+            raise ConfigError("config.cache must be an object")
+        max_entries = cache_raw.get("maxEntries", 4096)
+        if (
+            not isinstance(max_entries, int)
+            or isinstance(max_entries, bool)
+            or max_entries < 1
+        ):
+            raise ConfigError(
+                "config.cache.maxEntries must be a positive integer"
+            )
+        cache = CacheConfig(max_entries=max_entries)
+
     metrics = None
     metrics_raw = raw.get("metrics")
     if metrics_raw is not None:
@@ -359,6 +391,7 @@ def parse_config(raw: Mapping[str, Any]) -> Config:
         survive_session_expiry=survive,
         max_session_rebirths=max_rebirths,
         reconcile=reconcile,
+        cache=cache,
         unknown_keys=tuple(
             sorted(set(raw) - KNOWN_TOP_LEVEL_KEYS)
         ),

@@ -80,6 +80,12 @@ class EventEmitter:
         for registry in (self._listeners, self._once):
             if listener in registry.get(event, []):
                 registry[event].remove(listener)
+            if event in registry and not registry[event]:
+                # drop the empty key: per-path watch listeners come and
+                # go for the process lifetime (zkcache churn), and a
+                # leftover empty list per path ever watched is a slow
+                # leak in the client's _watch_emitter
+                del registry[event]
 
     def listener_count(self, event: str) -> int:
         return len(self._listeners.get(event, [])) + len(self._once.get(event, []))

@@ -34,6 +34,18 @@ Exported metrics (all prefixed ``registrar_``):
     registrar_drift_repaired_total{reason}  reconciler drift converged
     registrar_reconcile_sweeps_total    reconcile sweeps completed
     registrar_reconcile_sweep_seconds   duration of the last reconcile sweep
+
+:func:`instrument_cache` (ISSUE 4) additionally exposes the
+watch-coherent resolve cache (:mod:`registrar_tpu.zkcache`):
+
+    registrar_cache_hits_total / _misses_total / _invalidations_total
+    registrar_cache_bypasses_total      lookups served live while degraded
+    registrar_cache_degraded_total      transitions into degraded mode
+    registrar_cache_evictions_total     maxEntries evictions
+    registrar_cache_entries             entries currently cached (gauge)
+    registrar_cache_authoritative       1 = coherence-guaranteed (gauge)
+    registrar_cache_coherence_lag_seconds[_total|_count]
+                                        write→cache-visible lag
 """
 
 from __future__ import annotations
@@ -110,6 +122,20 @@ class Counter(_Metric):
             raise ValueError("counters only go up")
         key = self._key(labels)
         self._values[key] = self._values.get(key, 0.0) + amount
+
+    #: counters backed by a live total are read at scrape time (the
+    #: cache's hot path bumps a plain int; an event per lookup would put
+    #:  an emitter dispatch inside every DNS answer).  The backing total
+    #: must be monotonic — that is the exporter's contract to keep.
+    fn: Optional[Callable[[], float]] = None
+
+    def set_function(self, fn: Callable[[], float]) -> None:
+        self.fn = fn
+
+    def render(self) -> List[str]:
+        if self.fn is not None:
+            self._values[self._key(None)] = float(self.fn())
+        return super().render()
 
 
 class Gauge(_Metric):
@@ -355,4 +381,72 @@ def instrument(ee, zk, registry: Optional[MetricsRegistry] = None) -> MetricsReg
     ee.on("fail", lambda *_a: transitions.inc(labels={"to": "down"}))
     ee.on("ok", lambda *_a: transitions.inc(labels={"to": "up"}))
     ee.on("error", lambda *_a: errors.inc())
+    return reg
+
+
+def instrument_cache(cache, registry: Optional[MetricsRegistry] = None) -> MetricsRegistry:
+    """Expose a :class:`registrar_tpu.zkcache.ZKCache`'s counters.
+
+    The cache's lookup hot path bumps plain ints in ``cache.stats``;
+    the registry reads them at scrape time (``Counter.set_function``),
+    so instrumentation adds zero cost to a cached DNS answer.  Every
+    series exists from the first scrape (pre-seeded via the same
+    scrape-time read — the backing stats start at 0).
+    """
+    reg = registry if registry is not None else MetricsRegistry()
+    stats = cache.stats
+
+    def from_stat(metric, key: str) -> None:
+        metric.set_function(lambda: stats[key])
+
+    from_stat(reg.counter(
+        "registrar_cache_hits_total",
+        "Resolve-cache lookups served from memory",
+    ), "hits")
+    from_stat(reg.counter(
+        "registrar_cache_misses_total",
+        "Resolve-cache lookups that needed a live ZooKeeper read",
+    ), "misses")
+    from_stat(reg.counter(
+        "registrar_cache_invalidations_total",
+        "Cache entries dropped by a fired one-shot watch",
+    ), "invalidations")
+    from_stat(reg.counter(
+        "registrar_cache_bypasses_total",
+        "Lookups served live because the cache was degraded "
+        "(session down or watch re-arm failed)",
+    ), "bypasses")
+    from_stat(reg.counter(
+        "registrar_cache_degraded_total",
+        "Transitions into degraded (non-authoritative) mode",
+    ), "degraded_total")
+    from_stat(reg.counter(
+        "registrar_cache_evictions_total",
+        "Entries evicted by the maxEntries bound",
+    ), "evictions")
+    reg.counter(
+        "registrar_cache_coherence_lag_seconds_total",
+        "Sum of observed write-to-invalidation-processed lag (the "
+        "window in which a cached answer could still be stale; "
+        "divide by _count for the mean)",
+    ).set_function(lambda: stats["coherence_lag_ms_total"] / 1000.0)
+    from_stat(reg.counter(
+        "registrar_cache_coherence_lag_count",
+        "Number of coherence-lag observations",
+    ), "coherence_lag_count")
+    entries = reg.gauge(
+        "registrar_cache_entries", "Entries currently cached"
+    )
+    entries.set_function(lambda: float(cache.entries))
+    authoritative = reg.gauge(
+        "registrar_cache_authoritative",
+        "1 while cached answers are coherence-guaranteed, 0 in "
+        "degraded (live-read) mode",
+    )
+    authoritative.set_function(lambda: 1.0 if cache.authoritative else 0.0)
+    lag_last = reg.gauge(
+        "registrar_cache_coherence_lag_seconds",
+        "Last observed write-to-invalidation-processed lag (seconds)",
+    )
+    lag_last.set_function(lambda: stats["coherence_lag_ms_last"] / 1000.0)
     return reg

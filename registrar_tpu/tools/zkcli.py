@@ -573,18 +573,194 @@ async def _cmd_verify(args) -> int:
     return 1
 
 
+def _resolution_lines(res) -> List[str]:
+    """Render a Resolution the way `resolve` prints it (shared with the
+    serve-view loop so the two command outputs can never drift)."""
+    lines = [str(ans) for ans in res.answers]
+    if res.additionals:
+        lines.append(";; ADDITIONAL:")
+        lines.extend(str(ans) for ans in res.additionals)
+    return lines
+
+
 async def _cmd_resolve(zk: ZKClient, args) -> int:
-    res = await binderview.resolve(zk, args.name, args.qtype)
+    src = zk
+    cache = None
+    try:
+        if getattr(args, "cached", False):
+            # The watch-coherent memory path (ISSUE 4): first resolve
+            # fills the cache (live reads + one-shot watches), the
+            # printed answer is then served entirely from memory — the
+            # same plumbing the long-running `serve-view` loop keeps
+            # hot.
+            from registrar_tpu.zkcache import ZKCache
+
+            cache = ZKCache(zk)
+            await binderview.resolve(cache, args.name, args.qtype)
+            src = cache
+        res = await binderview.resolve(src, args.name, args.qtype)
+    finally:
+        # close() even when the warm-up resolve raised: at the REPL the
+        # session (and the cache's listeners on it) outlives the failed
+        # command, and a leaked listener set per retry accumulates.
+        if cache is not None:
+            cache.close()
     if res.empty:
         print(f"no answers for {args.name} ({args.qtype})", file=sys.stderr)
         return 1
-    for ans in res.answers:
-        print(ans)
-    if res.additionals:
-        print(";; ADDITIONAL:")
-        for ans in res.additionals:
-            print(ans)
+    for line in _resolution_lines(res):
+        print(line)
     return 0
+
+
+def _infer_qtype(name: str) -> str:
+    labels = name.split(".")
+    if (
+        len(labels) >= 3
+        and labels[0].startswith("_")
+        and labels[1].startswith("_")
+    ):
+        return "SRV"
+    return "A"
+
+
+async def _cmd_serve_view(args) -> int:
+    """Long-running Binder's-eye watch loop over the resolve cache.
+
+    Warms a :class:`registrar_tpu.zkcache.ZKCache` for the given names,
+    prints each answer set, then re-resolves and re-prints whenever a
+    watch invalidation lands — the cache stays hot and coherent exactly
+    the way Binder's own zkplus cache does.  A periodic bunyan status
+    line on stderr (the daemon's jlog shape) carries hit rate, entry
+    count, and authority, so an operator can see a cold or degraded
+    cache at a glance.  ``--duration`` bounds the run (0 = until ^C).
+
+    Connects per ``-f CONFIG``'s own zookeeper/cache blocks when given
+    (like ``verify``), else per ``-s``.
+    """
+    import logging
+
+    from registrar_tpu import jlog
+    from registrar_tpu.retry import RetryPolicy
+    from registrar_tpu.zkcache import DEFAULT_MAX_ENTRIES, ZKCache
+
+    # getattr: at the interactive prompt only `servers` is copied onto
+    # the parsed command; the chroot flag is a one-shot-invocation knob.
+    servers = args.servers
+    chroot = getattr(args, "chroot", None)
+    request_timeout_ms = None
+    max_entries = args.max_entries
+    if args.file:
+        from registrar_tpu.config import ConfigError, load_config
+
+        try:
+            cfg = load_config(args.file)
+        except ConfigError as e:
+            print(f"zkcli: serve-view: {e}", file=sys.stderr)
+            return 2
+        servers = cfg.zookeeper.servers
+        chroot = cfg.zookeeper.chroot
+        request_timeout_ms = cfg.zookeeper.request_timeout_ms
+        if max_entries is None and cfg.cache is not None:
+            max_entries = cfg.cache.max_entries
+    if max_entries is None:
+        max_entries = DEFAULT_MAX_ENTRIES
+
+    zk = ZKClient(
+        servers,
+        chroot=chroot,
+        request_timeout_ms=request_timeout_ms,
+        # Long-running: ride out blips like the daemon does; the cache
+        # degrades to live reads while down and resumes cold after.
+        reconnect_policy=RetryPolicy(
+            max_attempts=float("inf"), initial_delay=0.5, max_delay=15
+        ),
+    )
+    try:
+        await asyncio.wait_for(zk.connect(), timeout=10)
+    except Exception as e:  # noqa: BLE001 - startup probe failure
+        print(f"zkcli: cannot connect to {servers}: {e}", file=sys.stderr)
+        return 1
+
+    status_log = logging.getLogger("registrar_tpu.zkcli.serve_view")
+    handler = logging.StreamHandler(sys.stderr)
+    handler.setFormatter(jlog.BunyanFormatter("zkcli"))
+    status_log.handlers[:] = [handler]
+    status_log.propagate = False
+    status_log.setLevel(logging.INFO)
+
+    cache = ZKCache(zk, max_entries=max_entries)
+    names = [(n.rstrip(".").lower(), _infer_qtype(n)) for n in args.names]
+    shown = {}
+
+    async def refresh(initial: bool = False) -> None:
+        for name, qtype in names:
+            res = await binderview.resolve(cache, name, qtype)
+            lines = _resolution_lines(res)
+            if shown.get(name) == lines and not initial:
+                continue
+            shown[name] = lines
+            print(f";; {name} ({qtype}):")
+            for line in lines or ["; no answers"]:
+                print(line)
+            sys.stdout.flush()
+
+    def emit_status() -> None:
+        status_log.info(
+            "cache status",
+            extra={
+                "zdata": {
+                    "hits": int(cache.stats["hits"]),
+                    "misses": int(cache.stats["misses"]),
+                    "hitRate": round(cache.hit_rate(), 4),
+                    "invalidations": int(cache.stats["invalidations"]),
+                    "entries": cache.entries,
+                    "authoritative": cache.authoritative,
+                    "degradedTotal": int(cache.stats["degraded_total"]),
+                    "coherenceLagMsLast": round(
+                        cache.stats["coherence_lag_ms_last"], 3
+                    ),
+                }
+            },
+        )
+
+    dirty = asyncio.Event()
+    cache.on("invalidated", lambda *_a: dirty.set())
+    cache.on("restored", lambda *_a: dirty.set())
+
+    loop = asyncio.get_running_loop()
+    start = loop.time()
+    next_status = start + args.status_interval
+    try:
+        await refresh(initial=True)
+        emit_status()
+        while True:
+            now = loop.time()
+            if args.duration and now - start >= args.duration:
+                emit_status()
+                return 0
+            wait = next_status - now
+            if args.duration:
+                wait = min(wait, args.duration - (now - start))
+            try:
+                await asyncio.wait_for(dirty.wait(), timeout=max(wait, 0.01))
+            except asyncio.TimeoutError:
+                pass
+            if dirty.is_set():
+                dirty.clear()
+                try:
+                    await refresh()
+                except (ZKError, ConnectionError, OSError) as e:
+                    # Degraded (live-read) refresh against a down
+                    # ensemble: keep the loop alive; the reconnect +
+                    # restored event re-resolves when service returns.
+                    print(f"zkcli: refresh failed: {e}", file=sys.stderr)
+            if loop.time() >= next_status:
+                emit_status()
+                next_status += args.status_interval
+    finally:
+        cache.close()
+        await zk.close()
 
 
 def build_parser() -> argparse.ArgumentParser:
@@ -758,7 +934,43 @@ def _register_commands(sub) -> None:
     p.add_argument("name")
     p.add_argument("-t", "--qtype", default="A", type=str.upper,
                    choices=["A", "SRV"])
+    p.add_argument(
+        "--cached", action="store_true",
+        help="serve the answer from a watch-coherent in-memory cache "
+        "(fills on first touch, then answers without ZooKeeper reads — "
+        "the Binder hot path; see serve-view for the long-running loop)",
+    )
     p.set_defaults(fn=_cmd_resolve)
+
+    p = sub.add_parser(
+        "serve-view",
+        help="long-running Binder's-eye view: warm the watch-coherent "
+        "resolve cache for NAMES, re-print answers as watches "
+        "invalidate, emit periodic bunyan cache-status lines on stderr",
+    )
+    p.add_argument(
+        "names", nargs="+", metavar="NAME",
+        help="domains to serve (a _svc._proto. prefix implies SRV)",
+    )
+    p.add_argument(
+        "--duration", type=float, default=0.0, metavar="SECONDS",
+        help="stop after this many seconds (default: run until ctrl-C)",
+    )
+    p.add_argument(
+        "--status-interval", type=float, default=30.0, metavar="SECONDS",
+        help="seconds between cache-status log lines (default 30)",
+    )
+    p.add_argument(
+        "--max-entries", type=int, default=None,
+        help="cache entry bound (default: config cache.maxEntries, "
+        "else 4096)",
+    )
+    p.add_argument(
+        "-f", "--file", default=None, metavar="CONFIG",
+        help="connect per this registrar config's zookeeper block "
+        "(and honor its cache block) instead of -s",
+    )
+    p.set_defaults(fn=_cmd_serve_view, raw=True)
 
     p = sub.add_parser(
         "setquota", help="set a soft quota on a subtree (zkCli.sh setquota)"
@@ -927,8 +1139,12 @@ async def _repl_loop(zk, args, parser, loop, _read_line, _run_cancellable) -> in
         cmd.repl = True
         try:
             if getattr(cmd, "raw", False):
-                # admin words probe the servers over raw TCP
+                # raw commands build their own connections: hand them the
+                # session's servers AND chroot (serve-view resolving
+                # un-chrooted paths while the sibling `resolve` answers
+                # through the chroot would silently disagree)
                 cmd.servers = args.servers
+                cmd.chroot = getattr(args, "chroot", None)
                 await _run_cancellable(cmd.fn(cmd))
             else:
                 await _run_cancellable(cmd.fn(zk, cmd))

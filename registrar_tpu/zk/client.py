@@ -412,6 +412,11 @@ class ZKClient(EventEmitter):
             )
         except ZKError as err:
             log.warning("re-arming watches failed: %s", err)
+            # Watch-dependent consumers (the zkcache invalidation layer)
+            # must know their coherence signal may now be broken on this
+            # connection: a cache serving entries whose watches never got
+            # re-armed would serve stale answers forever.
+            self.emit("watch_rearm_failed", err)
 
     async def close(self) -> None:
         """Gracefully end the session (ephemerals are dropped server-side)."""
@@ -938,7 +943,7 @@ class ZKClient(EventEmitter):
         return (resp.data or b"", resp.stat)
 
     async def get_many(
-        self, paths: Iterable[str]
+        self, paths: Iterable[str], watch: bool = False
     ) -> List[Optional[Tuple[bytes, Stat]]]:
         """Pipelined getData fan-out: one corked write, one drain, replies
         collected in order.  Returns one entry per path — ``(data, stat)``,
@@ -946,6 +951,11 @@ class ZKClient(EventEmitter):
         answer for a fan-out over a changing tree, e.g. the Binder-view
         resolver reading a service's instances while members churn); any
         other error propagates.
+
+        ``watch=True`` leaves a one-shot data watch on every path that
+        exists (like real getData, NO_NODE leaves nothing behind — the
+        zkcache refill path relies on that asymmetry and negative-caches
+        only through explicit exists-watches).
         """
         paths = list(paths)
         for p in paths:
@@ -953,23 +963,89 @@ class ZKClient(EventEmitter):
         futs, post_err = await self._post_pipeline(
             (
                 OpCode.GET_DATA,
-                proto.GetDataRequest(path=self._abs(p), watch=False),
+                proto.GetDataRequest(path=self._abs(p), watch=watch),
             )
             for p in paths
         )
         results = await self._gather_replies(futs)
         out: List[Optional[Tuple[bytes, Stat]]] = []
-        for res in results:
+        for path, res in zip(paths, results):
             if isinstance(res, ZKError) and res.code == Err.NO_NODE:
                 out.append(None)
                 continue
             if isinstance(res, BaseException):
                 raise res
+            if watch:
+                self._watch_paths["data"].add(path)
             resp = proto.GetDataResponse.read(res)
             out.append((resp.data or b"", resp.stat))
         if post_err is not None:
             raise post_err
         return out
+
+    async def read_node(
+        self, path: str, watch: bool = False
+    ) -> Optional[Tuple[bytes, Stat, List[str]]]:
+        """Read a node's data AND children in one pipelined flush.
+
+        The Binder-view resolver's first two waits — ``get(path)`` then
+        ``get_children(path)`` — ride one FIFO connection anyway, so
+        corking them into a single write/drain saves a full round trip on
+        every resolve (and every zkcache miss).  Returns ``(data, stat,
+        children)``, or None when the node does not exist — including the
+        narrow race where another session deletes it between the two
+        server-side ops (the getData succeeded, the getChildren saw
+        NO_NODE; the armed data watch then fires NODE_DELETED, so a cache
+        holding the None is still invalidated).
+
+        ``watch=True`` arms one-shot data + child watches on success,
+        exactly as ``get(watch=True)`` + ``get_children(watch=True)``
+        would; NO_NODE leaves nothing armed (negative caching is the
+        caller's job, via :meth:`exists` and its exists-watch).
+        """
+        self._check_path(path)
+        abs_path = self._abs(path)
+        futs, post_err = await self._post_pipeline(
+            (
+                (
+                    OpCode.GET_DATA,
+                    proto.GetDataRequest(path=abs_path, watch=watch),
+                ),
+                (
+                    OpCode.GET_CHILDREN2,
+                    proto.GetChildrenRequest(path=abs_path, watch=watch),
+                ),
+            )
+        )
+        results = await self._gather_replies(futs)
+        if post_err is not None or len(results) != 2:
+            # Not connected mid-post: earlier futures (if any) were
+            # gathered above so the read loop owes nothing; surface the
+            # posting error.
+            raise post_err if post_err is not None else ZKError(
+                Err.CONNECTION_LOSS
+            )
+        data_res, child_res = results
+        for res in (data_res, child_res):
+            if isinstance(res, BaseException) and not (
+                isinstance(res, ZKError) and res.code == Err.NO_NODE
+            ):
+                raise res
+        if isinstance(data_res, ZKError) or isinstance(child_res, ZKError):
+            # Absent (or deleted mid-burst).  When the getData half
+            # succeeded, the server DID arm its data watch; record it so
+            # a reconnect's SetWatches re-arm keeps parity with the
+            # server-side state (the pending NODE_DELETED event resolves
+            # both sides).
+            if watch and not isinstance(data_res, ZKError):
+                self._watch_paths["data"].add(path)
+            return None
+        if watch:
+            self._watch_paths["data"].add(path)
+            self._watch_paths["child"].add(path)
+        data = proto.GetDataResponse.read(data_res)
+        children = proto.GetChildren2Response.read(child_res).children
+        return (data.data or b"", data.stat, children)
 
     async def get_children(self, path: str, watch: bool = False) -> List[str]:
         self._check_path(path)
@@ -1028,6 +1104,22 @@ class ZKClient(EventEmitter):
     def watch(self, path: str, listener) -> None:
         """Register a listener for one-shot watch events on ``path``."""
         self._watch_emitter.on(path, listener)
+
+    def unwatch(self, path: str, listener) -> None:
+        """Remove a listener previously registered with :meth:`watch`."""
+        self._watch_emitter.off(path, listener)
+
+    def forget_watches(self, path: str) -> None:
+        """Drop ``path`` from the re-arm bookkeeping (client-side only).
+
+        The server-side one-shot watch, if still armed, fires once more
+        and is then gone; what this prevents is the reconnect-time
+        SetWatches re-arm resurrecting a registration nobody listens to.
+        Used by cache eviction, where the entry is gone and a future
+        event for the path would be a harmless no-op invalidation.
+        """
+        for kind in self._watch_paths.values():
+            kind.discard(path)
 
     # -- transactions / sync (full ZooKeeper 3.4 surface) --------------------
 

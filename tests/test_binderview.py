@@ -429,3 +429,82 @@ class TestResolveEdges:
         finally:
             await client.close()
             await server.stop()
+
+
+class TestCachedParity:
+    """ISSUE 4: `resolve` over a ZKCache answers identically to the
+    live path, cold AND warm, across the README-derived scenarios."""
+
+    async def _setup_tree(self, client):
+        # authcache-style service with two redis_host instances
+        reg = {
+            "domain": "authcache.emy-10.joyent.us",
+            "type": "redis_host",
+            "ttl": 30,
+            "service": {
+                "type": "service",
+                "service": {
+                    "srvce": "_redis", "proto": "_tcp", "port": 6379, "ttl": 60,
+                },
+                "ttl": 60,
+            },
+        }
+        await register(client, reg, admin_ip="172.27.10.62",
+                       hostname="inst-a", settle_delay=0)
+        await _put_host(
+            client, "/us/joyent/emy-10/authcache/inst-b",
+            "redis_host", "172.27.10.67", ttl=30, ports=[6379],
+        )
+        # SRV per-port fanout
+        moray = {
+            "domain": "moray.emy-10.joyent.us",
+            "type": "moray_host",
+            "ports": [2021, 2022],
+            "service": {
+                "type": "service",
+                "service": {"srvce": "_moray", "proto": "_tcp", "port": 2020},
+            },
+        }
+        await register(client, moray, admin_ip="172.27.10.80",
+                       hostname="m0", settle_delay=0)
+        # a non-directly-queryable type and a service-excluded type
+        await _put_host(client, "/us/test/ops/box1", "ops_host", "10.0.0.1")
+        await _put_host(client, "/us/joyent/emy-10/authcache/plain0",
+                        "host", "10.0.0.3")
+
+    async def test_scenarios_match_live_cold_and_warm(self):
+        from registrar_tpu.zkcache import ZKCache
+
+        server, client = await _pair()
+        observer = await ZKClient([server.address]).connect()
+        cache = ZKCache(observer)
+        try:
+            await self._setup_tree(client)
+            cases = [
+                ("authcache.emy-10.joyent.us", "A"),
+                ("inst-a.authcache.emy-10.joyent.us", "A"),
+                ("_redis._tcp.authcache.emy-10.joyent.us", "SRV"),
+                ("_moray._tcp.moray.emy-10.joyent.us", "SRV"),
+                ("moray.emy-10.joyent.us", "A"),
+                ("box1.ops.test.us", "A"),        # resolves as absent
+                ("plain0.authcache.emy-10.joyent.us", "A"),  # direct host
+                ("no.such.name", "A"),            # negative
+                ("_x._tcp.no.such.name", "SRV"),
+            ]
+            for name, qtype in cases:
+                live = await binderview.resolve(client, name, qtype)
+                cold = await binderview.resolve(cache, name, qtype)
+                warm = await binderview.resolve(cache, name, qtype)
+                for which, cached in (("cold", cold), ("warm", warm)):
+                    assert sorted(map(str, cached.answers)) == sorted(
+                        map(str, live.answers)
+                    ), f"{name}/{qtype}: {which} cached answers diverge"
+                    assert sorted(map(str, cached.additionals)) == sorted(
+                        map(str, live.additionals)
+                    ), f"{name}/{qtype}: {which} additionals diverge"
+            assert cache.stats["hits"] > 0
+        finally:
+            cache.close()
+            await observer.close()
+            await client.close()
+            await server.stop()

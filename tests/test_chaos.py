@@ -461,6 +461,37 @@ async def test_chaos_storm_forced_expiry_survived_in_process():
         workers = [_RebornWorker(i, ens.addresses) for i in range(N_WORKERS)]
         for w in workers:
             await w.start()
+        # Binder's-eye cache rider (ISSUE 4): a watch-coherent resolve
+        # cache on its own surviveSessionExpiry client rides the same
+        # storm.  During the storm it resolves continuously (exercising
+        # invalidation, degraded fallback, and rebirth re-arming under
+        # fire); at convergence it must agree EXACTLY with the live
+        # fleet — a cache serving one dead record past convergence is a
+        # DNS outage.
+        from registrar_tpu.zkcache import ZKCache
+
+        cache_client = ZKClient(
+            ens.addresses,
+            timeout_ms=8000,
+            connect_timeout_ms=500,
+            request_timeout_ms=1500,
+            survive_session_expiry=True,
+            max_session_rebirths=10_000,
+            reconnect_policy=FAST_RECONNECT,
+        )
+        await cache_client.connect()
+        cache = ZKCache(cache_client)
+        cache_resolves = {"ok": 0, "failed": 0}
+
+        async def cache_churn(stop: asyncio.Event) -> None:
+            while not stop.is_set():
+                try:
+                    await binderview.resolve(cache, DOMAIN, "A")
+                    cache_resolves["ok"] += 1
+                except (ZKError, ConnectionError, OSError):
+                    cache_resolves["failed"] += 1  # degraded + wire down
+                await asyncio.sleep(0.02)
+
         try:
             stop = asyncio.Event()
             events: list = []
@@ -503,10 +534,13 @@ async def test_chaos_storm_forced_expiry_survived_in_process():
                     await ens.restart(i)
 
             storm = asyncio.create_task(expiry_storm())
+            cache_task = asyncio.create_task(cache_churn(stop))
             await asyncio.sleep(churn_s)
             stop.set()
             await storm
+            await cache_task
             assert any(ev[0] == "expire" for ev in events), events
+            assert cache_resolves["ok"] > 0, "cache never answered in-storm"
 
             # -- convergence: exact §2.6 contract, in-process ------------
             deadline = asyncio.get_running_loop().time() + 30
@@ -556,7 +590,34 @@ async def test_chaos_storm_forced_expiry_survived_in_process():
             assert sorted(a.data for a in res.answers) == sorted(
                 w.admin_ip for w in workers
             )
+
+            # ISSUE 4 acceptance: the CACHED view converges to the same
+            # answer with zero stale records — bounded poll, then exact
+            # equality (the cache client survived every expiry too).
+            expected = sorted(w.admin_ip for w in workers)
+            deadline = asyncio.get_running_loop().time() + 30
+            while True:
+                try:
+                    cres = await binderview.resolve(cache, DOMAIN, "A")
+                    if sorted(a.data for a in cres.answers) == expected:
+                        break
+                except (ZKError, ConnectionError, OSError):
+                    pass
+                assert asyncio.get_running_loop().time() < deadline, (
+                    "cached view never converged after the expiry storm "
+                    f"(last={sorted(a.data for a in cres.answers)!r})"
+                )
+                await asyncio.sleep(0.05)
+            assert not cache_client.closed
+            # warm + authoritative now: the converged answer holds from
+            # memory, and equals the live view read through a worker
+            cres2 = await binderview.resolve(cache, DOMAIN, "A")
+            assert sorted(a.data for a in cres2.answers) == expected
+            assert cache.authoritative
         finally:
+            cache.close()
+            if not cache_client.closed:
+                await cache_client.close()
             for w in workers:
                 await w.stop()
 

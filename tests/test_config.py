@@ -304,3 +304,55 @@ class TestLoad:
             load_config(str(p))
         # parse failure is invalid-config (EX_CONFIG), not unreadable
         assert not isinstance(exc.value, ConfigUnreadableError)
+
+
+class TestCacheBlock:
+    """ISSUE 4: the `cache` block (resolve-cache tuning for zkcli
+    serve-view; absent = defaults, daemon behavior untouched)."""
+
+    def test_absent_block_is_none(self):
+        from registrar_tpu.config import parse_config
+
+        cfg = parse_config({
+            "registration": {"domain": "a.b.c", "type": "host"},
+            "zookeeper": {"servers": [{"host": "h", "port": 2181}]},
+        })
+        assert cfg.cache is None
+
+    def test_parsed_with_defaults_and_override(self):
+        from registrar_tpu.config import parse_config
+
+        base = {
+            "registration": {"domain": "a.b.c", "type": "host"},
+            "zookeeper": {"servers": [{"host": "h", "port": 2181}]},
+        }
+        cfg = parse_config({**base, "cache": {}})
+        assert cfg.cache is not None and cfg.cache.max_entries == 4096
+        cfg = parse_config({**base, "cache": {"maxEntries": 128}})
+        assert cfg.cache.max_entries == 128
+
+    def test_validation_errors(self):
+        import pytest
+
+        from registrar_tpu.config import ConfigError, parse_config
+
+        base = {
+            "registration": {"domain": "a.b.c", "type": "host"},
+            "zookeeper": {"servers": [{"host": "h", "port": 2181}]},
+        }
+        for bad in ([1], {"maxEntries": 0}, {"maxEntries": "big"},
+                    {"maxEntries": True}):
+            with pytest.raises(ConfigError):
+                parse_config({**base, "cache": bad})
+
+    def test_cache_is_a_known_key(self):
+        # a config using the documented key must not trip the
+        # unknown-key typo warning
+        from registrar_tpu.config import parse_config
+
+        cfg = parse_config({
+            "registration": {"domain": "a.b.c", "type": "host"},
+            "zookeeper": {"servers": [{"host": "h", "port": 2181}]},
+            "cache": {"maxEntries": 64},
+        })
+        assert "cache" not in cfg.unknown_keys

@@ -331,3 +331,92 @@ def test_metric_value_defaults_to_zero_for_unsampled_labels():
     assert c.value({"never": "sampled"}) == 0.0
     assert reg.get("zero_test_total") is c
     assert reg.get("no_such_metric") is None
+
+
+class TestCacheInstrumentation:
+    """ISSUE 4: instrument_cache exposes the resolve cache's stats at
+    scrape time, pre-seeded (every series present before any traffic)."""
+
+    def _cache_like(self):
+        # duck-typed stand-in: instrument_cache only touches stats,
+        # .entries, and .authoritative
+        class FakeCache:
+            stats = {
+                "hits": 0, "misses": 0, "invalidations": 0,
+                "bypasses": 0, "degraded_total": 0, "evictions": 0,
+                "coherence_lag_ms_last": 0.0,
+                "coherence_lag_ms_total": 0.0, "coherence_lag_count": 0,
+            }
+            entries = 0
+            authoritative = True
+
+        return FakeCache()
+
+    def test_pre_seeded_series_render_at_zero(self):
+        from registrar_tpu.metrics import MetricsRegistry, instrument_cache
+
+        cache = self._cache_like()
+        reg = instrument_cache(cache, MetricsRegistry())
+        text = reg.render()
+        for series in (
+            "registrar_cache_hits_total 0",
+            "registrar_cache_misses_total 0",
+            "registrar_cache_invalidations_total 0",
+            "registrar_cache_bypasses_total 0",
+            "registrar_cache_degraded_total 0",
+            "registrar_cache_evictions_total 0",
+            "registrar_cache_coherence_lag_seconds_total 0",
+            "registrar_cache_coherence_lag_count 0",
+            "registrar_cache_entries 0",
+            "registrar_cache_authoritative 1",
+            "registrar_cache_coherence_lag_seconds 0",
+        ):
+            assert f"\n{series}\n" in f"\n{text}", f"missing: {series}"
+
+    def test_scrape_reads_live_stats(self):
+        from registrar_tpu.metrics import MetricsRegistry, instrument_cache
+
+        cache = self._cache_like()
+        reg = instrument_cache(cache, MetricsRegistry())
+        cache.stats["hits"] = 41
+        cache.stats["misses"] = 7
+        cache.stats["coherence_lag_ms_total"] = 1500.0
+        cache.stats["coherence_lag_ms_last"] = 250.0
+        cache.entries = 3
+        cache.authoritative = False
+        text = reg.render()
+        assert "registrar_cache_hits_total 41" in text
+        assert "registrar_cache_misses_total 7" in text
+        assert "registrar_cache_coherence_lag_seconds_total 1.5" in text
+        assert "registrar_cache_coherence_lag_seconds 0.25" in text
+        assert "registrar_cache_entries 3" in text
+        assert "registrar_cache_authoritative 0" in text
+
+    async def test_real_cache_round_trip(self):
+        """End to end: a real ZKCache, real resolves, scraped counters."""
+        from registrar_tpu import binderview
+        from registrar_tpu.metrics import MetricsRegistry, instrument_cache
+        from registrar_tpu.registration import register
+        from registrar_tpu.testing.server import ZKServer
+        from registrar_tpu.zk.client import ZKClient
+        from registrar_tpu.zkcache import ZKCache
+
+        server = await ZKServer().start()
+        client = await ZKClient([server.address]).connect()
+        try:
+            await register(
+                client, {"domain": "m.test.us", "type": "host"},
+                admin_ip="10.0.0.1", hostname="h0", settle_delay=0,
+            )
+            cache = ZKCache(client)
+            reg = instrument_cache(cache, MetricsRegistry())
+            await binderview.resolve(cache, "h0.m.test.us", "A")
+            await binderview.resolve(cache, "h0.m.test.us", "A")
+            text = reg.render()
+            assert "registrar_cache_hits_total 1" in text
+            assert "registrar_cache_misses_total 1" in text
+            assert "registrar_cache_authoritative 1" in text
+            cache.close()
+        finally:
+            await client.close()
+            await server.stop()

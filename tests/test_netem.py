@@ -492,3 +492,86 @@ class TestRegistrationRetryLayer:
             assert not is_transient(ZKError(Err.SESSION_EXPIRED))
         finally:
             await _shutdown(server, proxy, client)
+
+
+class TestCacheThroughToxics:
+    """ISSUE 4: the watch-coherent resolve cache behind a toxic wire.
+
+    Coherence rides on watch delivery; a lossy/slow wire may *delay*
+    convergence but must never let the cache settle on a stale answer —
+    and a wire cut must degrade the cache rather than freeze it."""
+
+    async def test_convergence_through_latency_and_slices(self):
+        from registrar_tpu.zkcache import ZKCache
+
+        server, proxy, client = await _proxied_pair()
+        writer = await ZKClient([server.address]).connect()  # clean path
+        cache = ZKCache(client)
+        try:
+            await register(
+                writer, REG, admin_ip="10.1.1.1",
+                hostname="netemhost", settle_delay=0,
+            )
+            res = await binderview.resolve(cache, DOMAIN, "A")
+            assert [a.data for a in res.answers] == ["10.1.1.1"]
+            # watch events now have to cross a delayed, sliced wire
+            proxy.add(Latency(latency_ms=30, jitter_ms=10), direction=DOWN)
+            proxy.add(Slicer(max_size=7), direction=DOWN)
+            await register(
+                writer, REG, admin_ip="10.1.1.2",
+                hostname="late", settle_delay=0,
+            )
+            deadline = asyncio.get_running_loop().time() + 10
+            while True:
+                res = await binderview.resolve(cache, DOMAIN, "A")
+                if sorted(a.data for a in res.answers) == [
+                    "10.1.1.1", "10.1.1.2",
+                ]:
+                    break
+                assert asyncio.get_running_loop().time() < deadline, (
+                    "cache never converged through the toxic wire"
+                )
+                await asyncio.sleep(0.02)
+            assert cache.authoritative
+        finally:
+            cache.close()
+            await writer.close()
+            await _shutdown(server, proxy, client)
+
+    async def test_wire_cut_degrades_then_cold_coherent_recovery(self):
+        from registrar_tpu.zkcache import ZKCache
+
+        server, proxy, client = await _proxied_pair(request_timeout_ms=500)
+        writer = await ZKClient([server.address]).connect()
+        cache = ZKCache(client)
+        try:
+            await register(
+                writer, REG, admin_ip="10.1.1.1",
+                hostname="netemhost", settle_delay=0,
+            )
+            await binderview.resolve(cache, DOMAIN, "A")
+            from registrar_tpu.records import host_record, payload_bytes
+
+            degraded = asyncio.Event()
+            cache.on("degraded", lambda _r: degraded.set())
+            proxy.drop_connections()  # sever every proxied connection
+            await asyncio.wait_for(degraded.wait(), timeout=10)
+            # a write lands while the cache is dark
+            await writer.set_data(
+                f"{PATH}/netemhost",
+                payload_bytes(host_record("load_balancer", "10.1.1.9")),
+            )
+            deadline = asyncio.get_running_loop().time() + 10
+            while True:
+                if cache.authoritative:
+                    res = await binderview.resolve(cache, DOMAIN, "A")
+                    if [a.data for a in res.answers] == ["10.1.1.9"]:
+                        break
+                assert asyncio.get_running_loop().time() < deadline, (
+                    "cache never recovered coherently after the cut"
+                )
+                await asyncio.sleep(0.05)
+        finally:
+            cache.close()
+            await writer.close()
+            await _shutdown(server, proxy, client)

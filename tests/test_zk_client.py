@@ -846,3 +846,156 @@ class TestWatches:
         finally:
             await client.close()
             await server.stop()
+
+
+class TestReadNode:
+    """The pipelined data+children helper (ISSUE 4 satellite): one
+    corked flush instead of sequential get + get_children waits."""
+
+    async def test_reads_data_and_children_in_one_flush(self):
+        server, client = await _pair()
+        try:
+            await client.mkdirp("/svc")
+            await client.put("/svc", b'{"type":"service"}')
+            await client.create("/svc/a", b"A")
+            await client.create("/svc/b", b"B")
+            drains = {"n": 0}
+            orig_drain = client._writer.drain
+
+            async def counting_drain():
+                drains["n"] += 1
+                return await orig_drain()
+
+            client._writer.drain = counting_drain
+            node = await client.read_node("/svc")
+            assert drains["n"] == 1, "read_node paid more than one flush"
+            data, stat, children = node
+            assert data == b'{"type":"service"}'
+            assert stat.num_children == 2
+            assert sorted(children) == ["a", "b"]
+        finally:
+            await client.close()
+            await server.stop()
+
+    async def test_absent_node_returns_none(self):
+        server, client = await _pair()
+        try:
+            assert await client.read_node("/nope") is None
+        finally:
+            await client.close()
+            await server.stop()
+
+    async def test_watch_arms_data_and_child_watches(self):
+        server, client = await _pair()
+        try:
+            await client.mkdirp("/w")
+            events = []
+            client.watch("/w", events.append)
+            await client.read_node("/w", watch=True)
+            assert "/w" in client._watch_paths["data"]
+            assert "/w" in client._watch_paths["child"]
+            await client.set_data("/w", b"x")
+            await client.create("/w/kid", b"")
+            deadline = asyncio.get_running_loop().time() + 5
+            while len(events) < 2:
+                assert asyncio.get_running_loop().time() < deadline
+                await asyncio.sleep(0.01)
+            kinds = sorted(ev.type for ev in events)
+            assert kinds == [
+                proto.EventType.NODE_DATA_CHANGED,
+                proto.EventType.NODE_CHILDREN_CHANGED,
+            ]
+        finally:
+            await client.close()
+            await server.stop()
+
+    async def test_no_node_with_watch_leaves_no_bookkeeping(self):
+        server, client = await _pair()
+        try:
+            assert await client.read_node("/ghost", watch=True) is None
+            assert "/ghost" not in client._watch_paths["data"]
+            assert "/ghost" not in client._watch_paths["child"]
+            assert "/ghost" not in client._watch_paths["exist"]
+        finally:
+            await client.close()
+            await server.stop()
+
+    async def test_get_many_watch_arms_only_existing(self):
+        server, client = await _pair()
+        try:
+            await client.mkdirp("/gm")
+            await client.create("/gm/a", b"A")
+            out = await client.get_many(["/gm/a", "/gm/ghost"], watch=True)
+            assert out[0][0] == b"A" and out[1] is None
+            assert "/gm/a" in client._watch_paths["data"]
+            assert "/gm/ghost" not in client._watch_paths["data"]
+            fired = asyncio.Event()
+            client.watch("/gm/a", lambda ev: fired.set())
+            await client.set_data("/gm/a", b"A2")
+            await asyncio.wait_for(fired.wait(), timeout=5)
+        finally:
+            await client.close()
+            await server.stop()
+
+    async def test_forget_watches_drops_rearm_bookkeeping(self):
+        server, client = await _pair()
+        try:
+            await client.mkdirp("/f")
+            await client.read_node("/f", watch=True)
+            client.forget_watches("/f")
+            for kind in client._watch_paths.values():
+                assert "/f" not in kind
+        finally:
+            await client.close()
+            await server.stop()
+
+    async def test_chrooted_read_node(self):
+        server = await ZKServer().start()
+        setup = await ZKClient([server.address]).connect()
+        try:
+            await setup.mkdirp("/app/svc")
+            await setup.put("/app/svc", b"payload")
+            await setup.create("/app/svc/kid", b"")
+            client = await ZKClient(
+                [server.address], chroot="/app"
+            ).connect()
+            try:
+                data, _stat, children = await client.read_node("/svc")
+                assert data == b"payload"
+                assert children == ["kid"]
+            finally:
+                await client.close()
+        finally:
+            await setup.close()
+            await server.stop()
+
+
+class TestWatchRearmFailure:
+    async def test_rearm_failure_emits_event(self):
+        """A failed SetWatches re-arm must be observable: the zkcache
+        degrades on it rather than serving entries whose coherence
+        signal silently died."""
+        server, client = await _pair(
+            reconnect_policy=RetryPolicy(
+                max_attempts=float("inf"), initial_delay=0.02, max_delay=0.2
+            )
+        )
+        try:
+            await client.mkdirp("/r")
+            await client.get("/r", watch=True)
+            failed = asyncio.Event()
+            client.on("watch_rearm_failed", lambda err: failed.set())
+            orig = client._submit
+
+            async def failing_submit(xid, op, body):
+                if op == OpCode.SET_WATCHES:
+                    raise ZKError(Err.CONNECTION_LOSS)
+                return await orig(xid, op, body)
+
+            client._submit = failing_submit
+            await server.drop_connections()
+            await asyncio.wait_for(failed.wait(), timeout=10)
+        finally:
+            client._submit = orig
+            await client.close()
+            await server.stop()

@@ -1,0 +1,490 @@
+"""Watch-coherent resolve cache (ISSUE 4): the zkcache unit/coherence suite.
+
+The contract under test (registrar_tpu/zkcache.py, docs/DESIGN.md):
+
+  * a warm resolve is served entirely from memory — zero requests on
+    the wire — and answers byte-identically to the live path;
+  * every kind of change (data write, instance add/remove, node delete,
+    node re-creation after a negative answer) invalidates the affected
+    entries via the one-shot watches armed with each fill, and the next
+    resolve reconverges;
+  * an invalidation that races an in-flight refill can never be
+    overwritten by the stale in-flight answer (generation counters);
+  * a session drop / terminal expiry / failed watch re-arm degrades the
+    cache to live reads; a reconnect resumes cold but authoritative;
+  * concurrent misses for one path share a single in-flight fill (no
+    cold-start stampede), and negative entries answer absent domains
+    from memory (no absent-domain stampede);
+  * the maxEntries bound evicts without breaking correctness.
+"""
+
+import asyncio
+
+from registrar_tpu import binderview
+from registrar_tpu.records import domain_to_path, host_record, payload_bytes
+from registrar_tpu.registration import register, unregister
+from registrar_tpu.retry import RetryPolicy
+from registrar_tpu.testing.server import ZKServer
+from registrar_tpu.zk.client import ZKClient
+from registrar_tpu.zk.protocol import EventType
+from registrar_tpu.zkcache import ZKCache
+
+DOMAIN = "cache.test.us"
+PATH = domain_to_path(DOMAIN)  # /us/test/cache
+
+FAST_RECONNECT = RetryPolicy(
+    max_attempts=float("inf"), initial_delay=0.02, max_delay=0.25
+)
+
+
+def _reg():
+    return {
+        "domain": DOMAIN,
+        "type": "load_balancer",
+        "service": {
+            "type": "service",
+            "service": {"srvce": "_http", "proto": "_tcp", "port": 80},
+        },
+    }
+
+
+async def _stack(n_instances=2):
+    """Server + writer client (owns the registrations) + cache client."""
+    server = await ZKServer().start()
+    writer = await ZKClient([server.address]).connect()
+    reader = await ZKClient(
+        [server.address], reconnect_policy=FAST_RECONNECT
+    ).connect()
+    for i in range(n_instances):
+        await register(
+            writer, _reg(), admin_ip=f"10.7.0.{i}", hostname=f"inst{i}",
+            settle_delay=0,
+        )
+    return server, writer, reader
+
+
+def _count_posts(zk):
+    """Count requests the client puts on the wire (pings excluded — the
+    ping loop writes frames directly, not through _post)."""
+    counter = {"n": 0}
+    orig = zk._post
+
+    def wrapper(xid, op, body):
+        counter["n"] += 1
+        return orig(xid, op, body)
+
+    zk._post = wrapper
+    return counter
+
+
+async def _converge(check, timeout=5.0, interval=0.01):
+    deadline = asyncio.get_running_loop().time() + timeout
+    while True:
+        if await check():
+            return
+        assert asyncio.get_running_loop().time() < deadline, (
+            "cache never converged within the coherence bound"
+        )
+        await asyncio.sleep(interval)
+
+
+class TestServedFromMemory:
+    async def test_warm_resolve_is_zero_rpcs_and_identical_to_live(self):
+        server, writer, reader = await _stack()
+        cache = ZKCache(reader)
+        try:
+            live = await binderview.resolve(writer, DOMAIN, "A")
+            cold = await binderview.resolve(cache, DOMAIN, "A")
+            posts = _count_posts(reader)
+            for _ in range(25):
+                warm = await binderview.resolve(cache, DOMAIN, "A")
+                warm_srv = await binderview.resolve(
+                    cache, f"_http._tcp.{DOMAIN}", "SRV"
+                )
+            assert posts["n"] == 0, (
+                f"warm resolves touched the wire ({posts['n']} requests) — "
+                "the A fill already covers the SRV query's entries"
+            )
+            assert sorted(map(str, warm.answers)) == sorted(
+                map(str, live.answers)
+            )
+            assert sorted(map(str, cold.answers)) == sorted(
+                map(str, live.answers)
+            )
+            assert len(warm_srv.answers) == 2
+            assert cache.hit_rate() > 0.9
+        finally:
+            cache.close()
+            await reader.close()
+            await writer.close()
+            await server.stop()
+
+    async def test_first_srv_resolve_reuses_a_fill(self):
+        # A and SRV queries for one domain share the node + instance
+        # entries: after an A warm-up the first SRV resolve is also
+        # wire-free.
+        server, writer, reader = await _stack()
+        cache = ZKCache(reader)
+        try:
+            await binderview.resolve(cache, DOMAIN, "A")
+            posts = _count_posts(reader)
+            res = await binderview.resolve(cache, f"_http._tcp.{DOMAIN}", "SRV")
+            assert posts["n"] == 0
+            assert len(res.answers) == 2
+        finally:
+            cache.close()
+            await reader.close()
+            await writer.close()
+            await server.stop()
+
+
+class TestInvalidation:
+    async def test_data_write_reconverges(self):
+        server, writer, reader = await _stack()
+        cache = ZKCache(reader)
+        try:
+            await binderview.resolve(cache, DOMAIN, "A")
+            await writer.set_data(
+                f"{PATH}/inst0",
+                payload_bytes(host_record("load_balancer", "10.9.9.9")),
+            )
+
+            async def updated():
+                res = await binderview.resolve(cache, DOMAIN, "A")
+                return "10.9.9.9" in [a.data for a in res.answers]
+
+            await _converge(updated)
+            assert cache.stats["invalidations"] >= 1
+            # the refill after an invalidation records a coherence-lag
+            # observation off the node's mtime
+            assert cache.stats["coherence_lag_count"] >= 1
+        finally:
+            cache.close()
+            await reader.close()
+            await writer.close()
+            await server.stop()
+
+    async def test_instance_join_and_leave_reconverge(self):
+        server, writer, reader = await _stack()
+        cache = ZKCache(reader)
+        joiner = await ZKClient([server.address]).connect()
+        try:
+            await binderview.resolve(cache, DOMAIN, "A")
+            nodes = await register(
+                joiner, _reg(), admin_ip="10.7.0.9", hostname="late",
+                settle_delay=0,
+            )
+
+            async def joined():
+                res = await binderview.resolve(cache, DOMAIN, "A")
+                return "10.7.0.9" in [a.data for a in res.answers]
+
+            await _converge(joined)
+
+            # an unregistered (deleted) record must never be served past
+            # the coherence bound — the DNS-outage case the ISSUE pins
+            await unregister(joiner, [n for n in nodes if n != PATH])
+
+            async def left():
+                res = await binderview.resolve(cache, DOMAIN, "A")
+                return "10.7.0.9" not in [a.data for a in res.answers]
+
+            await _converge(left)
+            # and at convergence the cached answer equals the live one
+            live = await binderview.resolve(writer, DOMAIN, "A")
+            cached = await binderview.resolve(cache, DOMAIN, "A")
+            assert sorted(a.data for a in cached.answers) == sorted(
+                a.data for a in live.answers
+            )
+        finally:
+            cache.close()
+            await joiner.close()
+            await reader.close()
+            await writer.close()
+            await server.stop()
+
+    async def test_session_death_of_instance_leaves_no_stale_answer(self):
+        # The ephemeral sweep on session close is the production "host
+        # died" path: its record must leave the cached view too.
+        server, writer, reader = await _stack(n_instances=1)
+        dying = await ZKClient([server.address]).connect()
+        cache = ZKCache(reader)
+        try:
+            await register(
+                dying, _reg(), admin_ip="10.7.0.8", hostname="doomed",
+                settle_delay=0,
+            )
+            res = await binderview.resolve(cache, DOMAIN, "A")
+            assert "10.7.0.8" in [a.data for a in res.answers]
+            await dying.close()
+
+            async def gone():
+                res = await binderview.resolve(cache, DOMAIN, "A")
+                return [a.data for a in res.answers] == ["10.7.0.0"]
+
+            await _converge(gone)
+        finally:
+            cache.close()
+            await reader.close()
+            await writer.close()
+            await server.stop()
+
+
+class TestNegativeCaching:
+    async def test_absent_domain_served_from_memory(self):
+        server, writer, reader = await _stack()
+        cache = ZKCache(reader)
+        try:
+            res = await binderview.resolve(cache, "ghost.test.us", "A")
+            assert res.empty
+            posts = _count_posts(reader)
+            for _ in range(20):
+                res = await binderview.resolve(cache, "ghost.test.us", "A")
+            assert posts["n"] == 0, "absent domain stampeded the server"
+            assert res.empty
+        finally:
+            cache.close()
+            await reader.close()
+            await writer.close()
+            await server.stop()
+
+    async def test_creation_invalidates_negative_entry(self):
+        server, writer, reader = await _stack(n_instances=0)
+        cache = ZKCache(reader)
+        try:
+            res = await binderview.resolve(cache, DOMAIN, "A")
+            assert res.empty  # negative-cached, exists-watch armed
+            await register(
+                writer, _reg(), admin_ip="10.7.1.1", hostname="born",
+                settle_delay=0,
+            )
+
+            async def visible():
+                res = await binderview.resolve(cache, DOMAIN, "A")
+                return [a.data for a in res.answers] == ["10.7.1.1"]
+
+            await _converge(visible)
+        finally:
+            cache.close()
+            await reader.close()
+            await writer.close()
+            await server.stop()
+
+
+class TestSingleFlight:
+    async def test_concurrent_cold_misses_share_one_fill(self):
+        server, writer, reader = await _stack()
+        cache = ZKCache(reader)
+        try:
+            posts = _count_posts(reader)
+            results = await asyncio.gather(
+                *(cache.read_node(PATH) for _ in range(25))
+            )
+            # one fill = one read_node burst (GET_DATA + GET_CHILDREN2)
+            assert posts["n"] == 2, (
+                f"{posts['n']} wire requests for 25 concurrent misses"
+            )
+            assert all(r is not None for r in results)
+            assert cache.stats["fills"] == 1
+        finally:
+            cache.close()
+            await reader.close()
+            await writer.close()
+            await server.stop()
+
+
+class TestGenerationCounters:
+    async def test_invalidation_racing_refill_never_resurrects_stale(self):
+        server, writer, reader = await _stack(n_instances=1)
+        cache = ZKCache(reader)
+        try:
+            # Hold the refill's reply window open deterministically: the
+            # loader gets its (still-current) answer, then an
+            # invalidation for the path lands BEFORE the loader stores.
+            release = asyncio.Event()
+            orig = reader.read_node
+
+            async def slow_read_node(path, watch=False):
+                result = await orig(path, watch=watch)
+                await release.wait()
+                return result
+
+            reader.read_node = slow_read_node
+            fill = asyncio.create_task(cache.read_node(PATH))
+            await asyncio.sleep(0.05)  # loader is parked on release
+            # the racing invalidation (as the watch dispatch would do)
+            cache._on_event(
+                type(
+                    "Ev", (), {"path": PATH,
+                               "type": EventType.NODE_DATA_CHANGED},
+                )()
+            )
+            release.set()
+            result = await fill
+            assert result is not None  # the read itself was valid...
+            # ...but the store was discarded: nothing cached for PATH
+            assert PATH not in cache._entries, (
+                "stale in-flight refill was resurrected over an "
+                "invalidation"
+            )
+        finally:
+            reader.read_node = orig
+            cache.close()
+            await reader.close()
+            await writer.close()
+            await server.stop()
+
+
+class TestDegradedMode:
+    async def test_disconnect_degrades_then_cold_authoritative_restart(self):
+        server, writer, reader = await _stack()
+        cache = ZKCache(reader)
+        try:
+            await binderview.resolve(cache, DOMAIN, "A")
+            assert cache.authoritative and cache.entries > 0
+            degraded = asyncio.Event()
+            entries_while_degraded = []
+            def on_degraded(_reason):
+                entries_while_degraded.append(cache.entries)
+                degraded.set()
+            cache.on("degraded", on_degraded)
+            await server.drop_connections()
+            # the FAST_RECONNECT policy may restore authority within
+            # milliseconds; the degrade transition itself is the event
+            await asyncio.wait_for(degraded.wait(), timeout=5)
+            assert entries_while_degraded == [0], (
+                "degraded cache kept entries"
+            )
+            assert cache.stats["degraded_total"] == 1
+
+            async def restored():
+                return cache.authoritative
+
+            await _converge(restored)
+            assert cache.entries == 0  # cold start
+            res = await binderview.resolve(cache, DOMAIN, "A")
+            assert len(res.answers) == 2
+            res = await binderview.resolve(cache, DOMAIN, "A")
+            assert cache.stats["hits"] > 0
+        finally:
+            cache.close()
+            await reader.close()
+            await writer.close()
+            await server.stop()
+
+    async def test_degraded_lookups_are_live_reads(self):
+        server, writer, reader = await _stack()
+        cache = ZKCache(reader)
+        try:
+            await binderview.resolve(cache, DOMAIN, "A")
+            # force degraded without dropping the transport, so live
+            # reads still work underneath
+            reader.emit("watch_rearm_failed", RuntimeError("boom"))
+            assert not cache.authoritative
+            await writer.set_data(
+                f"{PATH}/inst0",
+                payload_bytes(host_record("load_balancer", "10.8.8.8")),
+            )
+            # a degraded cache must see the write IMMEDIATELY (live read,
+            # no invalidation machinery involved)
+            res = await binderview.resolve(cache, DOMAIN, "A")
+            assert "10.8.8.8" in [a.data for a in res.answers]
+            assert cache.stats["bypasses"] > 0
+        finally:
+            cache.close()
+            await reader.close()
+            await writer.close()
+            await server.stop()
+
+    async def test_terminal_expiry_degrades_permanently(self):
+        server, writer, reader = await _stack()
+        cache = ZKCache(reader)
+        try:
+            await binderview.resolve(cache, DOMAIN, "A")
+            await server.expire_session(reader.session_id)
+            await asyncio.sleep(0.1)
+
+            async def degraded():
+                return not cache.authoritative and reader.closed
+
+            await _converge(degraded)
+            assert cache.entries == 0
+        finally:
+            cache.close()
+            await reader.close()
+            await writer.close()
+            await server.stop()
+
+
+class TestRebirthCoherence:
+    async def test_session_rebirth_resumes_coherent(self):
+        """ISSUE 4 satellite: with surviveSessionExpiry on, force-expire
+        the cache's session; the reborn session's re-armed machinery must
+        leave ZERO stale answers — writes made while the cache was dark
+        are visible after rebirth."""
+        server, writer, _ = await _stack()
+        reader = await ZKClient(
+            [server.address],
+            survive_session_expiry=True,
+            reconnect_policy=FAST_RECONNECT,
+        ).connect()
+        cache = ZKCache(reader)
+        try:
+            await binderview.resolve(cache, DOMAIN, "A")
+            reborn = asyncio.Event()
+            reader.on("session_reborn", lambda _sid: reborn.set())
+            await server.expire_session(reader.session_id)
+            # a write the dark cache must NOT miss
+            await writer.set_data(
+                f"{PATH}/inst1",
+                payload_bytes(host_record("load_balancer", "10.6.6.6")),
+            )
+            await asyncio.wait_for(reborn.wait(), timeout=10)
+
+            async def fresh():
+                if not cache.authoritative:
+                    return False
+                res = await binderview.resolve(cache, DOMAIN, "A")
+                return "10.6.6.6" in [a.data for a in res.answers]
+
+            await _converge(fresh)
+            assert not reader.closed
+            # at convergence: cached == live, zero stale
+            live = await binderview.resolve(writer, DOMAIN, "A")
+            cached = await binderview.resolve(cache, DOMAIN, "A")
+            assert sorted(a.data for a in cached.answers) == sorted(
+                a.data for a in live.answers
+            )
+        finally:
+            cache.close()
+            await reader.close()
+            await writer.close()
+            await server.stop()
+
+
+class TestEviction:
+    async def test_max_entries_bound_holds_and_evicted_paths_refill(self):
+        server = await ZKServer().start()
+        writer = await ZKClient([server.address]).connect()
+        reader = await ZKClient([server.address]).connect()
+        cache = ZKCache(reader, max_entries=2)
+        try:
+            for i in range(4):
+                await register(
+                    writer,
+                    {"domain": f"d{i}.ev.us", "type": "host"},
+                    admin_ip=f"10.4.0.{i}", hostname=f"h{i}", settle_delay=0,
+                )
+            for i in range(4):
+                res = await binderview.resolve(cache, f"h{i}.d{i}.ev.us", "A")
+                assert [a.data for a in res.answers] == [f"10.4.0.{i}"]
+            assert cache.entries <= 2
+            assert cache.stats["evictions"] >= 2
+            # an evicted domain still answers correctly (transparent refill)
+            res = await binderview.resolve(cache, "h0.d0.ev.us", "A")
+            assert [a.data for a in res.answers] == ["10.4.0.0"]
+        finally:
+            cache.close()
+            await reader.close()
+            await writer.close()
+            await server.stop()

@@ -663,3 +663,133 @@ class TestZkCliRepl:
             if proc.poll() is None:
                 proc.kill()
             await server.stop()
+
+
+class TestCachedResolve:
+    async def test_resolve_cached_answers_like_live(self):
+        server = await ZKServer().start()
+        client = await _seed(server)
+        try:
+            live = await asyncio.to_thread(
+                _run_cli, server, "resolve", "cli.test.us"
+            )
+            cached = await asyncio.to_thread(
+                _run_cli, server, "resolve", "--cached", "cli.test.us"
+            )
+            assert cached.returncode == 0
+            assert cached.stdout == live.stdout
+            cached_srv = await asyncio.to_thread(
+                _run_cli, server, "resolve", "--cached", "-t", "SRV",
+                "_http._tcp.cli.test.us",
+            )
+            assert cached_srv.returncode == 0
+            assert "0 10 80 box0.cli.test.us." in cached_srv.stdout
+            assert "ADDITIONAL" in cached_srv.stdout
+        finally:
+            await client.close()
+            await server.stop()
+
+    async def test_resolve_cached_absent_name_exits_one(self):
+        server = await ZKServer().start()
+        client = await _seed(server)
+        try:
+            out = await asyncio.to_thread(
+                _run_cli, server, "resolve", "--cached", "ghost.test.us"
+            )
+            assert out.returncode == 1
+            assert "no answers" in out.stderr
+        finally:
+            await client.close()
+            await server.stop()
+
+
+class TestServeView:
+    async def test_serve_view_prints_answers_and_status_line(self):
+        server = await ZKServer().start()
+        client = await _seed(server)
+        try:
+            out = await asyncio.to_thread(
+                _run_cli, server, "serve-view", "cli.test.us",
+                "_http._tcp.cli.test.us",
+                "--duration", "0.6", "--status-interval", "0.2",
+            )
+            assert out.returncode == 0, out.stderr
+            assert ";; cli.test.us (A):" in out.stdout
+            assert "10.5.5.5" in out.stdout
+            # SRV qtype inferred from the _svc._proto prefix
+            assert ";; _http._tcp.cli.test.us (SRV):" in out.stdout
+            assert "0 10 80 box0.cli.test.us." in out.stdout
+            # bunyan status line on stderr: parseable JSON with the
+            # operator-facing cache fields
+            status_lines = [
+                json.loads(line)
+                for line in out.stderr.splitlines()
+                if line.startswith("{")
+            ]
+            assert status_lines, out.stderr
+            last = status_lines[-1]
+            assert last["msg"] == "cache status"
+            assert last["name"] == "zkcli"
+            assert last["authoritative"] is True
+            assert last["hits"] >= 0 and last["misses"] > 0
+            assert 0.0 <= last["hitRate"] <= 1.0
+        finally:
+            await client.close()
+            await server.stop()
+
+    async def test_serve_view_reprints_on_change(self):
+        # A change made while serve-view runs must appear in its output
+        # (the invalidation -> re-resolve -> re-print loop).
+        server = await ZKServer().start()
+        client = await _seed(server)
+        try:
+            task = asyncio.create_task(asyncio.to_thread(
+                _run_cli, server, "serve-view", "cli.test.us",
+                "--duration", "2.5", "--status-interval", "5",
+            ))
+            await asyncio.sleep(0.8)  # let it warm up
+            reg = {
+                "domain": "cli.test.us",
+                "type": "load_balancer",
+                "service": {
+                    "type": "service",
+                    "service": {"srvce": "_http", "proto": "_tcp", "port": 80},
+                },
+            }
+            await register(client, reg, admin_ip="10.5.5.6",
+                           hostname="box1", settle_delay=0)
+            out = await task
+            assert out.returncode == 0, out.stderr
+            assert "10.5.5.6" in out.stdout, (
+                "serve-view never re-printed the updated answer set"
+            )
+        finally:
+            await client.close()
+            await server.stop()
+
+    async def test_serve_view_honors_config_file(self, tmp_path):
+        server = await ZKServer().start()
+        client = await _seed(server)
+        try:
+            cfg = tmp_path / "cfg.json"
+            cfg.write_text(json.dumps({
+                "registration": {"domain": "cli.test.us", "type": "host"},
+                "zookeeper": {
+                    "servers": [{"host": server.host, "port": server.port}],
+                },
+                "cache": {"maxEntries": 16},
+            }))
+            out = await asyncio.to_thread(
+                subprocess.run,
+                [sys.executable, "-m", "registrar_tpu.tools.zkcli",
+                 "-s", "127.0.0.1:1",  # dead: must use the config's servers
+                 "serve-view", "cli.test.us", "-f", str(cfg),
+                 "--duration", "0.4", "--status-interval", "0.2"],
+                **{"cwd": REPO, "capture_output": True, "text": True,
+                   "timeout": 30, "env": {**os.environ, "PYTHONPATH": REPO}},
+            )
+            assert out.returncode == 0, out.stderr
+            assert "10.5.5.5" in out.stdout
+        finally:
+            await client.close()
+            await server.stop()
