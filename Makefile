@@ -7,7 +7,7 @@ NAME = registrar
 RELEASE_TARBALL = $(NAME)-release.tar.gz
 RELSTAGEDIR = /tmp/$(NAME)-release
 
-.PHONY: all check check-core test test-jax chaos bench bench-cached release publish clean
+.PHONY: all check check-core test test-jax chaos restart-e2e bench bench-cached release publish clean
 
 all: check test
 
@@ -52,6 +52,15 @@ test-jax:
 # reproduction; CHAOS_NETEM=0 drops back to server-side faults only.
 chaos:
 	CHAOS_SECONDS=30 $(PYTHON) -m pytest tests/test_netem.py tests/test_chaos.py -x -q
+
+# Zero-downtime restart e2e (ISSUE 5): the real daemon is SIGTERMed and
+# relaunched mid-resolve-loop — handoff mode must show ZERO NO_NODE
+# observations (same ZK session resumed across the process boundary),
+# drain mode a bounded re-registration gap; every degraded statefile
+# shape must land in a clean fresh-session registration.  Wired into
+# the CI chaos job.
+restart-e2e:
+	$(PYTHON) -m pytest tests/test_restart_e2e.py -x -q
 
 bench:
 	$(PYTHON) bench.py

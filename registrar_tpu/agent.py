@@ -62,7 +62,7 @@ from __future__ import annotations
 
 import asyncio
 import logging
-from typing import Any, Mapping, Optional
+from typing import Any, List, Mapping, Optional, Sequence
 
 from registrar_tpu import registration as register_mod
 from registrar_tpu.events import EventEmitter, spawn_owned
@@ -107,6 +107,37 @@ class RegistrarEvents(EventEmitter):
         #: the level-triggered reconciler, when configured (test/metrics
         #: observability; None without the ``reconcile`` config block)
         self.reconciler = None
+        #: bound by _run once the initial registration lands (ISSUE 5):
+        #: the SIGHUP hot-reload entry point (see :meth:`reload`)
+        self._reload_fn = None
+        #: reload bookkeeping: None = the live registration corresponds
+        #: to the current params' desired records (every successful
+        #: pipeline run resets it).  After a reload delta fails
+        #: mid-apply, a ``(base_map, dirty_paths)`` pair: the
+        #: desired-record map of the last SUCCESSFUL application plus
+        #: the set of paths the failed delta may have half-touched —
+        #: the next reload re-diffs from the base and force-rewrites
+        #: every dirty path, so neither a retry nor a revert can read
+        #: as a hollow "noop" while ZooKeeper holds partial state.
+        self._applied_desired = None
+
+    async def reload(self, registration, admin_ip=None) -> str:
+        """Hot-apply a new registration/adminIp (SIGHUP, ISSUE 5).
+
+        Diffs the old desired records against the new and applies ONLY
+        the delta through the single-flight pipeline lock — unchanged
+        znodes are never touched (no delete+recreate blip for names that
+        did not change).  Returns ``"applied"`` or ``"noop"``.  Raises
+        when the initial registration has not completed yet, or when a
+        delta operation fails — by then the agent's desired state has
+        already switched to the new config, so the heartbeat/reconciler
+        recovery layers converge on it.
+        """
+        if self._reload_fn is None:
+            raise RuntimeError(
+                "initial registration has not completed; cannot reload"
+            )
+        return await self._reload_fn(registration, admin_ip)
 
     def stop(self) -> None:
         """Stop the heartbeat loop and health checker.
@@ -143,6 +174,7 @@ def register_plus(
     repair_heartbeat_miss: bool = False,
     register_retry: Optional[RetryPolicy] = None,
     reconcile: Optional[Mapping[str, Any]] = None,
+    resume_manifest: Optional[Sequence[str]] = None,
 ) -> RegistrarEvents:
     """Register, then keep the registration alive; returns the event surface.
 
@@ -161,6 +193,14 @@ def register_plus(
     docstring): ``{"interval_seconds": float, "repair": bool}`` — the
     config's ``reconcile`` object, seconds-based.  Default None = no
     reconciler, reference behavior.
+    ``resume_manifest`` (ISSUE 5) marks a cross-process session resume:
+    the client reattached a predecessor's live session whose ephemerals
+    are expected intact, so the agent VERIFIES the registration (one
+    read-back sweep against the desired records) instead of running the
+    pipeline's delete+recreate — a watching resolver sees zero NO_NODE.
+    Any drift (or a failed sweep) falls back to the normal pipeline.
+    The value is the predecessor's owned-znode list (observability; the
+    desired records, not the manifest, are the verification truth).
     """
     ee = RegistrarEvents()
     ee._track(_run(ee, zk, registration, admin_ip,
@@ -169,7 +209,8 @@ def register_plus(
                    heartbeat_retry,
                    repair_heartbeat_miss,
                    register_retry,
-                   reconcile))
+                   reconcile,
+                   resume_manifest))
     return ee
 
 
@@ -186,11 +227,18 @@ async def _run(
     repair_heartbeat_miss: bool = False,
     register_retry: Optional[RetryPolicy] = None,
     reconcile: Optional[Mapping[str, Any]] = None,
+    resume_manifest: Optional[Sequence[str]] = None,
 ) -> None:
+    # Mutable so the SIGHUP hot-reload can swap the registration in
+    # place: every later pipeline run (heartbeat repair, rebirth,
+    # health recovery, reconciler) reads through this one holder.
+    params = {"registration": dict(registration), "admin_ip": admin_ip}
+
     async def do_register() -> list:
         """The one registration pipeline call every path shares."""
         return await register_mod.register(
-            zk, registration, admin_ip=admin_ip, hostname=hostname,
+            zk, params["registration"], admin_ip=params["admin_ip"],
+            hostname=hostname,
             settle_delay=settle_delay, retry_policy=register_retry,
         )
 
@@ -199,17 +247,26 @@ async def _run(
     #: reconciler repair) — see module docstring.
     repair_lock = asyncio.Lock()
 
-    try:
-        znodes = await do_register()
-    except asyncio.CancelledError:
-        raise
-    except Exception as err:  # noqa: BLE001
-        log.debug("registration failed: %r", err)
-        ee.emit("error", err)
-        return
+    resumed = False
+    znodes = None
+    if resume_manifest is not None:
+        znodes = await _adopt_resumed(zk, params, hostname, resume_manifest)
+        resumed = znodes is not None
+    if znodes is None:
+        try:
+            znodes = await do_register()
+        except asyncio.CancelledError:
+            raise
+        except Exception as err:  # noqa: BLE001
+            log.debug("registration failed: %r", err)
+            ee.emit("error", err)
+            return
 
     ee.znodes = znodes
     ee.epoch += 1
+    ee._reload_fn = lambda reg, ip: _apply_reload(
+        ee, zk, params, repair_lock, hostname, reg, ip
+    )
     if ee.stopped:
         return
 
@@ -234,8 +291,8 @@ async def _run(
         from registrar_tpu.reconcile import Reconciler
 
         ee.reconciler = Reconciler(
-            zk, ee, registration,
-            admin_ip=admin_ip, hostname=hostname,
+            zk, ee, params["registration"],
+            admin_ip=params["admin_ip"], hostname=hostname,
             interval_s=reconcile.get("interval_seconds", 60.0),
             repair=bool(reconcile.get("repair", False)),
             repair_fn=lambda epoch: _reregister_guarded(
@@ -244,7 +301,236 @@ async def _run(
             lock=repair_lock,
         )
         ee._track(ee.reconciler.run())
+    if resume_manifest is not None:
+        # "reattached": verify-not-recreate adopted the predecessor's
+        # live znodes (zero NO_NODE across the restart); "repaired":
+        # the sweep found drift and the pipeline re-ran instead.
+        ee.emit("resume", "reattached" if resumed else "repaired")
     ee.emit("register", znodes)
+
+
+async def _adopt_resumed(
+    zk: ZKClient,
+    params: Mapping[str, Any],
+    hostname: Optional[str],
+    manifest: Sequence[str],
+) -> Optional[List[str]]:
+    """Verify-not-recreate (ISSUE 5 handoff resume).
+
+    The client reattached the predecessor's session, so its ephemerals
+    should be sitting exactly where the desired records say — running the
+    pipeline would delete and recreate them, a Binder-visible NO_NODE
+    window, which is the one thing a handoff exists to avoid.  One
+    read-back sweep (the reconciler's own diff engine) checks every
+    desired znode: clean means the registration is adopted as-is; any
+    drift — or a sweep the wire won't carry — returns None and the
+    caller falls back to the normal pipeline (the registration was
+    already broken, so the pipeline's blip costs nothing extra).
+    """
+    from registrar_tpu import reconcile as reconcile_mod
+
+    try:
+        desired = reconcile_mod.desired_records(
+            params["registration"], params["admin_ip"], hostname
+        )
+        drifts = await reconcile_mod.sweep(
+            zk, desired, session_id=zk.session_id
+        )
+    except asyncio.CancelledError:
+        raise
+    except Exception as err:  # noqa: BLE001 - fall back to the pipeline
+        log.warning(
+            "resume verification sweep failed (%r); falling back to the "
+            "registration pipeline", err,
+        )
+        return None
+    if drifts:
+        log.warning(
+            "resume verification found %d drift(s) (%s); falling back to "
+            "the registration pipeline",
+            len(drifts), [(d.reason, d.path) for d in drifts],
+        )
+        return None
+    adopted = [d.path for d in desired]
+    extra = sorted(set(manifest) - set(adopted))
+    if extra:
+        # Manifest nodes the current desired records no longer cover
+        # (shouldn't happen with the config-hash gate, but a manifest is
+        # operator-editable): never adopt them blind — they would be
+        # heartbeated and defended forever.
+        log.warning(
+            "resume manifest lists %s beyond the desired records; ignoring",
+            extra,
+        )
+    log.info(
+        "resumed registration verified in place (%d znodes, zero drift)",
+        len(adopted),
+    )
+    return adopted
+
+
+async def _apply_reload(
+    ee: RegistrarEvents,
+    zk: ZKClient,
+    params: dict,
+    lock: asyncio.Lock,
+    hostname: Optional[str],
+    new_registration: Mapping[str, Any],
+    new_admin_ip: Optional[str],
+) -> str:
+    """Apply a SIGHUP config reload as a minimal znode delta (ISSUE 5).
+
+    Old and new desired records are diffed path-by-path; only the
+    difference touches ZooKeeper — an unchanged host ephemeral is never
+    deleted or recreated, so names that didn't change never flicker in
+    DNS.  The agent's desired state (``params``, the reconciler's view,
+    ``ee.znodes``) switches to the new config FIRST, under the
+    single-flight lock: even if a delta operation then fails (raised to
+    the caller), every recovery layer is already converging on the new
+    records, not fighting for the old ones.
+
+    The diff base is what was last successfully APPLIED, not merely what
+    the params hold: a delta that died mid-apply leaves
+    ``ee._applied_desired`` carrying the pre-reload records plus the
+    paths the failed delta may have half-touched, so a retry SIGHUP —
+    or a revert back to the old config — re-computes the real remaining
+    work (dirty paths are unconditionally rewritten) instead of
+    comparing the new config against itself and declaring a hollow
+    "noop".  The individual delta operations are idempotent (absent
+    deletes, already-created creates, and missing set_data targets are
+    absorbed) for exactly that replay.
+    """
+    from registrar_tpu import reconcile as reconcile_mod
+
+    # desired_records validates the registration on every path through
+    # here, so a bad reload fails before any state is touched.
+    base = ee._applied_desired
+    if base is None:
+        old_desired = reconcile_mod.desired_records(
+            params["registration"], params["admin_ip"], hostname
+        )
+        base_map, dirty = {d.path: d for d in old_desired}, frozenset()
+    else:
+        base_map, dirty = base
+    new_desired = reconcile_mod.desired_records(
+        new_registration, new_admin_ip, hostname
+    )
+    new_map = {d.path: d for d in new_desired}
+
+    async with lock:
+        params["registration"] = dict(new_registration)
+        params["admin_ip"] = new_admin_ip
+        if ee.reconciler is not None:
+            ee.reconciler.registration = params["registration"]
+            ee.reconciler.admin_ip = new_admin_ip
+        if base_map == new_map and not dirty:
+            ee._applied_desired = None  # in sync with params again
+            return "noop"
+        if ee.stopped:
+            return "noop"
+        if ee.down:
+            # Desired state while health-deregistered is ABSENT; the new
+            # records materialize through do_register on recovery.
+            log.info(
+                "config reload applied while health-down: desired state "
+                "updated, znodes follow on recovery"
+            )
+            ee.epoch += 1
+            ee._applied_desired = None
+            return "applied"
+        try:
+            await _apply_desired_delta(zk, base_map, new_map, dirty=dirty)
+        except BaseException:
+            # Remember the pre-reload base AND every path this delta
+            # could have touched: a later reload (retry or revert) must
+            # assume those are in an unknown state and rewrite them,
+            # never trust the always-"noop" new-vs-new comparison the
+            # already-swapped params would produce.
+            touched = {
+                p
+                for p in set(base_map) | set(new_map)
+                if base_map.get(p) != new_map.get(p)
+            }
+            ee._applied_desired = (base_map, dirty | touched)
+            raise
+        ee._applied_desired = None
+        ee.znodes = [d.path for d in new_desired]
+        ee.epoch += 1
+        log.info(
+            "config reload applied: %d znode(s) now owned (epoch %d)",
+            len(ee.znodes), ee.epoch,
+        )
+        ee.emit("register", ee.znodes)
+    return "applied"
+
+
+async def _apply_desired_delta(
+    zk: ZKClient, old_map, new_map, dirty=frozenset()
+) -> None:
+    """Converge ZooKeeper from one desired-record map to another with the
+    minimum touch set.  Every operation is idempotent so a replay after a
+    mid-apply failure is safe (see :func:`_apply_reload`).
+
+    ``dirty`` paths are in an UNKNOWN state (a previous delta died while
+    touching them): they are unconditionally cleared in pass 1 — a stale
+    node a failed forward delta created must not survive a revert — and
+    rewritten from scratch in pass 2 when the new records want them.
+
+    Order matters: removals, shape changes, and dirty paths are cleared
+    FIRST — a node flipping ephemeral <-> persistent can only be
+    converged by unlink+recreate (a put cannot change ephemerality:
+    leaving a service record ephemeral means it silently dies with the
+    session), and a path becoming a service record may be about to grow
+    children, which an ephemeral cannot hold.
+    """
+    # Pass 1: clear removals, shape flips, and unknown (dirty) state.
+    for path in old_map:
+        if path not in new_map:
+            await register_mod.unlink_tolerant(zk, path)
+    for path in dirty:
+        if path not in old_map or path in new_map:
+            await register_mod.unlink_tolerant(zk, path)
+    for path, want in new_map.items():
+        have = old_map.get(path)
+        if (
+            have is not None
+            and path not in dirty
+            and have.ephemeral != want.ephemeral
+        ):
+            await register_mod.unlink_tolerant(zk, path)
+
+    # Pass 2: write the new records.
+    for path, want in new_map.items():
+        have = None if path in dirty else old_map.get(path)
+        if (
+            have is not None
+            and have.payload == want.payload
+            and have.ephemeral == want.ephemeral
+        ):
+            continue  # untouched: zero NO_NODE for unchanged names
+        if not want.ephemeral:
+            await zk.put(path, want.payload)  # service-record upsert
+        elif (
+            have is not None
+            and have.ephemeral
+            and have.payload != want.payload
+        ):
+            # Payload-only change on a node we own: set in place —
+            # watchers see one dataChanged, never a NO_NODE.
+            try:
+                await zk.set_data(path, want.payload)
+            except ZKError as err:
+                if err.code != Err.NO_NODE:
+                    raise
+                await zk.create_ephemeral_plus(path, want.payload)
+        else:
+            try:
+                await zk.create_ephemeral_plus(path, want.payload)
+            except ZKError as err:
+                if err.code != Err.NODE_EXISTS:
+                    raise
+                # replay after a half-applied delta: already created
+                await zk.set_data(path, want.payload)
 
 
 #: post-rebirth re-registration retry: unbounded like the connect path
@@ -341,6 +627,7 @@ async def _reregister_guarded(
             return False
         ee.znodes = new_znodes
         ee.epoch += 1
+        ee._applied_desired = None  # pipeline wrote the params' records
         log.debug("re-registered %s (epoch %d)", ee.znodes, ee.epoch)
         ee.emit("register", new_znodes)
         return True
@@ -485,6 +772,7 @@ def _start_health_consumer(
             else:
                 ee.znodes = znodes
                 ee.epoch += 1
+                ee._applied_desired = None  # pipeline wrote params' records
                 ee.down = False
                 ee.emit("register", znodes)
         finally:

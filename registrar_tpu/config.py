@@ -43,9 +43,15 @@ etc/config.coal.json)::
       "reconcile": {"intervalSeconds": 60,     # opt-in (ISSUE 3): level-
                     "repair": false},          #  triggered drift reconciler;
                                                #  NOTE: seconds, not ms
-      "cache": {"maxEntries": 4096}            # resolve-cache tuning for
-    }                                          #  zkcli serve-view (ISSUE 4);
+      "cache": {"maxEntries": 4096},           # resolve-cache tuning for
+                                               #  zkcli serve-view (ISSUE 4);
                                                #  the daemon ignores it
+      "restart": {                             # opt-in (ISSUE 5): zero-
+        "stateFile": "/var/run/registrar/state.json",  # downtime restarts;
+        "mode": "handoff",                     #  "handoff" hands the live ZK
+        "drainGraceSeconds": 0                 #  session to the successor,
+      }                                        #  "drain" unregisters + waits
+    }
 
 All reference keys are camelCase and all durations are milliseconds; this
 module translates them into the seconds-based snake_case surface of the
@@ -112,6 +118,24 @@ class CacheConfig:
 
 
 @dataclass
+class RestartConfig:
+    """The ``restart`` block (ISSUE 5): zero-downtime restart behavior.
+
+    ``mode: "handoff"`` keeps ``stateFile`` current (session id, passwd,
+    negotiated timeout, znode manifest — see
+    :mod:`registrar_tpu.statefile`) and a SIGTERM detaches the TCP
+    connection WITHOUT closing the session, so the ephemerals survive for
+    the successor process to reattach; ``mode: "drain"`` unregisters
+    cleanly, waits ``drainGraceSeconds``, and exits 0.  Absent block =
+    the pre-existing graceful stop (close the session, ephemerals deleted
+    immediately) — reference-parity-adjacent default, unchanged."""
+
+    state_file: str
+    mode: str = "handoff"
+    drain_grace_s: float = 0.0
+
+
+@dataclass
 class ReconcileConfig:
     """The ``reconcile`` block: the level-triggered registration
     reconciler (ISSUE 3, :mod:`registrar_tpu.reconcile`).  NOTE the unit
@@ -131,6 +155,7 @@ KNOWN_TOP_LEVEL_KEYS = frozenset(
         "adminIp", "zookeeper", "registration", "healthCheck", "logLevel",
         "maxAttempts", "repairHeartbeatMiss", "metrics",
         "surviveSessionExpiry", "maxSessionRebirths", "reconcile", "cache",
+        "restart",
     }
 )
 
@@ -155,9 +180,15 @@ class Config:
     reconcile: Optional[ReconcileConfig] = None
     #: resolve-cache tuning for zkcli serve-view (ISSUE 4; None = defaults)
     cache: Optional[CacheConfig] = None
+    #: opt-in zero-downtime restart behavior (ISSUE 5; None = today's
+    #: graceful stop: close the session, ephemerals deleted at once)
+    restart: Optional[RestartConfig] = None
     #: unrecognized top-level keys (ignored, like the reference — but
     #: surfaced so the daemon can warn about probable typos)
     unknown_keys: Tuple[str, ...] = ()
+    #: the file this config was loaded from (None when parsed from a
+    #: dict) — the SIGHUP reload re-reads it
+    source_path: Optional[str] = None
 
 
 def parse_config(raw: Mapping[str, Any]) -> Config:
@@ -361,6 +392,36 @@ def parse_config(raw: Mapping[str, Any]) -> Config:
             )
         cache = CacheConfig(max_entries=max_entries)
 
+    restart = None
+    restart_raw = raw.get("restart")
+    if restart_raw is not None:
+        if not isinstance(restart_raw, Mapping):
+            raise ConfigError("config.restart must be an object")
+        state_file = restart_raw.get("stateFile")
+        if not isinstance(state_file, str) or not state_file:
+            raise ConfigError(
+                "config.restart.stateFile must be a non-empty path"
+            )
+        mode = restart_raw.get("mode", "handoff")
+        if mode not in ("handoff", "drain"):
+            raise ConfigError(
+                'config.restart.mode must be "handoff" or "drain"'
+            )
+        grace = restart_raw.get("drainGraceSeconds", 0)
+        if (
+            not isinstance(grace, (int, float))
+            or isinstance(grace, bool)
+            or not math.isfinite(grace)
+            or grace < 0
+        ):
+            raise ConfigError(
+                "config.restart.drainGraceSeconds must be a non-negative "
+                "number (seconds)"
+            )
+        restart = RestartConfig(
+            state_file=state_file, mode=mode, drain_grace_s=float(grace)
+        )
+
     metrics = None
     metrics_raw = raw.get("metrics")
     if metrics_raw is not None:
@@ -392,6 +453,7 @@ def parse_config(raw: Mapping[str, Any]) -> Config:
         max_session_rebirths=max_rebirths,
         reconcile=reconcile,
         cache=cache,
+        restart=restart,
         unknown_keys=tuple(
             sorted(set(raw) - KNOWN_TOP_LEVEL_KEYS)
         ),
@@ -409,7 +471,9 @@ def load_config(path: str) -> Config:
         ) from e
     except json.JSONDecodeError as e:
         raise ConfigError(f"unable to parse configuration {path}: {e}") from e
-    return parse_config(raw)
+    cfg = parse_config(raw)
+    cfg.source_path = path
+    return cfg
 
 
 def _optional_ms(obj: Mapping[str, Any], key: str) -> Optional[int]:

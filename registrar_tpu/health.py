@@ -1,7 +1,9 @@
 """Periodic command-based health checking.
 
 Rebuild of reference lib/health.js:22-148: every ``interval`` seconds run a
-shell command with a ``timeout`` (SIGTERM on expiry, 1 MiB output cap); a
+shell command with a ``timeout`` (SIGTERM to the command's whole process
+group on expiry — the shell runs in its own session so grandchildren
+can't outlive the kill — 1 MiB output cap); a
 check fails on non-zero exit (unless ``ignore_exit_status``) or when stdout
 fails an optional regex match.  Failures accumulate; at ``threshold``
 failures within the sliding ``period`` window the service is declared down.
@@ -33,7 +35,9 @@ from __future__ import annotations
 
 import asyncio
 import logging
+import os
 import re
+import signal
 import time
 from typing import Any, Dict, List, Mapping, Optional
 
@@ -200,6 +204,13 @@ class HealthCheck(EventEmitter):
                 self.command,
                 stdout=asyncio.subprocess.PIPE,
                 stderr=asyncio.subprocess.PIPE,
+                # Own process group: the shell routinely spawns
+                # grandchildren (pipelines, `curl | grep`, & chains) that
+                # inherit it, so the timeout kill below can take out the
+                # WHOLE group — killing only the shell leaks any child
+                # that outlives it (and a signal-ignoring child used to
+                # survive every escalation while holding our pipes open).
+                start_new_session=hasattr(os, "killpg"),
             )
         except OSError as e:
             return HealthCheckError(f"{self.command} failed to spawn: {e}")
@@ -218,17 +229,14 @@ class HealthCheck(EventEmitter):
             await self._force_reap(proc)
             raise
         except asyncio.TimeoutError:
-            # SIGTERM, matching the reference's killSignal
-            # (lib/health.js:48); escalate if it lingers.  Drain the
-            # pipes so their transports are closed and the child isn't
-            # wedged on a full pipe; after the grace period escalate to
-            # the bounded SIGKILL reap (the pipes may be held open by a
-            # signal-ignoring grandchild — abandon them rather than
-            # suspend health checking).
-            try:
-                proc.terminate()
-            except ProcessLookupError:
-                pass
+            # SIGTERM to the whole process group, matching the
+            # reference's killSignal (lib/health.js:48); escalate if it
+            # lingers.  Drain the pipes so their transports are closed
+            # and the child isn't wedged on a full pipe; after the grace
+            # period escalate to the bounded group-SIGKILL reap (the
+            # pipes may be held open by a signal-ignoring grandchild —
+            # which the group KILL now reaps too).
+            self._kill_group(proc, signal.SIGTERM)
             try:
                 await asyncio.wait_for(self._drain(proc), timeout=1.0)
             except asyncio.TimeoutError:
@@ -253,8 +261,33 @@ class HealthCheck(EventEmitter):
         return None
 
     @staticmethod
-    async def _force_reap(proc) -> None:
-        """SIGKILL, reap (bounded), and close the pipe transports.
+    def _kill_group(proc, sig) -> None:
+        """Signal the child's whole process group, shell included.
+
+        The shell is spawned with ``start_new_session=True``, so its pid
+        doubles as the group id and every grandchild it forked (that did
+        not setsid itself) is in the group — ``os.killpg`` reaches the
+        processes a shell-only ``terminate()``/``kill()`` leaks.  Falls
+        back to signalling the shell alone when the group is already
+        gone, or on platforms without process groups."""
+        if hasattr(os, "killpg"):
+            try:
+                os.killpg(proc.pid, sig)
+                return
+            except ProcessLookupError:
+                return  # whole group already exited
+            except (PermissionError, OSError):
+                pass  # e.g. pid is not a group leader: fall through
+        try:
+            if sig == getattr(signal, "SIGKILL", None):
+                proc.kill()
+            else:
+                proc.terminate()
+        except ProcessLookupError:
+            pass
+
+    async def _force_reap(self, proc) -> None:
+        """Group SIGKILL, reap (bounded), and close the pipe transports.
 
         The ONE copy of the reap escalation (both the timeout and
         cancellation paths end here).  ``wait()`` resolves when the
@@ -268,10 +301,7 @@ class HealthCheck(EventEmitter):
         collector.  ``_transport`` is asyncio private API, so its
         absence (a future internals change) degrades to skipping the
         close rather than crashing the reap path."""
-        try:
-            proc.kill()
-        except ProcessLookupError:
-            pass  # already exited
+        self._kill_group(proc, getattr(signal, "SIGKILL", signal.SIGTERM))
         transport = getattr(proc, "_transport", None)
         try:
             await asyncio.wait_for(proc.wait(), timeout=1.0)
@@ -317,10 +347,7 @@ class HealthCheck(EventEmitter):
                 if total > MAX_OUTPUT_BYTES:
                     if not exceeded:
                         exceeded = True
-                        try:
-                            proc.terminate()
-                        except ProcessLookupError:
-                            pass
+                        self._kill_group(proc, signal.SIGTERM)
                     # Keep only up to the cap; drain (and discard) the
                     # rest so the pipe reaches EOF and the child can die.
                     if keep and before < MAX_OUTPUT_BYTES:

@@ -29,6 +29,27 @@ of waiting out the session timeout — an instance drained with
 ``systemctl stop registrar`` leaves DNS as fast as Binder's cache allows.
 (The reference is stopped with SMF ``:kill`` and waits for expiry,
 README.md:85-87.)
+
+Zero-downtime restarts (ISSUE 5, opt-in ``restart`` config block):
+
+  * ``mode: "handoff"`` — SIGTERM persists the live session's handoff
+    state (:mod:`registrar_tpu.statefile`) and detaches the TCP
+    connection WITHOUT closing the session: the ephemerals stay up for
+    the negotiated timeout, the successor process reattaches the same
+    session from the state file and verifies (not recreates) the
+    registration — a watching resolver sees **zero** NO_NODE across the
+    restart.  Every degraded shape (stale/foreign/tampered state file,
+    config change, a reattach the server refuses) falls back to today's
+    fresh-session registration;
+  * ``mode: "drain"`` — SIGTERM unregisters cleanly, waits
+    ``drainGraceSeconds``, then exits 0;
+  * a second SIGTERM/SIGINT during a wedged graceful stop forces an
+    immediate exit (:data:`EX_FORCED`) — operators are never pushed to
+    SIGKILL;
+  * SIGHUP re-reads the config file and hot-applies the registration
+    delta through the agent's single-flight pipeline lock (unchanged
+    znodes are never touched); keys that cannot hot-apply are named in
+    a warning and need a restart.
 """
 
 from __future__ import annotations
@@ -36,19 +57,28 @@ from __future__ import annotations
 import argparse
 import asyncio
 import logging
+import os
 import signal
 import sys
+import time
 
 from registrar_tpu import __version__
 from registrar_tpu import jlog
+from registrar_tpu import statefile
+from registrar_tpu.events import spawn_owned
 from registrar_tpu.agent import register_plus
 from registrar_tpu.config import (
     Config,
     ConfigError,
     ConfigUnreadableError,
+    RestartConfig,
     load_config,
 )
-from registrar_tpu.zk.client import create_zk_client
+from registrar_tpu.registration import unlink_tolerant
+from registrar_tpu.zk.client import (
+    ZKClient,
+    connect_with_backoff,
+)
 
 
 def parse_args(argv=None) -> argparse.Namespace:
@@ -82,6 +112,14 @@ def parse_args(argv=None) -> argparse.Namespace:
 #: restart on the latter but stop retrying a config that can never work
 #: (systemd/registrar.service sets RestartPreventExitStatus=78).
 EX_CONFIG = 78
+
+#: exit status when a SECOND SIGTERM/SIGINT lands while a graceful stop
+#: is still running (ISSUE 5 satellite): the operator's escape hatch out
+#: of a wedged shutdown (an unreachable ensemble stalling the drain, a
+#: long drainGraceSeconds) without reaching for SIGKILL.  Distinct from
+#: 0 (clean stop) and 1 (runtime failure) so supervisors and humans can
+#: tell a forced exit from both.  BSD sysexits EX_SOFTWARE.
+EX_FORCED = 70
 
 
 def configure(argv=None) -> Config:
@@ -140,11 +178,12 @@ def configure(argv=None) -> Config:
     return cfg
 
 
-async def run(cfg: Config, *, _exit=sys.exit) -> None:
-    """Connect, register, and serve events until stopped or expired."""
-    log = logging.getLogger("registrar")
-
-    zk = await create_zk_client(
+def _client_from_config(cfg: Config) -> ZKClient:
+    """The daemon's ZKClient settings, in ONE place: the cold-start path
+    and the handoff-resume path must run with identical client tuning —
+    a zookeeper key honored by one and silently dropped by the other
+    would make a restarted daemon behave differently from a cold one."""
+    return ZKClient(
         cfg.zookeeper.servers,
         timeout_ms=cfg.zookeeper.timeout_ms,
         connect_timeout_ms=cfg.zookeeper.connect_timeout_ms,
@@ -153,6 +192,127 @@ async def run(cfg: Config, *, _exit=sys.exit) -> None:
         survive_session_expiry=cfg.survive_session_expiry,
         max_session_rebirths=cfg.max_session_rebirths,
     )
+
+
+async def _drain_unregister(zk: ZKClient, znodes, log) -> list:
+    """Best-effort deregistration for the drain shutdown.
+
+    Unlike the pipeline's strict ``unregister``, this walk NEVER aborts
+    early: the whole point of a drain is that every record this host
+    still serves leaves DNS before the process exits, so an
+    already-absent node (health-down raced us, an operator deleted one
+    out-of-band) is success, a still-shared service node is left in
+    place as usual, and any other per-node error is logged while the
+    remaining nodes are still processed.  Returns the nodes deleted.
+    """
+    deleted = []
+    for node in znodes:
+        try:
+            outcome = await unlink_tolerant(zk, node)
+        except asyncio.CancelledError:
+            raise
+        except Exception as err:  # noqa: BLE001 - keep draining the rest
+            log.error("restart: drain could not delete %s", node,
+                      extra={"zdata": {"err": repr(err)}})
+            continue
+        if outcome == "deleted":
+            deleted.append(node)
+    return deleted
+
+
+async def _attempt_resume(cfg: Config, restart_cfg: RestartConfig,
+                          fingerprint: str, log):
+    """Try to adopt the predecessor's session from the state file.
+
+    Returns ``(client_or_None, manifest_or_None, attempted)``:
+
+      * ``(client, manifest, True)`` — the session reattached; the agent
+        should verify-not-recreate against ``manifest``;
+      * ``(client, None, True)`` — a resume was staged but the server
+        refused it (expired in the gap): the client holds a FRESH
+        session, register normally;
+      * ``(None, None, True)`` — the state file was unusable (stale
+        stamp, config-hash mismatch, foreign/corrupt/short passwd):
+        connect + register exactly as a cold start;
+      * ``(None, None, False)`` — no state file at all (a normal cold
+        start, nothing to report).
+    """
+    try:
+        state = statefile.load(restart_cfg.state_file)
+    except statefile.StateFileMissing:
+        return None, None, False
+    except statefile.StateFileError as e:
+        log.warning(
+            "restart: unusable state file (%s); starting fresh", e,
+            extra={"zdata": {"reason": e.reason,
+                             "file": restart_cfg.state_file}},
+        )
+        return None, None, True
+    reason = statefile.check_resumable(state, fingerprint)
+    if reason is not None:
+        log.warning(
+            "restart: state file not resumable (%s); starting fresh",
+            reason,
+            extra={"zdata": {"reason": reason,
+                             "session": f"0x{state.session_id:x}",
+                             "file": restart_cfg.state_file}},
+        )
+        return None, None, True
+    zk = _client_from_config(cfg)
+    zk.seed_session(
+        state.session_id, state.passwd,
+        negotiated_timeout_ms=state.negotiated_timeout_ms,
+        last_zxid=state.last_zxid,
+    )
+    log.info(
+        "restart: resuming predecessor session",
+        extra={"zdata": {"session": f"0x{state.session_id:x}",
+                         "predecessorPid": state.pid,
+                         "znodes": list(state.znodes)}},
+    )
+    await connect_with_backoff(zk)
+    if zk.session_id == state.session_id:
+        log.info(
+            "restart: session resumed; verifying registration in place",
+            extra={"zdata": {"session": f"0x{zk.session_id:x}"}},
+        )
+        return zk, list(state.znodes), True
+    # seed refused: the client already fell back to a fresh session
+    # (zk.client resume_refused path) — register from scratch.
+    log.warning(
+        "restart: session resume refused (expired in the gap); "
+        "registering fresh",
+        extra={"zdata": {"stale": f"0x{state.session_id:x}",
+                         "session": f"0x{zk.session_id:x}"}},
+    )
+    return zk, None, True
+
+
+async def run(cfg: Config, *, _exit=sys.exit) -> None:
+    """Connect, register, and serve events until stopped or expired."""
+    log = logging.getLogger("registrar")
+
+    restart_cfg = cfg.restart
+    fingerprint = (
+        statefile.config_fingerprint(
+            cfg.registration, cfg.admin_ip, cfg.zookeeper.chroot
+        )
+        if restart_cfg is not None
+        else None
+    )
+
+    zk = None
+    resume_manifest = None
+    resume_attempted = False
+    if restart_cfg is not None:
+        zk, resume_manifest, resume_attempted = await _attempt_resume(
+            cfg, restart_cfg, fingerprint, log
+        )
+    if zk is None:
+        # Same construction + infinite-backoff envelope create_zk_client
+        # wraps (reference lib/zk.js:62-127) — shared with the resume
+        # path above via _client_from_config/connect_with_backoff.
+        zk = await connect_with_backoff(_client_from_config(cfg))
 
     zk.on("close", lambda *a: log.warning("zookeeper: disconnected"))
     # The initial connect already happened; later connects are reconnects
@@ -202,6 +362,7 @@ async def run(cfg: Config, *, _exit=sys.exit) -> None:
             if cfg.reconcile is not None
             else None
         ),
+        resume_manifest=resume_manifest,
     )
 
     ee.on("fail", lambda err: log.error(
@@ -250,6 +411,155 @@ async def run(cfg: Config, *, _exit=sys.exit) -> None:
     ee.on("heartbeatFailure", on_heartbeat_failure)
     ee.on("heartbeat", on_heartbeat)
 
+    # -- handoff state keeper (ISSUE 5) -------------------------------------
+    # The state file tracks the LIVE session: rewritten on every session
+    # establish/reattach/rebirth and registration refresh, stamped once
+    # more at SIGTERM-handoff time, and fenced (deleted) the moment the
+    # session is known dead (terminal expiry) or deliberately closed.
+    state_note = {"hash": fingerprint}
+    state_tasks: set = set()
+    state_write_lock = asyncio.Lock()
+
+    def _snapshot_state():
+        return statefile.SessionState(
+            session_id=zk.session_id,
+            passwd=zk.session_passwd,
+            negotiated_timeout_ms=zk.negotiated_timeout_ms,
+            last_zxid=zk.last_zxid,
+            chroot=zk.chroot,
+            config_hash=state_note["hash"],
+            znodes=list(ee.znodes),
+            pid=os.getpid(),
+            stamp=time.time(),
+        )
+
+    def _log_statefile_error(err: OSError) -> None:
+        # A broken state file costs the NEXT restart its handoff (it
+        # degrades to a fresh registration); it must never cost THIS
+        # process its registration.
+        log.error(
+            "restart: cannot write state file %s",
+            restart_cfg.state_file, extra={"zdata": {"err": repr(err)}},
+        )
+
+    def write_statefile(*_a) -> None:
+        """Synchronous save — ONLY for the SIGTERM-handoff stamp, where
+        the process is about to exit and the write must land first."""
+        if restart_cfg is None or zk.closed or zk.session_id == 0:
+            return
+        try:
+            statefile.save(restart_cfg.state_file, _snapshot_state())
+        except OSError as err:
+            _log_statefile_error(err)
+
+    def write_statefile_bg(*_a) -> None:
+        """Event-listener save: the state is snapshotted NOW (on the
+        loop, a consistent view) but the two fsyncs run in a worker
+        thread — register/connect/rebirth fire exactly when the session
+        machinery is busiest, and a slow disk must not stall the loop.
+        The lock serializes writers so snapshots land in event order."""
+        if restart_cfg is None or zk.closed or zk.session_id == 0:
+            return
+        state = _snapshot_state()
+
+        async def _save() -> None:
+            async with state_write_lock:
+                try:
+                    await asyncio.to_thread(
+                        statefile.save, restart_cfg.state_file, state
+                    )
+                except OSError as err:
+                    _log_statefile_error(err)
+
+        spawn_owned(_save(), state_tasks)
+
+    def clear_statefile(*_a) -> None:
+        if restart_cfg is not None:
+            statefile.clear(restart_cfg.state_file)
+
+    if restart_cfg is not None:
+        ee.on("register", write_statefile_bg)
+        zk.on("connect", write_statefile_bg)
+        zk.on("session_reborn", write_statefile_bg)
+        # Fencing: a terminally expired session must never be offered to
+        # a successor (the reattach would be refused, but a dead-session
+        # state file also misleads operators and `zkcli state`).
+        zk.on("session_expired", clear_statefile)
+
+    # -- SIGHUP config hot-reload (ISSUE 5) ---------------------------------
+    reload_lock = asyncio.Lock()
+
+    async def do_reload() -> None:
+        async with reload_lock:
+            result = "failed"
+            path = cfg.source_path
+            if path is None:
+                log.error("SIGHUP: no config file to reload from")
+            else:
+                log.info("SIGHUP: reloading configuration from %s", path)
+                try:
+                    new_cfg = load_config(path)
+                    from registrar_tpu.registration import (
+                        _validate_registration,
+                    )
+
+                    _validate_registration(new_cfg.registration)
+                except (ConfigError, ValueError) as err:
+                    log.error(
+                        "SIGHUP: invalid configuration; keeping the "
+                        "running config",
+                        exc_info=(type(err), err, err.__traceback__),
+                    )
+                else:
+                    if new_cfg.log_level and new_cfg.log_level != cfg.log_level:
+                        level = jlog.LEVELS.get(new_cfg.log_level.lower())
+                        if level is not None:
+                            logging.getLogger().setLevel(level)
+                            cfg.log_level = new_cfg.log_level
+                            log.info("SIGHUP: logLevel -> %s",
+                                     new_cfg.log_level)
+                    cold = _cold_reload_changes(cfg, new_cfg)
+                    if cold:
+                        log.warning(
+                            "SIGHUP: changes to %s cannot hot-apply; "
+                            "restart to pick them up", ", ".join(cold),
+                            extra={"zdata": {"keys": cold}},
+                        )
+                    try:
+                        result = await ee.reload(
+                            new_cfg.registration, new_cfg.admin_ip
+                        )
+                    except asyncio.CancelledError:
+                        raise
+                    except RuntimeError as err:
+                        log.error("SIGHUP: %s", err)
+                    except Exception as err:  # noqa: BLE001
+                        # The agent's desired state already switched to
+                        # the new records (reload mutates before it
+                        # writes), so heartbeat/reconciler converge on
+                        # them; adopt the new config here too.
+                        log.error(
+                            "SIGHUP: reload delta failed mid-apply (%r); "
+                            "recovery layers will converge on the new "
+                            "records", err,
+                        )
+                        cfg.registration = dict(new_cfg.registration)
+                        cfg.admin_ip = new_cfg.admin_ip
+                    else:
+                        cfg.registration = dict(new_cfg.registration)
+                        cfg.admin_ip = new_cfg.admin_ip
+                        log.info(
+                            "SIGHUP: configuration reload %s", result,
+                            extra={"zdata": {"result": result}},
+                        )
+                    if restart_cfg is not None:
+                        state_note["hash"] = statefile.config_fingerprint(
+                            cfg.registration, cfg.admin_ip,
+                            cfg.zookeeper.chroot,
+                        )
+                        write_statefile_bg()
+            ee.emit("configReload", result)
+
     metrics_server = None
     if cfg.metrics is not None:
         from registrar_tpu.metrics import MetricsServer, instrument
@@ -271,21 +581,138 @@ async def run(cfg: Config, *, _exit=sys.exit) -> None:
                      extra={"zdata": {"host": cfg.metrics.host,
                                       "port": metrics_server.port}})
 
+    if resume_attempted and resume_manifest is None:
+        # The agent reports "reattached"/"repaired" itself; the shapes
+        # where no session came back (unusable file, refused reattach)
+        # are only known here.  Emitted after the metrics wiring above
+        # so the counter sees it.
+        ee.emit("resume", "fresh")
+
     loop = asyncio.get_running_loop()
+
+    def on_stop_signal() -> None:
+        if stopping.is_set():
+            # Second-signal escape hatch (ISSUE 5 satellite): the
+            # graceful stop below is wedged (unreachable ensemble, long
+            # drain grace) and the operator signalled again — leave NOW,
+            # with a distinct line and code, so nobody reaches for
+            # SIGKILL.  os._exit skips cleanup by design: cleanup is
+            # exactly what is stuck.
+            log.critical(
+                "second termination signal during graceful stop; "
+                "forcing immediate exit (code %d)", EX_FORCED,
+            )
+            try:
+                sys.stdout.flush()
+            except Exception:  # noqa: BLE001
+                pass
+            # check: disable=unguarded-private-attr -- os._exit is the
+            # documented immediate-exit API (skips atexit/finalizers by
+            # design), which is exactly what a wedged shutdown needs
+            os._exit(EX_FORCED)
+        stopping.set()
+
     for sig in (signal.SIGTERM, signal.SIGINT):
         try:
-            loop.add_signal_handler(sig, stopping.set)
+            loop.add_signal_handler(sig, on_stop_signal)
         except NotImplementedError:  # non-unix test environments
             pass
+    reload_tasks: set = set()
+    try:
+        loop.add_signal_handler(
+            signal.SIGHUP, lambda: spawn_owned(do_reload(), reload_tasks)
+        )
+    except (NotImplementedError, AttributeError):  # non-unix
+        pass
 
     await stopping.wait()
-    log.info("registrar: shutting down")
-    ee.stop()
+    mode = restart_cfg.mode if restart_cfg is not None else None
+    log.info(
+        "registrar: shutting down",
+        extra={"zdata": {"mode": mode or "close"}},
+    )
+    ee.stop()  # health checker first: no transition may race the exit
+    if (
+        exit_code == 0
+        and mode == "handoff"
+        and not zk.closed
+        and zk.session_id != 0
+    ):
+        # Persist with a FRESH stamp — the successor's staleness window
+        # opens here — then sever the TCP connection with the session
+        # (and every ephemeral) left alive for it.  Any in-flight
+        # background save must land FIRST: a worker thread finishing
+        # after us would clobber this stamp with an older snapshot and
+        # silently shrink (or void) the successor's resume window.
+        if state_tasks:
+            await asyncio.gather(*state_tasks, return_exceptions=True)
+        async with state_write_lock:
+            write_statefile()
+        log.info(
+            "restart: session handed off; ephemerals remain live for "
+            "the successor",
+            extra={"zdata": {"session": f"0x{zk.session_id:x}",
+                             "stateFile": restart_cfg.state_file,
+                             "znodes": list(ee.znodes)}},
+        )
+        ee.emit("handoff", restart_cfg.state_file)
+        await zk.detach()
+    elif exit_code == 0 and mode == "drain":
+        deleted = await _drain_unregister(zk, ee.znodes, log)
+        log.info("restart: drained",
+                 extra={"zdata": {"znodes": deleted}})
+        ee.emit("drain", deleted)
+        if restart_cfg.drain_grace_s > 0:
+            log.info(
+                "restart: waiting drainGraceSeconds before exit",
+                extra={"zdata": {"seconds": restart_cfg.drain_grace_s}},
+            )
+            await asyncio.sleep(restart_cfg.drain_grace_s)
+        clear_statefile()
+        await zk.close()
+    else:
+        await zk.close()  # deletes our ephemerals immediately (docstring)
+        clear_statefile()  # a closed session is nothing to hand off
     if metrics_server is not None:
+        # Stopped LAST so the handoff/drain counters increment while the
+        # endpoint still answers (a drain's grace period is scrapeable).
         await metrics_server.stop()
-    await zk.close()  # deletes our ephemerals immediately (see docstring)
     if exit_code:
         _exit(exit_code)
+
+
+def _cold_reload_changes(old: Config, new: Config) -> list:
+    """Config keys changed between ``old`` and ``new`` that can NOT
+    hot-apply over SIGHUP — named in the reload warning so operators
+    know those changes still need a restart.  Everything that shapes the
+    znode records (registration, adminIp) hot-applies; logLevel
+    hot-applies separately."""
+    cold = []
+    if old.zookeeper != new.zookeeper:
+        cold.append("zookeeper")
+    if old.health_check != new.health_check:
+        cold.append("healthCheck")
+    if old.metrics != new.metrics:
+        cold.append("metrics")
+    if old.reconcile != new.reconcile:
+        cold.append("reconcile")
+    if old.restart != new.restart:
+        cold.append("restart")
+    if old.survive_session_expiry != new.survive_session_expiry:
+        cold.append("surviveSessionExpiry")
+    if old.max_session_rebirths != new.max_session_rebirths:
+        cold.append("maxSessionRebirths")
+    if old.repair_heartbeat_miss != new.repair_heartbeat_miss:
+        cold.append("repairHeartbeatMiss")
+    if old.heartbeat_interval_s != new.heartbeat_interval_s:
+        cold.append("registration.heartbeatInterval")
+    if (
+        old.heartbeat_retry.max_attempts != new.heartbeat_retry.max_attempts
+    ):
+        cold.append("maxAttempts")
+    if old.cache != new.cache:
+        cold.append("cache")
+    return cold
 
 
 def main(argv=None) -> None:

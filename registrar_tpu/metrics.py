@@ -34,6 +34,13 @@ Exported metrics (all prefixed ``registrar_``):
     registrar_drift_repaired_total{reason}  reconciler drift converged
     registrar_reconcile_sweeps_total    reconcile sweeps completed
     registrar_reconcile_sweep_seconds   duration of the last reconcile sweep
+    registrar_handoffs_total            handoff shutdowns: session left
+                                        alive for a successor (ISSUE 5)
+    registrar_drains_total              drain shutdowns (clean unregister)
+    registrar_session_resumes_total{outcome}  cross-process session
+                                        resumes (reattached|repaired|fresh)
+    registrar_config_reloads_total{result}  SIGHUP config reloads
+                                        (applied|noop|failed)
 
 :func:`instrument_cache` (ISSUE 4) additionally exposes the
 watch-coherent resolve cache (:mod:`registrar_tpu.zkcache`):
@@ -340,6 +347,28 @@ def instrument(ee, zk, registry: Optional[MetricsRegistry] = None) -> MetricsReg
         "registrar_reconcile_sweep_seconds",
         "Duration of the last reconcile sweep (seconds)",
     )
+    handoffs = reg.counter(
+        "registrar_handoffs_total",
+        "Handoff shutdowns: session state persisted, connection "
+        "detached with the session (and ephemerals) left alive for a "
+        "successor (restart.mode=handoff, ISSUE 5)",
+    )
+    drains = reg.counter(
+        "registrar_drains_total",
+        "Drain shutdowns: znodes unregistered cleanly before exit "
+        "(restart.mode=drain)",
+    )
+    resumes = reg.counter(
+        "registrar_session_resumes_total",
+        "Cross-process session resume attempts by outcome: reattached "
+        "(verified in place, zero NO_NODE), repaired (reattached but "
+        "drifted; pipeline re-ran), fresh (state unusable or reattach "
+        "refused; normal registration)",
+    )
+    reloads = reg.counter(
+        "registrar_config_reloads_total",
+        "SIGHUP config reloads by result (applied|noop|failed)",
+    )
 
     start = time.monotonic()
     uptime.set_function(lambda: time.monotonic() - start)
@@ -358,6 +387,10 @@ def instrument(ee, zk, registry: Optional[MetricsRegistry] = None) -> MetricsReg
     for reason in reconcile_mod.REASONS:
         drift.inc(0, labels={"reason": reason})
         drift_repaired.inc(0, labels={"reason": reason})
+    for outcome in ("reattached", "repaired", "fresh"):
+        resumes.inc(0, labels={"outcome": outcome})
+    for result in ("applied", "noop", "failed"):
+        reloads.inc(0, labels={"result": result})
 
     def on_sweep(summary) -> None:
         sweeps.inc()
@@ -365,6 +398,13 @@ def instrument(ee, zk, registry: Optional[MetricsRegistry] = None) -> MetricsReg
 
     zk.on("session_reborn", lambda *_a: rebirths.inc())
     zk.on("rebirth_breaker_tripped", lambda *_a: breaker_trips.inc())
+    ee.on("handoff", lambda *_a: handoffs.inc())
+    ee.on("drain", lambda *_a: drains.inc())
+    ee.on("resume", lambda outcome: resumes.inc(labels={"outcome": outcome}))
+    ee.on(
+        "configReload",
+        lambda result: reloads.inc(labels={"result": result}),
+    )
     ee.on("drift", lambda d: drift.inc(labels={"reason": d.reason}))
     ee.on(
         "driftRepaired",

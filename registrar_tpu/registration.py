@@ -238,6 +238,30 @@ async def _register_once(
     return nodes
 
 
+async def unlink_tolerant(zk: ZKClient, path: str) -> str:
+    """Delete one znode, absorbing the two benign outcomes every
+    deregistration walk shares.  Returns ``"deleted"``, ``"absent"``
+    (NO_NODE — already gone, e.g. deleted out-of-band or a replay of a
+    half-applied delta), or ``"shared"`` (NOT_EMPTY — a service node
+    with sibling hosts' ephemerals still under it, which must survive
+    this host's departure).  Any other error propagates.
+
+    The ONE copy of this tolerance (ISSUE 5): the reload delta
+    (:mod:`registrar_tpu.agent`), the drain shutdown
+    (:mod:`registrar_tpu.main`), and ``zkcli drain`` all walk with it,
+    so their deregistration semantics can never drift apart.
+    """
+    try:
+        await zk.unlink(path)
+    except ZKError as err:
+        if err.code == Err.NO_NODE:
+            return "absent"
+        if err.code == Err.NOT_EMPTY:
+            return "shared"
+        raise
+    return "deleted"
+
+
 async def unregister(
     zk: ZKClient, znodes: Sequence[str], atomic: bool = False
 ) -> List[str]:

@@ -15,6 +15,8 @@ one command instead of manual tree-walking::
     python -m registrar_tpu.tools.zkcli -s 127.0.0.1:2181 resolve -t SRV _http._tcp.example.joyent.us
     python -m registrar_tpu.tools.zkcli -s 127.0.0.1:2181 admin ruok
     python -m registrar_tpu.tools.zkcli verify -f /opt/registrar/etc/config.json
+    python -m registrar_tpu.tools.zkcli state /var/run/registrar/state.json
+    python -m registrar_tpu.tools.zkcli drain -f /opt/registrar/etc/config.json
     python -m registrar_tpu.tools.zkcli -s 127.0.0.1:2181 getacl /us/joyent
     python -m registrar_tpu.tools.zkcli -s 127.0.0.1:2181 --auth digest:ops:pw \
         setacl /us/joyent/locked digest:ops:HASH:cdrwa world:anyone:r
@@ -505,6 +507,50 @@ async def _cmd_setacl(zk: ZKClient, args) -> int:
     return 0
 
 
+async def _config_session(args, what: str):
+    """Load ``-f CONFIG`` and open one bounded, non-reconnecting session
+    per its own ``zookeeper`` block — the shared scaffolding of every
+    config-driven command (``verify``, ``drain``), so the connect/timeout
+    envelope can never drift between them.
+
+    Returns ``(cfg, zk)`` with the session connected, or ``None`` after
+    printing the error (the caller exits 2: the command could not run).
+    The per-operation deadline honors the config's own
+    ``zookeeper.requestTimeout``, else derives one from ``--timeout`` —
+    a server that accepts the handshake and then stalls replies must
+    make the command exit 2, never hang a cron job forever.
+    """
+    from registrar_tpu.config import ConfigError, load_config
+
+    try:
+        cfg = load_config(args.file)
+    except ConfigError as e:
+        print(f"zkcli: {what}: {e}", file=sys.stderr)
+        return None
+    zk = ZKClient(
+        cfg.zookeeper.servers,
+        timeout_ms=cfg.zookeeper.timeout_ms,
+        connect_timeout_ms=cfg.zookeeper.connect_timeout_ms,
+        chroot=cfg.zookeeper.chroot,
+        reconnect=False,
+        request_timeout_ms=(
+            cfg.zookeeper.request_timeout_ms
+            if cfg.zookeeper.request_timeout_ms is not None
+            else max(int(args.timeout * 1000), 1)
+        ),
+    )
+    try:
+        await asyncio.wait_for(zk.connect(), timeout=args.timeout)
+    except Exception as e:  # noqa: BLE001 - probe failure, not a bug
+        await zk.close()
+        print(
+            f"zkcli: {what}: cannot connect to "
+            f"{cfg.zookeeper.servers}: {e!r}", file=sys.stderr,
+        )
+        return None
+    return cfg, zk
+
+
 async def _cmd_verify(args) -> int:
     """Read-only drift audit: diff live ZooKeeper state against a
     registrar config's desired records (the reconciler's sweep,
@@ -517,38 +563,12 @@ async def _cmd_verify(args) -> int:
     ``-s`` flag, so the audit sees exactly what the daemon would.
     """
     from registrar_tpu import reconcile
-    from registrar_tpu.config import ConfigError, load_config
 
-    try:
-        cfg = load_config(args.file)
-    except ConfigError as e:
-        print(f"zkcli: verify: {e}", file=sys.stderr)
+    session = await _config_session(args, "verify")
+    if session is None:
         return 2
-    zk = ZKClient(
-        cfg.zookeeper.servers,
-        timeout_ms=cfg.zookeeper.timeout_ms,
-        connect_timeout_ms=cfg.zookeeper.connect_timeout_ms,
-        chroot=cfg.zookeeper.chroot,
-        reconnect=False,
-        # The audit itself must be bounded too, or a server that accepts
-        # the handshake and then stalls replies hangs the cron job
-        # forever instead of exiting 2: honor the config's own
-        # per-operation deadline, else derive one from --timeout.
-        request_timeout_ms=(
-            cfg.zookeeper.request_timeout_ms
-            if cfg.zookeeper.request_timeout_ms is not None
-            else max(int(args.timeout * 1000), 1)
-        ),
-    )
+    cfg, zk = session
     try:
-        try:
-            await asyncio.wait_for(zk.connect(), timeout=args.timeout)
-        except Exception as e:  # noqa: BLE001 - probe failure, not a bug
-            print(
-                f"zkcli: verify: cannot connect to "
-                f"{cfg.zookeeper.servers}: {e!r}", file=sys.stderr,
-            )
-            return 2
         try:
             drifts = await reconcile.audit(
                 zk, cfg.registration,
@@ -571,6 +591,103 @@ async def _cmd_verify(args) -> int:
     )
     print(f"{len(drifts)} drift(s): {rollup}", file=sys.stderr)
     return 1
+
+
+async def _cmd_state(args) -> int:
+    """Inspect a registrar handoff state file (``restart.stateFile``).
+
+    Prints every persisted field plus a resumability verdict: would a
+    successor starting NOW (optionally with ``--config``'s fingerprint)
+    attempt the session resume, or fall back to a fresh registration —
+    and why.  Exit 0 = resumable, 1 = not resumable, 2 = unreadable.
+    Local file inspection only; no ZooKeeper connection is made (the
+    server's reattach verdict is the final authority either way).
+    """
+    import time as time_mod
+
+    from registrar_tpu import statefile
+
+    try:
+        state = statefile.load(args.file)
+    except statefile.StateFileError as e:
+        print(f"zkcli: state: {e} (reason: {e.reason})", file=sys.stderr)
+        return 2
+    age = time_mod.time() - state.stamp
+    print(f"format = {statefile.FORMAT}")
+    print(f"sessionId = 0x{state.session_id:x}")
+    print(f"negotiatedTimeoutMs = {state.negotiated_timeout_ms}")
+    print(f"lastZxid = 0x{state.last_zxid:x}")
+    print(f"chroot = {state.chroot or '(none)'}")
+    print(f"configHash = {state.config_hash}")
+    print(f"pid = {state.pid}")
+    print(f"stampAgeSeconds = {age:.1f}")
+    print(f"znodes = {' '.join(state.znodes) or '(none)'}")
+    config_hash = state.config_hash
+    if args.config:
+        from registrar_tpu.config import ConfigError, load_config
+
+        try:
+            cfg = load_config(args.config)
+        except ConfigError as e:
+            print(f"zkcli: state: {e}", file=sys.stderr)
+            return 2
+        config_hash = statefile.config_fingerprint(
+            cfg.registration, cfg.admin_ip, cfg.zookeeper.chroot
+        )
+    reason = statefile.check_resumable(state, config_hash)
+    if reason is None:
+        print("resumable = yes (a successor would attempt the reattach)")
+        return 0
+    print(f"resumable = no ({reason})")
+    return 1
+
+
+async def _cmd_drain(args) -> int:
+    """Deregister a host's records from OUTSIDE the daemon.
+
+    The external analog of the daemon's ``restart.mode: "drain"``
+    shutdown — for pulling a crashed, wedged, or SIGKILLed instance out
+    of DNS without waiting for its session timeout.  Connects per the
+    config's own ``zookeeper`` block (like ``verify``) and deletes the
+    config's desired znodes; a shared service node still holding sibling
+    hosts' ephemerals is left in place, exactly as the daemon's own
+    deregistration would.  Exit 0 = drained (deleted nodes printed),
+    2 = unreachable or config invalid.
+    """
+    from registrar_tpu import reconcile
+
+    session = await _config_session(args, "drain")
+    if session is None:
+        return 2
+    cfg, zk = session
+    try:
+        paths = [
+            d.path
+            for d in reconcile.desired_records(
+                cfg.registration, cfg.admin_ip, args.hostname
+            )
+        ]
+        from registrar_tpu.registration import unlink_tolerant
+
+        outcomes = []
+        try:
+            for p in paths:
+                # Already absent, or a shared service node with sibling
+                # hosts still under it: both are fine for an external
+                # drain — the goal is THIS host out of DNS.
+                outcomes.append((p, await unlink_tolerant(zk, p)))
+        except (ZKError, ConnectionError, OSError) as e:
+            print(f"zkcli: drain: {e}", file=sys.stderr)
+            return 2
+    finally:
+        await zk.close()
+    for node, outcome in outcomes:
+        if outcome == "deleted":
+            print(f"deleted {node}")
+        else:
+            why = "already absent" if outcome == "absent" else "shared (kept)"
+            print(f"skipped {node} ({why})")
+    return 0
 
 
 def _resolution_lines(res) -> List[str]:
@@ -927,6 +1044,42 @@ def _register_commands(sub) -> None:
         help="connect budget before reporting unreachable (default 10)",
     )
     p.set_defaults(fn=_cmd_verify, raw=True)
+
+    p = sub.add_parser(
+        "state",
+        help="inspect a registrar handoff state file (restart.stateFile): "
+        "fields + resumability verdict (exit 0 resumable / 1 not / "
+        "2 unreadable); local only, no ZooKeeper connection",
+    )
+    p.add_argument("file", metavar="STATEFILE")
+    p.add_argument(
+        "--config", default=None, metavar="CONFIG",
+        help="also check the state's config fingerprint against this "
+        "registrar config (a mismatched config makes a resume fall back "
+        "to a fresh registration)",
+    )
+    p.set_defaults(fn=_cmd_state, raw=True)
+
+    p = sub.add_parser(
+        "drain",
+        help="deregister a host's records from outside the daemon — pull "
+        "a crashed/wedged instance out of DNS now instead of waiting out "
+        "its session timeout (connects per the config's zookeeper block)",
+    )
+    p.add_argument(
+        "-f", "--file", required=True, metavar="CONFIG",
+        help="registrar config file (the daemon's -f argument)",
+    )
+    p.add_argument(
+        "--hostname", default=None,
+        help="drain this hostname's records (default: this machine's "
+        "hostname)",
+    )
+    p.add_argument(
+        "--timeout", type=float, default=10.0, metavar="SECONDS",
+        help="connect budget before reporting unreachable (default 10)",
+    )
+    p.set_defaults(fn=_cmd_drain, raw=True)
 
     p = sub.add_parser(
         "resolve", help="answer a DNS query the way Binder would"

@@ -185,6 +185,10 @@ class ZKClient(EventEmitter):
         self._rebirth_times: Deque[float] = deque()
         #: an expiry was absorbed; the next successful connect is a rebirth
         self._rebirth_pending = False
+        #: a cross-process handoff resume is staged (seed_session); a
+        #: refused reattach then degrades to a fresh-session handshake
+        #: instead of the terminal session_expired
+        self._resume_pending = False
 
         self.session_id = 0
         self.session_passwd = b"\x00" * 16
@@ -228,6 +232,60 @@ class ZKClient(EventEmitter):
         return f"ZKClient({hosts}, session=0x{self.session_id:x})"
 
     # -- connection management ----------------------------------------------
+
+    def seed_session(
+        self,
+        session_id: int,
+        passwd: bytes,
+        negotiated_timeout_ms: Optional[int] = None,
+        last_zxid: int = 0,
+    ) -> None:
+        """Stage a cross-process session resume (ISSUE 5 handoff).
+
+        The next :meth:`connect` offers ``(session_id, passwd)`` to the
+        server exactly as an in-process reconnect would, reattaching the
+        predecessor's live session — its ephemerals never flickered.  If
+        the server refuses (the session expired in the gap), the client
+        resets to a fresh-session handshake and stays OPEN: the refusing
+        attempt raises :class:`SessionExpiredError`, and the caller's
+        retry loop establishes a brand-new session on the next attempt —
+        never the terminal ``session_expired``.  Callers detect the
+        outcome by comparing ``client.session_id`` to the seed after the
+        connect lands (``resume_refused`` also fires on refusal).
+
+        ``last_zxid`` seeds the ConnectRequest's ``last_zxid_seen``, so a
+        server behind the predecessor's view refuses the reattach the
+        same way it would refuse a too-new in-process reconnect.
+        """
+        if self._connected or self._closed:
+            raise RuntimeError("seed_session requires a fresh, open client")
+        if not isinstance(passwd, bytes) or len(passwd) != 16:
+            raise ValueError("session passwd must be exactly 16 bytes")
+        self.session_id = session_id
+        self.session_passwd = passwd
+        self.last_zxid = last_zxid
+        if negotiated_timeout_ms is not None:
+            # The predecessor's negotiated value sizes the watchdog and
+            # ping cadence correctly from the first connection.
+            self.negotiated_timeout_ms = negotiated_timeout_ms
+        self._resume_pending = True
+
+    async def detach(self) -> None:
+        """Close the transport WITHOUT closing the session (handoff).
+
+        The inverse of :meth:`close`: no CLOSE_SESSION is sent, so the
+        server keeps the session — and every ephemeral it owns — alive
+        for the rest of the negotiated timeout, long enough for a
+        successor process to reattach it via :meth:`seed_session`.  The
+        client object itself is finished (no reconnects, operations fail
+        closed), exactly like close() from the caller's point of view.
+        """
+        if self._closed:
+            return
+        self._closed = True
+        if self._reconnect_task:
+            self._reconnect_task.cancel()
+        await self._teardown(expected=True)
 
     async def connect(self) -> "ZKClient":
         """Connect (or reconnect) to the first reachable server.
@@ -352,6 +410,16 @@ class ZKClient(EventEmitter):
         )
         self.emit("state", "connected")
         self.emit("connect")
+        if self._resume_pending:
+            # Consumed only on full success, like the rebirth marker
+            # above: a drop in the handshake tail leaves the next
+            # attempt still counting as the staged resume.
+            self._resume_pending = False
+            log.warning(
+                "session 0x%x resumed across a process boundary "
+                "(handoff state file)", self.session_id,
+            )
+            self.emit("session_resumed", self.session_id)
         if reborn:
             self._rebirth_pending = False  # consumed only on full success
             self.rebirths += 1
@@ -508,6 +576,23 @@ class ZKClient(EventEmitter):
         session (``session_reborn`` fires from _connect_one).  Otherwise:
         the reference-exact terminal path — closed + ``session_expired``.
         """
+        if self._resume_pending and not self._closed:
+            # A staged handoff resume the server refused: the session
+            # died between the predecessor's detach and now.  Not a
+            # rebirth (this client never held a session), not terminal —
+            # reset to the fresh-session handshake and let the caller's
+            # retry loop register from scratch, the documented fallback.
+            self._resume_pending = False
+            self.session_id = 0
+            self.session_passwd = b"\x00" * 16
+            self.last_zxid = 0
+            log.warning(
+                "handoff session resume refused by the server (session "
+                "expired); falling back to a fresh session"
+            )
+            self.emit("state", "resume_refused")
+            self.emit("resume_refused")
+            return
         if self.survive_session_expiry and not self._closed:
             now = time.monotonic()
             while (
@@ -1421,6 +1506,27 @@ async def create_zk_client(
         survive_session_expiry=survive_session_expiry,
         max_session_rebirths=max_session_rebirths,
     )
+    return await connect_with_backoff(
+        client, on_attempt=on_attempt, retry_policy=retry_policy
+    )
+
+
+async def connect_with_backoff(
+    client: ZKClient,
+    on_attempt=None,
+    retry_policy: Optional[RetryPolicy] = None,
+) -> ZKClient:
+    """The reference's infinite-backoff initial connect, over an existing
+    (possibly :meth:`ZKClient.seed_session`-staged) client.
+
+    Split out of :func:`create_zk_client` for the handoff-resume path
+    (ISSUE 5): the successor constructs and seeds the client itself, but
+    the retry/backoff/logging envelope must be the daemon's usual one —
+    including the case where the seeded reattach is refused mid-pass (the
+    client resets to a fresh handshake and the NEXT attempt here builds
+    the new session; ``SessionExpiredError`` is retryable for an open
+    client).
+    """
 
     def backoff_log(number: int, delay: float, err: Exception) -> None:
         level = (
@@ -1437,7 +1543,13 @@ async def create_zk_client(
             on_attempt(number, delay, err)
 
     await call_with_backoff(
-        client.connect, retry_policy or CONNECT_RETRY, on_backoff=backoff_log
+        client.connect,
+        retry_policy or CONNECT_RETRY,
+        on_backoff=backoff_log,
+        # A refused handoff resume raises SessionExpiredError but leaves
+        # the client OPEN and reset to a fresh handshake: retry.  Only a
+        # closed client (terminal expiry, close()) is unrecoverable.
+        retryable=lambda err: not client.closed,
     )
     log.info("ZK: connected: %s", client)
     return client

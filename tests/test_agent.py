@@ -691,3 +691,353 @@ class TestHealthIntegration:
         finally:
             await client.close()
             await server.stop()
+
+
+class TestResumeManifest:
+    """ISSUE 5: verify-not-recreate when the client reattached a
+    predecessor's live session (register_plus(resume_manifest=...))."""
+
+    SVC_REG = {
+        "domain": DOMAIN,
+        "type": "load_balancer",
+        "service": {
+            "type": "service",
+            "service": {"srvce": "_http", "proto": "_tcp", "port": 80},
+        },
+    }
+
+    async def test_clean_resume_adopts_without_touching_znodes(self):
+        from registrar_tpu.registration import register
+
+        server, client = await _pair()
+        try:
+            nodes = await register(
+                client, self.SVC_REG, admin_ip="10.7.7.7",
+                hostname="agenthost", settle_delay=0,
+            )
+            before = {n: (await client.stat(n)).czxid for n in nodes}
+            outcomes = []
+            ee = _plus(client, registration=self.SVC_REG,
+                       resume_manifest=list(nodes))
+            ee.on("resume", outcomes.append)
+            (znodes,) = await ee.wait_for("register", timeout=10)
+            assert sorted(znodes) == sorted(nodes)
+            assert outcomes == ["reattached"]
+            # zero NO_NODE: nothing was deleted or recreated
+            for n in nodes:
+                assert (await client.stat(n)).czxid == before[n]
+            ee.stop()
+        finally:
+            await client.close()
+            await server.stop()
+
+    async def test_drifted_resume_falls_back_to_the_pipeline(self):
+        from registrar_tpu.registration import register
+
+        server, client = await _pair()
+        try:
+            nodes = await register(
+                client, self.SVC_REG, admin_ip="10.7.7.7",
+                hostname="agenthost", settle_delay=0,
+            )
+            # the host record vanished in the gap: the verify sweep must
+            # catch it and the pipeline must re-register
+            await client.unlink(f"{PATH}/agenthost")
+            outcomes = []
+            ee = _plus(client, registration=self.SVC_REG,
+                       resume_manifest=list(nodes))
+            ee.on("resume", outcomes.append)
+            (znodes,) = await ee.wait_for("register", timeout=10)
+            assert f"{PATH}/agenthost" in znodes
+            assert outcomes == ["repaired"]
+            st = await client.stat(f"{PATH}/agenthost")
+            assert st.ephemeral_owner == client.session_id
+            ee.stop()
+        finally:
+            await client.close()
+            await server.stop()
+
+    async def test_payload_drift_on_resume_repairs_to_contract_bytes(self):
+        from registrar_tpu.registration import register
+
+        server, client = await _pair()
+        try:
+            nodes = await register(
+                client, REGISTRATION, admin_ip="10.7.7.7",
+                hostname="agenthost", settle_delay=0,
+            )
+            want, _ = await client.get(nodes[0])
+            await server.corrupt_node(nodes[0], b'{"evil":1}')
+            ee = _plus(client, resume_manifest=list(nodes))
+            await ee.wait_for("register", timeout=10)
+            got, _ = await client.get(nodes[0])
+            assert got == want
+            ee.stop()
+        finally:
+            await client.close()
+            await server.stop()
+
+
+class TestReload:
+    """ISSUE 5: SIGHUP hot-reload — ee.reload applies only the delta
+    through the single-flight lock; unchanged znodes never flicker."""
+
+    async def test_noop_reload(self):
+        server, client = await _pair()
+        try:
+            ee = _plus(client)
+            await ee.wait_for("register", timeout=10)
+            st = await client.stat(f"{PATH}/agenthost")
+            assert await ee.reload(dict(REGISTRATION), "10.7.7.7") == "noop"
+            # byte-identical desired state: nothing touched at all
+            after = await client.stat(f"{PATH}/agenthost")
+            assert (after.czxid, after.mzxid) == (st.czxid, st.mzxid)
+            ee.stop()
+        finally:
+            await client.close()
+            await server.stop()
+
+    async def test_admin_ip_change_sets_payload_in_place(self):
+        server, client = await _pair()
+        try:
+            ee = _plus(client)
+            await ee.wait_for("register", timeout=10)
+            node = f"{PATH}/agenthost"
+            before = await client.stat(node)
+            assert await ee.reload(dict(REGISTRATION), "10.9.9.9") == "applied"
+            data, after = await client.get(node)
+            # same node (never deleted: czxid unchanged), new bytes
+            assert after.czxid == before.czxid
+            assert after.mzxid > before.mzxid
+            assert parse_payload(data)["load_balancer"]["address"] == "10.9.9.9"
+            ee.stop()
+        finally:
+            await client.close()
+            await server.stop()
+
+    async def test_alias_add_and_remove_is_a_pure_delta(self):
+        server, client = await _pair()
+        try:
+            ee = _plus(client)
+            await ee.wait_for("register", timeout=10)
+            host_node = f"{PATH}/agenthost"
+            host_before = await client.stat(host_node)
+
+            with_alias = dict(REGISTRATION,
+                              aliases=[f"extra.{DOMAIN}"])
+            assert await ee.reload(with_alias, "10.7.7.7") == "applied"
+            alias_node = f"{PATH}/extra"
+            st = await client.stat(alias_node)
+            assert st.ephemeral_owner == client.session_id
+            assert sorted(ee.znodes) == sorted([host_node, alias_node])
+            # the unchanged host record was never deleted or rewritten
+            host_mid = await client.stat(host_node)
+            assert (host_mid.czxid, host_mid.mzxid) == (
+                host_before.czxid, host_before.mzxid
+            )
+
+            assert await ee.reload(dict(REGISTRATION), "10.7.7.7") == "applied"
+            assert await client.exists(alias_node) is None
+            assert ee.znodes == [host_node]
+            host_after = await client.stat(host_node)
+            assert (host_after.czxid, host_after.mzxid) == (
+                host_before.czxid, host_before.mzxid
+            )
+            ee.stop()
+        finally:
+            await client.close()
+            await server.stop()
+
+    async def test_reload_before_registration_raises(self):
+        server, client = await _pair()
+        try:
+            ee = _plus(client, settle_delay=5.0)  # registration in flight
+            try:
+                await ee.reload(dict(REGISTRATION), "10.7.7.7")
+            except RuntimeError as e:
+                assert "cannot reload" in str(e)
+            else:
+                raise AssertionError("reload before registration succeeded")
+            ee.stop()
+        finally:
+            await client.close()
+            await server.stop()
+
+    async def test_reload_while_down_defers_to_recovery(self):
+        server, client = await _pair()
+        try:
+            ee = _plus(client)
+            await ee.wait_for("register", timeout=10)
+            node = f"{PATH}/agenthost"
+            # simulate a health-deregistered host: desired = absent
+            ee.down = True
+            await client.unlink(node)
+            with_alias = dict(REGISTRATION, aliases=[f"down.{DOMAIN}"])
+            assert await ee.reload(with_alias, "10.7.7.7") == "applied"
+            # nothing was written while down...
+            assert await client.exists(f"{PATH}/down") is None
+            assert await client.exists(node) is None
+            ee.stop()
+        finally:
+            await client.close()
+            await server.stop()
+
+    async def test_reloaded_config_drives_later_pipeline_runs(self):
+        # After a reload, every recovery path must register the NEW
+        # records: heartbeat repair re-runs the pipeline through the
+        # shared params holder.
+        server, client = await _pair()
+        try:
+            from registrar_tpu.retry import RetryPolicy
+
+            ee = _plus(
+                client,
+                heartbeat_interval=0.05,
+                heartbeat_retry=RetryPolicy(max_attempts=1),
+                repair_heartbeat_miss=True,
+            )
+            await ee.wait_for("register", timeout=10)
+            assert await ee.reload(dict(REGISTRATION), "10.8.8.8") == "applied"
+            node = f"{PATH}/agenthost"
+            # delete the node out-of-band: heartbeat repair must restore
+            # it with the RELOADED payload, not the boot-time one
+            await client.unlink(node)
+            deadline = asyncio.get_running_loop().time() + 10
+            while True:
+                st = await client.exists(node)
+                if st is not None and st.ephemeral_owner == client.session_id:
+                    break
+                assert asyncio.get_running_loop().time() < deadline
+                await asyncio.sleep(0.05)
+            data, _ = await client.get(node)
+            assert parse_payload(data)["load_balancer"]["address"] == "10.8.8.8"
+            ee.stop()
+        finally:
+            await client.close()
+            await server.stop()
+
+    async def test_reload_shape_change_ephemeral_to_persistent(self):
+        # REVIEW FIX: a path flipping from ephemeral host record to the
+        # persistent service record (alias becomes the service domain)
+        # must be unlink+recreated — a put would set_data the existing
+        # ephemeral and the "service record" would silently die with
+        # the session.
+        server, client = await _pair()
+        try:
+            reg1 = dict(REGISTRATION, aliases=[f"svc.{DOMAIN}"])
+            ee = _plus(client, registration=reg1)
+            await ee.wait_for("register", timeout=10)
+            alias_node = f"{PATH}/svc"
+            st = await client.stat(alias_node)
+            assert st.ephemeral_owner != 0  # host record today
+
+            reg2 = {
+                "domain": f"svc.{DOMAIN}",
+                "type": "load_balancer",
+                "service": {
+                    "type": "service",
+                    "service": {"srvce": "_http", "proto": "_tcp",
+                                "port": 80},
+                },
+            }
+            assert await ee.reload(reg2, "10.7.7.7") == "applied"
+            st = await client.stat(alias_node)
+            assert st.ephemeral_owner == 0, (
+                "service record left ephemeral by the reload"
+            )
+            assert parse_payload(
+                (await client.get(alias_node))[0]
+            )["type"] == "service"
+            host = await client.stat(f"{alias_node}/agenthost")
+            assert host.ephemeral_owner == client.session_id
+            ee.stop()
+        finally:
+            await client.close()
+            await server.stop()
+
+    async def test_reload_retry_after_midapply_failure_is_not_a_noop(self):
+        # REVIEW FIX: a delta that dies mid-apply leaves params already
+        # switched; a retry SIGHUP used to diff new-vs-new and answer
+        # "noop" without touching ZooKeeper.  The retry must re-diff
+        # from the last APPLIED records and finish the job.
+        server, client = await _pair()
+        try:
+            ee = _plus(client)
+            await ee.wait_for("register", timeout=10)
+            with_alias = dict(REGISTRATION, aliases=[f"retry.{DOMAIN}"])
+
+            real_create = client.create_ephemeral_plus
+            boom = {"armed": True}
+
+            async def failing_create(path, data=b""):
+                if boom["armed"]:
+                    boom["armed"] = False
+                    raise ConnectionError("wire died mid-delta")
+                return await real_create(path, data)
+
+            client.create_ephemeral_plus = failing_create
+            try:
+                await ee.reload(with_alias, "10.7.7.7")
+            except ConnectionError:
+                pass
+            else:
+                raise AssertionError("fault never fired")
+            assert await client.exists(f"{PATH}/retry") is None
+
+            # the retry must APPLY (not "noop") and create the alias
+            assert await ee.reload(with_alias, "10.7.7.7") == "applied"
+            st = await client.stat(f"{PATH}/retry")
+            assert st.ephemeral_owner == client.session_id
+            assert sorted(ee.znodes) == sorted(
+                [f"{PATH}/agenthost", f"{PATH}/retry"]
+            )
+            ee.stop()
+        finally:
+            await client.close()
+            await server.stop()
+
+    async def test_reload_revert_after_failure_cleans_partial_state(self):
+        # REVIEW FIX: a forward delta A->B dies after creating one of
+        # B's new nodes; the operator reverts the config to A.  The
+        # revert must NOT read as "noop" (base == A) — the half-created
+        # B node is in an unknown state and has to be cleaned, or it
+        # serves stale DNS for as long as the session lives.
+        server, client = await _pair()
+        try:
+            ee = _plus(client)
+            await ee.wait_for("register", timeout=10)
+            cfg_b = dict(REGISTRATION, aliases=[
+                f"b1.{DOMAIN}", f"b2.{DOMAIN}",
+            ])
+
+            real_create = client.create_ephemeral_plus
+            async def failing_create(path, data=b""):
+                if path.endswith("/b2"):
+                    raise ConnectionError("wire died mid-delta")
+                return await real_create(path, data)
+
+            client.create_ephemeral_plus = failing_create
+            try:
+                await ee.reload(cfg_b, "10.7.7.7")
+            except ConnectionError:
+                pass
+            else:
+                raise AssertionError("fault never fired")
+            client.create_ephemeral_plus = real_create
+            # partial state: b1 landed, b2 did not
+            assert await client.exists(f"{PATH}/b1") is not None
+            assert await client.exists(f"{PATH}/b2") is None
+
+            # revert to A: must APPLY and remove the stray b1
+            assert await ee.reload(dict(REGISTRATION), "10.7.7.7") == "applied"
+            assert await client.exists(f"{PATH}/b1") is None
+            assert ee.znodes == [f"{PATH}/agenthost"]
+            st = await client.stat(f"{PATH}/agenthost")
+            assert st.ephemeral_owner == client.session_id
+            # and the agent is back in sync: the next identical reload
+            # really is a noop
+            assert await ee.reload(dict(REGISTRATION), "10.7.7.7") == "noop"
+            ee.stop()
+        finally:
+            await client.close()
+            await server.stop()

@@ -387,7 +387,16 @@ async def test_chaos_churn_converges():
 
 class _RebornWorker:
     """One full agent stack (register_plus + surviveSessionExpiry client +
-    repairing reconciler) riding out the expiry storm in-process."""
+    repairing reconciler) riding out the expiry storm in-process.
+
+    The ISSUE 5 rider adds :meth:`restart` — the in-process analog of
+    "SIGTERM + relaunch" mid-storm, in both restart modes: ``handoff``
+    detaches the live session and the successor agent reattaches it
+    (``seed_session``) and verifies-not-recreates; ``drain`` unregisters,
+    closes, and the successor registers fresh.  A handoff whose session
+    the storm expired in the gap is refused by the server and must
+    degrade to a fresh registration — never to a terminal expiry.
+    """
 
     def __init__(self, i: int, addresses):
         self.i = i
@@ -399,8 +408,15 @@ class _RebornWorker:
         #: terminal session_expired events — the "process exit" analog
         #: (main.py's _die fires exactly on this event)
         self.terminal_expiries = 0
+        self.restarts = 0
+        self.resumed_restarts = 0
+        self._restarting = False
 
-    async def start(self) -> None:
+    async def start(self, resume=None) -> None:
+        """``resume``: a predecessor's ``(session_id, passwd,
+        negotiated_timeout_ms, last_zxid, znodes)`` handoff tuple."""
+        from registrar_tpu.retry import call_with_backoff
+
         self.client = ZKClient(
             self.addresses,
             timeout_ms=8000,
@@ -412,7 +428,25 @@ class _RebornWorker:
             max_session_rebirths=10_000,
             reconnect_policy=FAST_RECONNECT,
         )
-        await self.client.connect()
+        manifest = None
+        if resume is not None:
+            sid, passwd, timeout_ms, zxid, znodes = resume
+            self.client.seed_session(
+                sid, passwd, negotiated_timeout_ms=timeout_ms,
+                last_zxid=zxid,
+            )
+            await call_with_backoff(
+                self.client.connect, FAST_RECONNECT,
+                retryable=lambda _e: not self.client.closed,
+            )
+            if self.client.session_id == sid:
+                manifest = list(znodes)
+                self.resumed_restarts += 1
+        else:
+            await call_with_backoff(
+                self.client.connect, FAST_RECONNECT,
+                retryable=lambda _e: not self.client.closed,
+            )
 
         def on_terminal(*_a):
             self.terminal_expiries += 1
@@ -433,8 +467,56 @@ class _RebornWorker:
                 jitter="decorrelated",
             ),
             reconcile={"interval_seconds": 0.1, "repair": True},
+            resume_manifest=manifest,
         )
         await self.ee.wait_for("register", timeout=10)
+
+    async def restart(self, mode: str) -> None:
+        """SIGTERM + relaunch, in-process: stop the agent, hand off or
+        drain per ``mode``, then bring up a successor agent — retrying
+        until it lands (a "supervisor" that keeps relaunching; the
+        convergence assertion owns the overall deadline)."""
+        if self._restarting:
+            return
+        self._restarting = True
+        try:
+            self.restarts += 1
+            ee, client = self.ee, self.client
+            znodes = list(ee.znodes)
+            ee.stop()
+            resume = None
+            if mode == "handoff" and not client.closed and client.session_id:
+                resume = (
+                    client.session_id, client.session_passwd,
+                    client.negotiated_timeout_ms, client.last_zxid, znodes,
+                )
+                await client.detach()
+            else:
+                try:
+                    if not client.closed and znodes:
+                        await unregister(client, znodes)
+                except (ZKError, ConnectionError, OSError):
+                    pass  # mid-storm: the successor's cleanup reconciles
+                if not client.closed:
+                    await client.close()
+            while True:
+                try:
+                    await self.start(resume=resume)
+                    return
+                except asyncio.CancelledError:
+                    raise
+                except Exception:  # noqa: BLE001 - relaunch like a supervisor
+                    resume = None  # one resume attempt, then fresh
+                    if self.ee is not None:
+                        self.ee.stop()
+                    if self.client is not None and not self.client.closed:
+                        try:
+                            await self.client.close()
+                        except Exception:  # noqa: BLE001
+                            pass
+                    await asyncio.sleep(0.2)
+        finally:
+            self._restarting = False
 
     async def stop(self) -> None:
         if self.ee is not None:
@@ -495,6 +577,7 @@ async def test_chaos_storm_forced_expiry_survived_in_process():
         try:
             stop = asyncio.Event()
             events: list = []
+            restart_tasks: list = []
 
             async def expiry_storm() -> None:
                 while not stop.is_set():
@@ -505,7 +588,7 @@ async def test_chaos_storm_forced_expiry_survived_in_process():
                     ]
                     dead = [i for i in range(ENSEMBLE) if i not in live]
                     roll = rng.random()
-                    if roll < 0.5 and live:
+                    if roll < 0.4 and live:
                         # THE event under test: a forced session expiry
                         sids = sorted(
                             s.session_id
@@ -518,14 +601,28 @@ async def test_chaos_storm_forced_expiry_survived_in_process():
                                 sids[idx]
                             )
                             events.append(("expire", idx))
-                    elif roll < 0.65 and len(live) > 1:
+                    elif roll < 0.55 and len(live) > 1:
                         i = rng.choice(live)
                         await ens.kill(i)
                         events.append(("kill", i))
-                    elif roll < 0.85 and dead:
+                    elif roll < 0.7 and dead:
                         i = rng.choice(dead)
                         await ens.restart(i)
                         events.append(("restart", i))
+                    elif roll < 0.85:
+                        # ISSUE 5 rider: SIGTERM + relaunch a random
+                        # fleet member mid-storm, alternating restart
+                        # modes — handoffs that get force-expired in
+                        # the gap exercise the refused-resume fallback.
+                        i = rng.randrange(N_WORKERS)
+                        mode = "handoff" if rng.random() < 0.5 else "drain"
+                        if not workers[i]._restarting:
+                            restart_tasks.append(
+                                asyncio.create_task(
+                                    workers[i].restart(mode)
+                                )
+                            )
+                            events.append(("agent-restart", i, mode))
                     elif live:
                         i = rng.choice(live)
                         await ens.servers[i].drop_connections()
@@ -539,7 +636,12 @@ async def test_chaos_storm_forced_expiry_survived_in_process():
             stop.set()
             await storm
             await cache_task
+            # every mid-storm restart must complete (its "supervisor"
+            # loop keeps relaunching until the successor registers)
+            if restart_tasks:
+                await asyncio.gather(*restart_tasks)
             assert any(ev[0] == "expire" for ev in events), events
+            assert any(ev[0] == "agent-restart" for ev in events), events
             assert cache_resolves["ok"] > 0, "cache never answered in-storm"
 
             # -- convergence: exact §2.6 contract, in-process ------------
@@ -568,9 +670,13 @@ async def test_chaos_storm_forced_expiry_survived_in_process():
                 assert not w.client.closed
             total_rebirths = sum(w.client.rebirths for w in workers)
             expiries = sum(1 for ev in events if ev[0] == "expire")
+            agent_restarts = sum(w.restarts for w in workers)
+            resumed = sum(w.resumed_restarts for w in workers)
             print(
                 f"expiry storm: {expiries} forced expiries, "
-                f"{total_rebirths} rebirths, {len(events)} faults",
+                f"{total_rebirths} rebirths, {agent_restarts} agent "
+                f"restarts ({resumed} session handoffs resumed), "
+                f"{len(events)} faults",
                 file=sys.stderr,
             )
 

@@ -356,3 +356,59 @@ class TestCacheBlock:
             "cache": {"maxEntries": 64},
         })
         assert "cache" not in cfg.unknown_keys
+
+
+class TestRestartBlock:
+    """ISSUE 5: the `restart` config block."""
+
+    BASE = {
+        "registration": {"domain": "a.b", "type": "host"},
+        "zookeeper": {"servers": [{"host": "h", "port": 1}]},
+    }
+
+    def _parse(self, restart):
+        return parse_config({**self.BASE, "restart": restart})
+
+    def test_handoff_defaults(self):
+        cfg = self._parse({"stateFile": "/var/run/registrar/state.json"})
+        assert cfg.restart.state_file == "/var/run/registrar/state.json"
+        assert cfg.restart.mode == "handoff"
+        assert cfg.restart.drain_grace_s == 0.0
+
+    def test_drain_with_grace(self):
+        cfg = self._parse({"stateFile": "/s", "mode": "drain",
+                           "drainGraceSeconds": 2.5})
+        assert cfg.restart.mode == "drain"
+        assert cfg.restart.drain_grace_s == 2.5
+
+    def test_absent_block_means_off(self):
+        assert parse_config(self.BASE).restart is None
+
+    def test_state_file_required(self):
+        with pytest.raises(ConfigError, match="stateFile"):
+            self._parse({"mode": "handoff"})
+        with pytest.raises(ConfigError, match="stateFile"):
+            self._parse({"stateFile": ""})
+
+    def test_mode_must_be_known(self):
+        with pytest.raises(ConfigError, match="mode"):
+            self._parse({"stateFile": "/s", "mode": "yolo"})
+
+    def test_grace_must_be_non_negative_number(self):
+        with pytest.raises(ConfigError, match="drainGraceSeconds"):
+            self._parse({"stateFile": "/s", "drainGraceSeconds": -1})
+        with pytest.raises(ConfigError, match="drainGraceSeconds"):
+            self._parse({"stateFile": "/s", "drainGraceSeconds": True})
+
+    def test_block_must_be_object(self):
+        with pytest.raises(ConfigError, match="restart"):
+            self._parse("handoff")
+
+    def test_source_path_recorded_by_load_config(self, tmp_path):
+        import json as json_mod
+
+        path = tmp_path / "c.json"
+        path.write_text(json_mod.dumps(self.BASE))
+        cfg = load_config(str(path))
+        assert cfg.source_path == str(path)
+        assert parse_config(self.BASE).source_path is None

@@ -376,3 +376,82 @@ class TestLoop:
         hc.start()
         assert hc._task is task
         hc.stop()
+
+
+class TestProcessGroupKill:
+    """ISSUE 5 satellite: timeout kills reach the whole process GROUP.
+
+    Pre-fix, only the shell got terminate()/kill(): a grandchild the
+    shell spawned survived every escalation (and held the output pipes
+    open past the reap) — a health command leak per timeout, forever.
+    """
+
+    async def test_timeout_reaps_trap_ignoring_grandchild(self, tmp_path):
+        import os
+        import sys
+        import time as time_mod
+
+        pidfile = tmp_path / "grandchild.pid"
+        script = (
+            "import os, signal, time; "
+            "signal.signal(signal.SIGTERM, signal.SIG_IGN); "
+            f"open({str(pidfile)!r}, 'w').write(str(os.getpid())); "
+            "time.sleep(30)"
+        )
+        # background + wait: the python process is a GRANDchild of the
+        # health shell (same process group), not the shell itself
+        command = f'{sys.executable} -c "{script}" & wait'
+        check = HealthCheck(command=command, timeout=0.5, interval=60)
+        record = await check.check_once()
+        assert record["type"] == "fail"
+        assert "timed out" in str(record["err"])
+        assert pidfile.exists(), "grandchild never started"
+        pid = int(pidfile.read_text())
+
+        deadline = time_mod.monotonic() + 5
+        while time_mod.monotonic() < deadline:
+            try:
+                os.kill(pid, 0)
+            except ProcessLookupError:
+                break  # reaped: the group SIGKILL reached it
+            await asyncio.sleep(0.05)
+        else:
+            try:
+                os.kill(pid, 9)  # do not leak it out of the test either
+            except ProcessLookupError:
+                pass
+            raise AssertionError(
+                "SIGTERM-ignoring grandchild survived the timeout kill"
+            )
+
+    async def test_output_cap_kill_also_hits_the_group(self, tmp_path):
+        import os
+        import sys
+        import time as time_mod
+
+        pidfile = tmp_path / "grandchild.pid"
+        script = (
+            "import os, signal, sys, time; "
+            "signal.signal(signal.SIGTERM, signal.SIG_IGN); "
+            f"open({str(pidfile)!r}, 'w').write(str(os.getpid())); "
+            "sys.stdout.write('x' * (2 * 1024 * 1024)); "
+            "sys.stdout.flush(); time.sleep(30)"
+        )
+        command = f'{sys.executable} -c "{script}" & wait'
+        check = HealthCheck(command=command, timeout=5.0, interval=60)
+        record = await check.check_once()
+        assert record["type"] == "fail"
+        pid = int(pidfile.read_text())
+        deadline = time_mod.monotonic() + 8
+        while time_mod.monotonic() < deadline:
+            try:
+                os.kill(pid, 0)
+            except ProcessLookupError:
+                break
+            await asyncio.sleep(0.05)
+        else:
+            try:
+                os.kill(pid, 9)
+            except ProcessLookupError:
+                pass
+            raise AssertionError("runaway grandchild survived the cap kill")

@@ -412,3 +412,88 @@ class TestEndToEnd:
         finally:
             await observer.close()
             await server.stop()
+
+
+class TestGracefulStopOrdering:
+    """ISSUE 5 satellite: the shutdown sequence is ordered — health
+    checking stops first (no transition may race the exit), then the
+    deregistration, then the client close, then the exit code."""
+
+    async def test_drain_stop_runs_health_deregister_close_in_order(
+        self, tmp_path, monkeypatch
+    ):
+        from registrar_tpu import main as main_mod
+        from registrar_tpu.agent import RegistrarEvents
+        from registrar_tpu.config import parse_config
+        from registrar_tpu.main import run
+
+        server = await ZKServer().start()
+        observer = await ZKClient([server.address]).connect()
+        order = []
+
+        real_stop = RegistrarEvents.stop
+
+        def rec_stop(self):
+            order.append("health-stop")
+            return real_stop(self)
+
+        monkeypatch.setattr(RegistrarEvents, "stop", rec_stop)
+
+        real_unreg = main_mod._drain_unregister
+
+        async def rec_unreg(zk, nodes, lg):
+            order.append("deregister")
+            return await real_unreg(zk, nodes, lg)
+
+        monkeypatch.setattr(main_mod, "_drain_unregister", rec_unreg)
+
+        real_close = ZKClient.close
+
+        async def rec_close(self):
+            order.append("close")
+            return await real_close(self)
+
+        monkeypatch.setattr(ZKClient, "close", rec_close)
+
+        cfg = parse_config({
+            "registration": {"domain": "order.e2e.registrar",
+                             "type": "host",
+                             "heartbeatInterval": 100},
+            "adminIp": "10.66.66.70",
+            "zookeeper": {
+                "servers": [{"host": server.host, "port": server.port}],
+                "timeout": 10000,
+            },
+            "healthCheck": {"command": "true", "interval": 60000},
+            "restart": {"stateFile": str(tmp_path / "s.json"),
+                        "mode": "drain"},
+        })
+        task = asyncio.create_task(
+            run(cfg, _exit=lambda c: order.append(("exit", c)))
+        )
+        try:
+            node = f"/registrar/e2e/order/{socket.gethostname()}"
+            for _ in range(200):
+                if await observer.exists(node):
+                    break
+                await asyncio.sleep(0.05)
+            else:
+                raise AssertionError("znode never appeared")
+            os.kill(os.getpid(), signal.SIGTERM)
+            await asyncio.wait_for(task, timeout=15)
+            order.append("returned")
+            assert order == [
+                "health-stop", "deregister", "close", "returned",
+            ]
+            # clean exit: code 0 means _exit was never invoked
+            assert ("exit", 1) not in order
+            assert await observer.exists(node) is None
+        finally:
+            if not task.done():
+                task.cancel()
+                try:
+                    await task
+                except asyncio.CancelledError:
+                    pass
+            await observer.close()
+            await server.stop()

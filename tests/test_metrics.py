@@ -420,3 +420,56 @@ class TestCacheInstrumentation:
         finally:
             await client.close()
             await server.stop()
+
+
+class TestRestartInstrumentation:
+    """ISSUE 5: handoff/resume/reload counters, all pre-seeded."""
+
+    async def test_restart_counters_wired_and_pre_seeded(self):
+        from registrar_tpu.agent import register_plus
+        from registrar_tpu.metrics import instrument
+        from registrar_tpu.testing.server import ZKServer
+        from registrar_tpu.zk.client import ZKClient
+
+        server = await ZKServer().start()
+        client = await ZKClient([server.address]).connect()
+        try:
+            ee = register_plus(
+                client, {"domain": "m.test.us", "type": "host"},
+                admin_ip="10.1.1.1", hostname="mbox", settle_delay=0.01,
+            )
+            reg = instrument(ee, client)
+            await ee.wait_for("register", timeout=10)
+
+            # every series exists at zero before any event fires
+            text = reg.render()
+            for line in (
+                'registrar_session_resumes_total{outcome="reattached"} 0',
+                'registrar_session_resumes_total{outcome="repaired"} 0',
+                'registrar_session_resumes_total{outcome="fresh"} 0',
+                'registrar_config_reloads_total{result="applied"} 0',
+                'registrar_config_reloads_total{result="noop"} 0',
+                'registrar_config_reloads_total{result="failed"} 0',
+                "registrar_handoffs_total 0",
+                "registrar_drains_total 0",
+            ):
+                assert line in text, line
+
+            ee.emit("resume", "reattached")
+            ee.emit("resume", "fresh")
+            ee.emit("configReload", "applied")
+            ee.emit("configReload", "failed")
+            ee.emit("handoff", "/var/run/state.json")
+            ee.emit("drain", ["/m/test"])
+            text = reg.render()
+            assert 'registrar_session_resumes_total{outcome="reattached"} 1' in text
+            assert 'registrar_session_resumes_total{outcome="fresh"} 1' in text
+            assert 'registrar_session_resumes_total{outcome="repaired"} 0' in text
+            assert 'registrar_config_reloads_total{result="applied"} 1' in text
+            assert 'registrar_config_reloads_total{result="failed"} 1' in text
+            assert "registrar_handoffs_total 1" in text
+            assert "registrar_drains_total 1" in text
+            ee.stop()
+        finally:
+            await client.close()
+            await server.stop()

@@ -575,3 +575,54 @@ class TestCacheThroughToxics:
             cache.close()
             await writer.close()
             await _shutdown(server, proxy, client)
+
+
+class TestHandoffThroughWireFaults:
+    """ISSUE 5 rider: the cross-process session resume must land through
+    a faulty wire — the exact moment a deploy restarts the daemon is
+    also the moment ops least want a flaky network to demote the
+    zero-downtime path to a re-registration blip."""
+
+    async def test_seeded_resume_lands_through_resets(self):
+        from registrar_tpu.retry import call_with_backoff
+
+        server, proxy, client = await _proxied_pair(timeout_ms=10000)
+        successor = None
+        try:
+            await client.create("/ho-netem", b"x", CreateFlag.EPHEMERAL)
+            sid, passwd = client.session_id, client.session_passwd
+            timeout_ms = client.negotiated_timeout_ms
+            zxid = client.last_zxid
+            await client.detach()
+
+            # the wire RSTs every connection while the successor starts:
+            # its seeded connect must keep retrying (the session seed is
+            # NOT consumed by failed attempts) and, once the fault
+            # clears, still reattach the SAME session inside its timeout
+            toxic = proxy.add(ResetAfter(n=0), direction=UP)
+            successor = ZKClient(
+                [proxy.address], timeout_ms=10000,
+                connect_timeout_ms=500, reconnect_policy=FAST,
+            )
+            successor.seed_session(
+                sid, passwd, negotiated_timeout_ms=timeout_ms,
+                last_zxid=zxid,
+            )
+            connect = asyncio.create_task(
+                call_with_backoff(
+                    successor.connect, FAST,
+                    retryable=lambda _e: not successor.closed,
+                )
+            )
+            await asyncio.sleep(0.5)  # several attempts die on the RST
+            assert not connect.done()
+            proxy.remove(toxic)
+            await asyncio.wait_for(connect, timeout=8)
+            assert successor.session_id == sid
+            st = await successor.stat("/ho-netem")
+            assert st.ephemeral_owner == sid
+            proxy.remove(toxic)
+            assert _orphan_ephemerals(server) == []
+        finally:
+            await _shutdown(server, proxy,
+                            *( [successor] if successor else [] ))

@@ -1,0 +1,574 @@
+"""Zero-downtime restart e2e (ISSUE 5): handoff, drain, and fallbacks.
+
+The headline acceptance test: a resolver polling through an agent
+restart in handoff mode observes ZERO NO_NODE answers — the successor
+process reattaches the predecessor's ZooKeeper session from the state
+file and verifies (not recreates) the registration.  Drain mode's
+bounded gap, the second-signal escape hatch, the SIGHUP hot reload, and
+every degraded statefile shape (stale stamp, passwd tamper, config-hash
+mismatch, expired reattach — each must land in a clean fresh-session
+registration) are pinned alongside.
+
+In-process tests drive ``main.run`` directly against the testing server
+(signals delivered to our own pid — the loop's handlers catch them);
+subprocess tests run the real daemon for the exit-code/relaunch shapes.
+`make restart-e2e` runs this module in CI's chaos job.
+"""
+
+import asyncio
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+import time
+
+from registrar_tpu import statefile
+from registrar_tpu.config import parse_config
+from registrar_tpu.main import EX_FORCED, run
+from registrar_tpu.statefile import SessionState
+from registrar_tpu.testing.server import ZKServer
+from registrar_tpu.zk.client import ZKClient
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+HOSTNAME = socket.gethostname()
+DOMAIN = "ho.e2e.registrar"
+PATH = "/registrar/e2e/ho"
+NODE = f"{PATH}/{HOSTNAME}"
+
+
+def _cfg_dict(server, state_file, mode="handoff", grace=0, **over):
+    cfg = {
+        "registration": {
+            "domain": DOMAIN,
+            "type": "load_balancer",
+            "heartbeatInterval": 100,
+        },
+        "adminIp": "10.66.77.88",
+        "zookeeper": {
+            "servers": [{"host": server.host, "port": server.port}],
+            "timeout": 10000,
+        },
+        "restart": {
+            "stateFile": str(state_file),
+            "mode": mode,
+            "drainGraceSeconds": grace,
+        },
+    }
+    cfg.update(over)
+    return cfg
+
+
+class _Poller:
+    """Existence poller standing in for a Binder resolver: every tick it
+    asks "is the host record there?" and records each NO_NODE answer."""
+
+    def __init__(self, observer, node):
+        self.observer = observer
+        self.node = node
+        self.misses = 0
+        self.checks = 0
+        self.owners = set()
+        self._stop = asyncio.Event()
+        self._task = None
+
+    def start(self):
+        self._task = asyncio.create_task(self._loop())
+        return self
+
+    async def _loop(self):
+        while not self._stop.is_set():
+            st = await self.observer.exists(self.node)
+            self.checks += 1
+            if st is None:
+                self.misses += 1
+            else:
+                self.owners.add(st.ephemeral_owner)
+            await asyncio.sleep(0.01)
+
+    async def stop(self):
+        self._stop.set()
+        if self._task is not None:
+            await self._task
+
+
+async def _wait_for(pred, timeout=20, interval=0.05):
+    deadline = asyncio.get_running_loop().time() + timeout
+    while True:
+        result = await pred()
+        if result:
+            return result
+        assert asyncio.get_running_loop().time() < deadline, "timed out"
+        await asyncio.sleep(interval)
+
+
+class TestHandoffInProcess:
+    async def test_sigterm_handoff_then_resume_zero_no_node_window(
+        self, tmp_path
+    ):
+        # THE tentpole behavior, in-process: SIGTERM persists the state
+        # and detaches; the successor reattaches the same session and
+        # verifies in place; the observer never once sees the node gone.
+        server = await ZKServer(max_session_timeout_ms=30000).start()
+        observer = await ZKClient([server.address]).connect()
+        state_path = tmp_path / "state.json"
+        cfg = parse_config(_cfg_dict(server, state_path))
+        task2 = None
+        try:
+            task1 = asyncio.create_task(run(cfg, _exit=lambda c: None))
+            await _wait_for(lambda: observer.exists(NODE))
+            sid0 = (await observer.stat(NODE)).ephemeral_owner
+            assert sid0 != 0
+
+            poller = _Poller(observer, NODE).start()
+            os.kill(os.getpid(), signal.SIGTERM)
+            await asyncio.wait_for(task1, timeout=15)
+
+            # predecessor is gone, its statefile and ephemerals are not
+            state = statefile.load(str(state_path))
+            assert state.session_id == sid0
+            assert NODE in state.znodes
+            assert (await observer.stat(NODE)).ephemeral_owner == sid0
+            stamp0 = state.stamp
+
+            cfg2 = parse_config(_cfg_dict(server, state_path))
+            task2 = asyncio.create_task(run(cfg2, _exit=lambda c: None))
+            # the successor rewrites the statefile when it registers
+            await _wait_for(
+                lambda: asyncio.sleep(
+                    0, statefile.load(str(state_path)).stamp != stamp0
+                ),
+                timeout=15,
+            )
+            await asyncio.sleep(0.3)  # a few heartbeats through the poller
+            await poller.stop()
+
+            assert poller.checks > 10
+            assert poller.misses == 0, (
+                f"resolver saw {poller.misses} NO_NODE answers across a "
+                "handoff restart"
+            )
+            # ... and it was the SAME session the whole way through
+            assert poller.owners == {sid0}
+            assert (await observer.stat(NODE)).ephemeral_owner == sid0
+        finally:
+            if task2 is not None:
+                task2.cancel()
+                try:
+                    await task2
+                except asyncio.CancelledError:
+                    pass
+            await observer.close()
+            await server.stop()
+
+    async def test_drain_mode_unregisters_then_exits(self, tmp_path):
+        server = await ZKServer(max_session_timeout_ms=30000).start()
+        observer = await ZKClient([server.address]).connect()
+        state_path = tmp_path / "state.json"
+        cfg = parse_config(
+            _cfg_dict(server, state_path, mode="drain", grace=0.3)
+        )
+        try:
+            task = asyncio.create_task(run(cfg, _exit=lambda c: None))
+            await _wait_for(lambda: observer.exists(NODE))
+            t0 = asyncio.get_running_loop().time()
+            os.kill(os.getpid(), signal.SIGTERM)
+            # the node is deregistered promptly, not via session timeout
+            await _wait_for(
+                lambda: _absent(observer, NODE), timeout=10
+            )
+            await asyncio.wait_for(task, timeout=15)
+            elapsed = asyncio.get_running_loop().time() - t0
+            # ...and the exit respected drainGraceSeconds
+            assert elapsed >= 0.3
+            # a drained session has nothing to hand off
+            assert not state_path.exists()
+        finally:
+            await observer.close()
+            await server.stop()
+
+
+async def _absent(observer, node):
+    return await observer.exists(node) is None
+
+
+class TestDrainResilience:
+    async def test_drain_continues_past_an_already_absent_node(
+        self, tmp_path
+    ):
+        # REVIEW FIX: the drain walk must not abort on the first NO_NODE
+        # (a node deleted out-of-band) — every remaining LIVE record has
+        # to leave DNS before the process exits.
+        server = await ZKServer(max_session_timeout_ms=30000).start()
+        observer = await ZKClient([server.address]).connect()
+        reg = {
+            "domain": DOMAIN,
+            "type": "load_balancer",
+            "aliases": ["two.e2e.registrar"],
+            "heartbeatInterval": 60000,  # no repair racing the test
+        }
+        cfg = parse_config(_cfg_dict(
+            server, tmp_path / "state.json", mode="drain",
+            registration=reg,
+        ))
+        alias_node = "/registrar/e2e/two"
+        try:
+            task = asyncio.create_task(run(cfg, _exit=lambda c: None))
+            await _wait_for(lambda: observer.exists(NODE))
+            await _wait_for(lambda: observer.exists(alias_node))
+            # the FIRST node in the owned list vanishes out-of-band
+            await observer.unlink(NODE)
+            os.kill(os.getpid(), signal.SIGTERM)
+            await asyncio.wait_for(task, timeout=15)
+            # the walk kept going: the alias left DNS too
+            assert await observer.exists(alias_node) is None
+        finally:
+            await observer.close()
+            await server.stop()
+
+
+class TestResumeFallbacks:
+    """Every degraded statefile shape lands in a clean fresh-session
+    registration (the acceptance list, one test per branch)."""
+
+    async def _run_and_expect_fresh(self, server, cfg, not_owner):
+        observer = await ZKClient([server.address]).connect()
+        task = asyncio.create_task(run(cfg, _exit=lambda c: None))
+        try:
+            await _wait_for(lambda: observer.exists(NODE))
+            st = await observer.stat(NODE)
+            assert st.ephemeral_owner != 0
+            assert st.ephemeral_owner != not_owner
+            data, _ = await observer.get(NODE)
+            rec = json.loads(data)
+            assert rec["load_balancer"]["address"] == "10.66.77.88"
+            assert not task.done(), "daemon died instead of falling back"
+        finally:
+            task.cancel()
+            try:
+                await task
+            except asyncio.CancelledError:
+                pass
+            await observer.close()
+
+    def _fingerprint(self, cfg):
+        return statefile.config_fingerprint(
+            cfg.registration, cfg.admin_ip, cfg.zookeeper.chroot
+        )
+
+    def _state(self, cfg, **over):
+        base = dict(
+            session_id=0xDEAD1234,
+            passwd=b"\x05" * 16,
+            negotiated_timeout_ms=10000,
+            last_zxid=0,
+            chroot="",
+            config_hash=self._fingerprint(cfg),
+            znodes=[NODE],
+            pid=99999,
+            stamp=time.time(),
+        )
+        base.update(over)
+        return SessionState(**base)
+
+    async def test_stale_stamp_falls_back_fresh(self, tmp_path):
+        server = await ZKServer().start()
+        try:
+            state_path = tmp_path / "state.json"
+            cfg = parse_config(_cfg_dict(server, state_path))
+            statefile.save(
+                str(state_path),
+                self._state(cfg, stamp=time.time() - 60.0),
+            )
+            await self._run_and_expect_fresh(server, cfg, 0xDEAD1234)
+        finally:
+            await server.stop()
+
+    async def test_config_hash_mismatch_falls_back_fresh(self, tmp_path):
+        server = await ZKServer().start()
+        try:
+            state_path = tmp_path / "state.json"
+            cfg = parse_config(_cfg_dict(server, state_path))
+            statefile.save(
+                str(state_path),
+                self._state(cfg, config_hash="not-this-config"),
+            )
+            await self._run_and_expect_fresh(server, cfg, 0xDEAD1234)
+        finally:
+            await server.stop()
+
+    async def test_tampered_passwd_falls_back_fresh(self, tmp_path):
+        server = await ZKServer().start()
+        try:
+            state_path = tmp_path / "state.json"
+            cfg = parse_config(_cfg_dict(server, state_path))
+            statefile.save(str(state_path), self._state(cfg))
+            raw = json.loads(state_path.read_text())
+            raw["passwd"] = "c2hvcnQ="  # "short": not 16 bytes
+            state_path.write_text(json.dumps(raw))
+            await self._run_and_expect_fresh(server, cfg, 0xDEAD1234)
+        finally:
+            await server.stop()
+
+    async def test_foreign_file_falls_back_fresh(self, tmp_path):
+        server = await ZKServer().start()
+        try:
+            state_path = tmp_path / "state.json"
+            state_path.write_text('{"something": "else entirely"}')
+            cfg = parse_config(_cfg_dict(server, state_path))
+            await self._run_and_expect_fresh(server, cfg, 0xDEAD1234)
+        finally:
+            await server.stop()
+
+    async def test_expired_session_reattach_refused_falls_back_fresh(
+        self, tmp_path
+    ):
+        # The statefile is perfectly valid — but the session it names
+        # died in the gap.  The server's refusal must degrade to a fresh
+        # session + registration, never to the terminal session_expired.
+        server = await ZKServer().start()
+        try:
+            state_path = tmp_path / "state.json"
+            cfg = parse_config(_cfg_dict(server, state_path))
+            pre = await ZKClient(
+                [server.address], timeout_ms=10000
+            ).connect()
+            sid = pre.session_id
+            statefile.save(
+                str(state_path),
+                self._state(
+                    cfg,
+                    session_id=sid,
+                    passwd=pre.session_passwd,
+                    negotiated_timeout_ms=pre.negotiated_timeout_ms,
+                ),
+            )
+            await pre.close()  # the session is gone server-side
+            await self._run_and_expect_fresh(server, cfg, sid)
+        finally:
+            await server.stop()
+
+
+def _spawn_daemon(cfg_path, stdout=subprocess.PIPE):
+    return subprocess.Popen(
+        [sys.executable, "-m", "registrar_tpu", "-f", str(cfg_path)],
+        cwd=REPO, stdout=stdout, stderr=subprocess.STDOUT,
+        env={**os.environ, "PYTHONPATH": REPO, "LOG_LEVEL": "info"},
+    )
+
+
+class TestHandoffSubprocess:
+    async def test_real_daemon_restart_has_zero_no_node_window(
+        self, tmp_path
+    ):
+        # The ISSUE's headline, with the real daemon binary: resolver
+        # polls through SIGTERM + relaunch; zero NO_NODE, same session,
+        # and the successor's reconciler sweeps report zero drift.
+        server = await ZKServer(max_session_timeout_ms=30000).start()
+        observer = await ZKClient([server.address]).connect()
+        state_path = tmp_path / "state.json"
+        cfg_path = tmp_path / "config.json"
+        cfg_path.write_text(json.dumps(_cfg_dict(
+            server, state_path,
+            reconcile={"intervalSeconds": 0.2, "repair": True},
+        )))
+        proc = succ = None
+        try:
+            proc = _spawn_daemon(cfg_path)
+            await _wait_for(lambda: observer.exists(NODE))
+            sid0 = (await observer.stat(NODE)).ephemeral_owner
+
+            poller = _Poller(observer, NODE).start()
+            proc.send_signal(signal.SIGTERM)
+            rc = await asyncio.to_thread(proc.wait, 15)
+            assert rc == 0, proc.stdout.read().decode()
+            pred_out = proc.stdout.read().decode()
+            assert "session handed off" in pred_out
+
+            state = statefile.load(str(state_path))
+            assert state.session_id == sid0
+            stamp0 = state.stamp
+
+            succ = _spawn_daemon(cfg_path)
+            await _wait_for(
+                lambda: asyncio.sleep(
+                    0, statefile.load(str(state_path)).stamp != stamp0
+                ),
+                timeout=20,
+            )
+            # let the reconciler run a few post-resume sweeps
+            await asyncio.sleep(0.8)
+            await poller.stop()
+
+            assert poller.misses == 0, (
+                f"{poller.misses}/{poller.checks} polls saw NO_NODE"
+            )
+            assert poller.owners == {sid0}
+            assert succ.poll() is None
+
+            # stop the successor and read its log: it resumed (did not
+            # re-register) and its sweeps found nothing to repair
+            succ.send_signal(signal.SIGTERM)
+            assert await asyncio.to_thread(succ.wait, 15) == 0
+            out = succ.stdout.read().decode()
+            assert "session resumed; verifying registration in place" in out
+            assert "resumed registration verified in place" in out
+            assert "drift detected" not in out
+            assert "registrar: registered" in out  # the adopted set
+        finally:
+            for p in (proc, succ):
+                if p is not None and p.poll() is None:
+                    p.kill()
+                    p.wait(timeout=10)
+                if p is not None and p.stdout:
+                    p.stdout.close()
+            await observer.close()
+            await server.stop()
+
+    async def test_drain_mode_bounded_gap_and_clean_exit(self, tmp_path):
+        server = await ZKServer(max_session_timeout_ms=30000).start()
+        observer = await ZKClient([server.address]).connect()
+        state_path = tmp_path / "state.json"
+        cfg_path = tmp_path / "config.json"
+        cfg_path.write_text(json.dumps(
+            _cfg_dict(server, state_path, mode="drain", grace=0.2)
+        ))
+        proc = succ = None
+        try:
+            proc = _spawn_daemon(cfg_path, stdout=subprocess.DEVNULL)
+            await _wait_for(lambda: observer.exists(NODE))
+            proc.send_signal(signal.SIGTERM)
+            rc = await asyncio.to_thread(proc.wait, 15)
+            assert rc == 0
+            # drained: the node left DNS immediately, not via timeout
+            assert await observer.exists(NODE) is None
+            assert not state_path.exists()
+
+            # relaunch: the gap is bounded by a normal fresh
+            # registration (connect + pipeline + 1 s settle)
+            t0 = asyncio.get_running_loop().time()
+            succ = _spawn_daemon(cfg_path, stdout=subprocess.DEVNULL)
+            await _wait_for(lambda: observer.exists(NODE), timeout=20)
+            gap = asyncio.get_running_loop().time() - t0
+            assert gap < 15
+        finally:
+            for p in (proc, succ):
+                if p is not None and p.poll() is None:
+                    p.kill()
+                    p.wait(timeout=10)
+            await observer.close()
+            await server.stop()
+
+    async def test_second_signal_forces_immediate_exit(self, tmp_path):
+        # Escape hatch: a graceful stop stuck in a 30 s drain grace gets
+        # a second SIGTERM → immediate exit, distinct code + log line.
+        server = await ZKServer(max_session_timeout_ms=30000).start()
+        observer = await ZKClient([server.address]).connect()
+        state_path = tmp_path / "state.json"
+        cfg_path = tmp_path / "config.json"
+        cfg_path.write_text(json.dumps(
+            _cfg_dict(server, state_path, mode="drain", grace=30)
+        ))
+        proc = None
+        try:
+            proc = _spawn_daemon(cfg_path)
+            await _wait_for(lambda: observer.exists(NODE))
+            proc.send_signal(signal.SIGTERM)
+            # wait until the drain actually ran (node deregistered) so
+            # the second signal lands INSIDE the wedged grace period
+            await _wait_for(lambda: _absent(observer, NODE), timeout=10)
+            proc.send_signal(signal.SIGTERM)
+            rc = await asyncio.to_thread(proc.wait, 10)
+            assert rc == EX_FORCED
+            out = proc.stdout.read().decode()
+            assert "forcing immediate exit" in out
+        finally:
+            if proc is not None and proc.poll() is None:
+                proc.kill()
+                proc.wait(timeout=10)
+            if proc is not None and proc.stdout:
+                proc.stdout.close()
+            await observer.close()
+            await server.stop()
+
+
+class TestSighupReload:
+    async def test_sighup_applies_registration_delta_in_place(
+        self, tmp_path
+    ):
+        server = await ZKServer(max_session_timeout_ms=30000).start()
+        observer = await ZKClient([server.address]).connect()
+        cfg_path = tmp_path / "config.json"
+
+        def write_cfg(aliases):
+            cfg = {
+                "registration": {
+                    "domain": DOMAIN,
+                    "type": "load_balancer",
+                    "aliases": aliases,
+                    "heartbeatInterval": 100,
+                },
+                "adminIp": "10.66.77.88",
+                "zookeeper": {
+                    "servers": [
+                        {"host": server.host, "port": server.port}
+                    ],
+                    "timeout": 10000,
+                },
+            }
+            cfg_path.write_text(json.dumps(cfg))
+
+        alias1 = "/registrar/e2e/one"
+        alias2 = "/registrar/e2e/two"
+        write_cfg(["one.e2e.registrar"])
+        proc = None
+        try:
+            proc = _spawn_daemon(cfg_path)
+            await _wait_for(lambda: observer.exists(NODE))
+            await _wait_for(lambda: observer.exists(alias1))
+            host_before = await observer.stat(NODE)
+            alias1_before = await observer.stat(alias1)
+
+            # add an alias: only the new node is written
+            write_cfg(["one.e2e.registrar", "two.e2e.registrar"])
+            proc.send_signal(signal.SIGHUP)
+            await _wait_for(lambda: observer.exists(alias2), timeout=15)
+            host_mid = await observer.stat(NODE)
+            alias1_mid = await observer.stat(alias1)
+            assert (host_mid.czxid, host_mid.mzxid) == (
+                host_before.czxid, host_before.mzxid
+            )
+            assert (alias1_mid.czxid, alias1_mid.mzxid) == (
+                alias1_before.czxid, alias1_before.mzxid
+            )
+
+            # remove the first alias: only it is deleted
+            write_cfg(["two.e2e.registrar"])
+            proc.send_signal(signal.SIGHUP)
+            await _wait_for(lambda: _absent(observer, alias1), timeout=15)
+            assert await observer.exists(alias2) is not None
+            host_after = await observer.stat(NODE)
+            assert host_after.czxid == host_before.czxid
+
+            # an invalid config must be rejected with the old one kept
+            cfg_path.write_text("{ not json")
+            proc.send_signal(signal.SIGHUP)
+            await asyncio.sleep(0.5)
+            assert proc.poll() is None
+            assert await observer.exists(NODE) is not None
+            assert await observer.exists(alias2) is not None
+
+            proc.send_signal(signal.SIGTERM)
+            assert await asyncio.to_thread(proc.wait, 15) == 0
+            out = proc.stdout.read().decode()
+            assert out.count("configuration reload applied") >= 2
+            assert "invalid configuration" in out
+        finally:
+            if proc is not None and proc.poll() is None:
+                proc.kill()
+                proc.wait(timeout=10)
+            if proc is not None and proc.stdout:
+                proc.stdout.close()
+            await observer.close()
+            await server.stop()

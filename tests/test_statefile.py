@@ -1,0 +1,190 @@
+"""Statefile unit tests: the handoff file's write/read/validate contract.
+
+Every degraded shape the module promises to reject (foreign, malformed,
+short passwd, stale stamp, config mismatch) is pinned here; the daemon-
+level fallback-to-fresh-registration behavior rides on these verdicts
+and is pinned in tests/test_restart_e2e.py.
+"""
+
+import base64
+import json
+import os
+import stat as stat_mod
+import time
+
+import pytest
+
+from registrar_tpu import statefile
+from registrar_tpu.statefile import (
+    SessionState,
+    StateFileInvalid,
+    StateFileMissing,
+    check_resumable,
+    config_fingerprint,
+)
+
+
+def _state(**over):
+    base = dict(
+        session_id=0x10023ab,
+        passwd=bytes(range(16)),
+        negotiated_timeout_ms=30000,
+        last_zxid=0x42,
+        chroot="/tenant",
+        config_hash="abc123",
+        znodes=["/us/test/a/box0", "/us/test/a"],
+        pid=4242,
+        stamp=time.time(),
+    )
+    base.update(over)
+    return SessionState(**base)
+
+
+class TestRoundTrip:
+    def test_save_load_round_trip(self, tmp_path):
+        path = str(tmp_path / "state.json")
+        want = _state()
+        statefile.save(path, want)
+        got = statefile.load(path)
+        assert got == want
+
+    def test_file_is_0600(self, tmp_path):
+        # The file IS the session secret: holder can delete the host's
+        # DNS records.  Never group/world readable.
+        path = str(tmp_path / "state.json")
+        statefile.save(path, _state())
+        mode = stat_mod.S_IMODE(os.stat(path).st_mode)
+        assert mode == 0o600
+
+    def test_save_replaces_atomically_no_temp_left(self, tmp_path):
+        path = str(tmp_path / "state.json")
+        statefile.save(path, _state(session_id=1))
+        statefile.save(path, _state(session_id=2))
+        assert statefile.load(path).session_id == 2
+        leftovers = [n for n in os.listdir(tmp_path) if n != "state.json"]
+        assert leftovers == []
+
+    def test_clear_removes_and_is_idempotent(self, tmp_path):
+        path = str(tmp_path / "state.json")
+        statefile.save(path, _state())
+        statefile.clear(path)
+        statefile.clear(path)  # already gone: not an error
+        with pytest.raises(StateFileMissing):
+            statefile.load(path)
+
+    def test_missing_file_is_its_own_error(self, tmp_path):
+        with pytest.raises(StateFileMissing) as ei:
+            statefile.load(str(tmp_path / "nope.json"))
+        assert ei.value.reason == "missing"
+
+
+class TestValidation:
+    def _write(self, tmp_path, payload) -> str:
+        path = str(tmp_path / "state.json")
+        with open(path, "w") as f:
+            f.write(payload)
+        return path
+
+    def test_non_json_is_foreign(self, tmp_path):
+        path = self._write(tmp_path, "not json at all {")
+        with pytest.raises(StateFileInvalid) as ei:
+            statefile.load(path)
+        assert ei.value.reason == "foreign"
+
+    def test_wrong_format_marker_is_foreign(self, tmp_path):
+        path = self._write(tmp_path, json.dumps({"format": "something-else"}))
+        with pytest.raises(StateFileInvalid) as ei:
+            statefile.load(path)
+        assert ei.value.reason == "foreign"
+
+    def test_short_passwd_rejected(self, tmp_path):
+        # A truncated/tampered secret: offering it to the server would
+        # just burn a refused reattach — reject at load.
+        path = str(tmp_path / "state.json")
+        statefile.save(path, _state())
+        raw = json.load(open(path))
+        raw["passwd"] = base64.b64encode(b"short").decode()
+        self._write(tmp_path, json.dumps(raw))
+        with pytest.raises(StateFileInvalid) as ei:
+            statefile.load(path)
+        assert ei.value.reason == "passwd"
+
+    def test_non_base64_passwd_rejected(self, tmp_path):
+        path = str(tmp_path / "state.json")
+        statefile.save(path, _state())
+        raw = json.load(open(path))
+        raw["passwd"] = "!!!not-base64!!!"
+        self._write(tmp_path, json.dumps(raw))
+        with pytest.raises(StateFileInvalid) as ei:
+            statefile.load(path)
+        assert ei.value.reason == "passwd"
+
+    def test_missing_field_is_malformed(self, tmp_path):
+        path = str(tmp_path / "state.json")
+        statefile.save(path, _state())
+        raw = json.load(open(path))
+        del raw["znodes"]
+        self._write(tmp_path, json.dumps(raw))
+        with pytest.raises(StateFileInvalid) as ei:
+            statefile.load(path)
+        assert ei.value.reason == "malformed"
+
+    def test_bad_session_id_is_malformed(self, tmp_path):
+        path = str(tmp_path / "state.json")
+        statefile.save(path, _state())
+        raw = json.load(open(path))
+        raw["sessionId"] = "zz-not-hex"
+        self._write(tmp_path, json.dumps(raw))
+        with pytest.raises(StateFileInvalid) as ei:
+            statefile.load(path)
+        assert ei.value.reason == "malformed"
+
+
+class TestResumable:
+    def test_fresh_matching_state_is_resumable(self):
+        assert check_resumable(_state(config_hash="h"), "h") is None
+
+    def test_config_hash_mismatch(self):
+        assert (
+            check_resumable(_state(config_hash="old"), "new")
+            == statefile.R_CONFIG_HASH
+        )
+
+    def test_stale_stamp_older_than_session_timeout(self):
+        st = _state(config_hash="h", stamp=time.time() - 31.0,
+                    negotiated_timeout_ms=30000)
+        assert check_resumable(st, "h") == statefile.R_STALE_STAMP
+
+    def test_stamp_just_inside_the_timeout_passes(self):
+        st = _state(config_hash="h", stamp=time.time() - 20.0,
+                    negotiated_timeout_ms=30000)
+        assert check_resumable(st, "h") is None
+
+    def test_far_future_stamp_rejected(self):
+        # A broken clock / tampered stamp must not be trusted forever.
+        st = _state(config_hash="h", stamp=time.time() + 3600.0,
+                    negotiated_timeout_ms=30000)
+        assert check_resumable(st, "h") == statefile.R_STALE_STAMP
+
+
+class TestFingerprint:
+    REG = {"domain": "a.b.us", "type": "host", "aliases": ["x.b.us"]}
+
+    def test_stable_across_key_order(self):
+        a = config_fingerprint(self.REG, "10.0.0.1", "/t")
+        b = config_fingerprint(
+            dict(reversed(list(self.REG.items()))), "10.0.0.1", "/t"
+        )
+        assert a == b
+
+    def test_sensitive_to_record_shaping_inputs(self):
+        base = config_fingerprint(self.REG, "10.0.0.1", "/t")
+        assert config_fingerprint(self.REG, "10.0.0.2", "/t") != base
+        assert config_fingerprint(self.REG, "10.0.0.1", "/u") != base
+        changed = dict(self.REG, aliases=["y.b.us"])
+        assert config_fingerprint(changed, "10.0.0.1", "/t") != base
+
+    def test_none_chroot_equals_empty(self):
+        assert config_fingerprint(self.REG, None, None) == config_fingerprint(
+            self.REG, None, ""
+        )

@@ -779,6 +779,129 @@ class TestSessions:
             await server.stop()
 
 
+class TestSessionHandoff:
+    """ISSUE 5: detach-without-close + seed_session cross-"process" resume
+    (two client OBJECTS standing in for two processes — the wire exchange
+    is identical)."""
+
+    async def test_detach_leaves_session_and_ephemerals_alive(self):
+        server, client = await _pair(timeout_ms=5000)
+        try:
+            await client.create("/ho1", b"", CreateFlag.EPHEMERAL)
+            sid = client.session_id
+            await client.detach()
+            assert client.closed
+            # No CLOSE_SESSION went out: the session and its ephemeral
+            # are still there for a successor.
+            assert sid in server.sessions
+            assert server.get_node("/ho1") is not None
+        finally:
+            await server.stop()
+
+    async def test_seed_session_resumes_across_client_objects(self):
+        server, client = await _pair(timeout_ms=5000)
+        successor = None
+        try:
+            await client.create("/ho2", b"payload", CreateFlag.EPHEMERAL)
+            sid, passwd = client.session_id, client.session_passwd
+            timeout_ms = client.negotiated_timeout_ms
+            zxid = client.last_zxid
+            await client.detach()
+
+            successor = ZKClient([server.address], timeout_ms=5000)
+            resumed = []
+            successor.on("session_resumed", resumed.append)
+            successor.seed_session(
+                sid, passwd, negotiated_timeout_ms=timeout_ms,
+                last_zxid=zxid,
+            )
+            await successor.connect()
+            assert successor.session_id == sid
+            assert resumed == [sid]
+            # The ephemeral never flickered and is OURS to operate on.
+            st = await successor.stat("/ho2")
+            assert st.ephemeral_owner == sid
+            data, _ = await successor.get("/ho2")
+            assert data == b"payload"
+            # ... and a clean close now reaps it (the successor really
+            # owns the session, not a lookalike).
+            await successor.close()
+            successor = None
+            assert server.get_node("/ho2") is None
+        finally:
+            if successor is not None:
+                await successor.close()
+            await server.stop()
+
+    async def test_refused_resume_falls_back_to_fresh_session(self):
+        server, client = await _pair(timeout_ms=5000)
+        successor = None
+        try:
+            await client.create("/ho3", b"", CreateFlag.EPHEMERAL)
+            sid, passwd = client.session_id, client.session_passwd
+            await client.detach()
+            # The session dies in the handoff gap.
+            await server.expire_session(sid)
+
+            successor = ZKClient([server.address], timeout_ms=5000)
+            refused = asyncio.Event()
+            terminal = asyncio.Event()
+            successor.on("resume_refused", lambda *a: refused.set())
+            successor.on("session_expired", lambda *a: terminal.set())
+            successor.seed_session(sid, passwd)
+            # The refusing attempt surfaces SessionExpiredError but the
+            # client stays OPEN, reset to a fresh handshake...
+            with pytest.raises(ZKError):
+                await successor.connect()
+            assert refused.is_set()
+            assert not terminal.is_set()
+            assert not successor.closed
+            assert successor.session_id == 0
+            # ...and the next attempt builds a brand-new session.
+            await successor.connect()
+            assert successor.session_id not in (0, sid)
+            await successor.create("/ho3b", b"", CreateFlag.EPHEMERAL)
+        finally:
+            if successor is not None and not successor.closed:
+                await successor.close()
+            await server.stop()
+
+    async def test_wrong_passwd_resume_is_refused_not_adopted(self):
+        server, client = await _pair(timeout_ms=5000)
+        successor = None
+        try:
+            await client.create("/ho4", b"", CreateFlag.EPHEMERAL)
+            sid = client.session_id
+            await client.detach()
+
+            successor = ZKClient([server.address], timeout_ms=5000)
+            successor.seed_session(sid, b"\xff" * 16)
+            with pytest.raises(ZKError):
+                await successor.connect()
+            await successor.connect()  # fresh session
+            assert successor.session_id != sid
+            # the REAL session (and its ephemeral) was not hijacked
+            assert sid in server.sessions
+            assert server.get_node("/ho4") is not None
+        finally:
+            if successor is not None and not successor.closed:
+                await successor.close()
+            await server.stop()
+
+    async def test_seed_session_validates_inputs(self):
+        server = await ZKServer().start()
+        try:
+            client = ZKClient([server.address])
+            with pytest.raises(ValueError):
+                client.seed_session(1, b"short")
+            connected = await ZKClient([server.address]).connect()
+            with pytest.raises(RuntimeError):
+                connected.seed_session(1, b"\x00" * 16)
+            await connected.close()
+        finally:
+            await server.stop()
+
+
 class TestWatches:
     async def test_data_watch_fires_on_delete(self):
         server, client = await _pair()

@@ -793,3 +793,159 @@ class TestServeView:
         finally:
             await client.close()
             await server.stop()
+
+
+def _run_tool(*args):
+    """Run zkcli without the -s flag (raw local commands like `state`)."""
+    return subprocess.run(
+        [sys.executable, "-m", "registrar_tpu.tools.zkcli", *args],
+        cwd=REPO, capture_output=True, text=True, timeout=30,
+        env={**os.environ, "PYTHONPATH": REPO},
+    )
+
+
+class TestStateCommand:
+    """`zkcli state FILE`: local handoff-statefile inspection (ISSUE 5)."""
+
+    def _save(self, tmp_path, **over):
+        import time
+
+        from registrar_tpu import statefile
+
+        base = dict(
+            session_id=0xABC123,
+            passwd=b"\x01" * 16,
+            negotiated_timeout_ms=30000,
+            last_zxid=7,
+            chroot="",
+            config_hash="deadbeef",
+            znodes=["/us/test/cli/box0"],
+            pid=111,
+            stamp=time.time(),
+        )
+        base.update(over)
+        path = tmp_path / "state.json"
+        statefile.save(str(path), statefile.SessionState(**base))
+        return path
+
+    async def test_fresh_state_is_resumable_exit_zero(self, tmp_path):
+        path = self._save(tmp_path)
+        out = await asyncio.to_thread(_run_tool, "state", str(path))
+        assert out.returncode == 0, out.stderr
+        assert "sessionId = 0xabc123" in out.stdout
+        assert "resumable = yes" in out.stdout
+        assert "/us/test/cli/box0" in out.stdout
+
+    async def test_stale_state_exits_one_with_reason(self, tmp_path):
+        import time
+
+        path = self._save(tmp_path, stamp=time.time() - 120)
+        out = await asyncio.to_thread(_run_tool, "state", str(path))
+        assert out.returncode == 1
+        assert "resumable = no (staleStamp)" in out.stdout
+
+    async def test_corrupt_state_exits_two(self, tmp_path):
+        path = tmp_path / "state.json"
+        path.write_text("{ not json")
+        out = await asyncio.to_thread(_run_tool, "state", str(path))
+        assert out.returncode == 2
+        assert "reason: foreign" in out.stderr
+
+    async def test_config_fingerprint_mismatch_exits_one(self, tmp_path):
+        path = self._save(tmp_path)  # hash "deadbeef": matches nothing
+        cfg = tmp_path / "config.json"
+        cfg.write_text(json.dumps({
+            "registration": {"domain": "cli.test.us", "type": "host"},
+            "zookeeper": {"servers": [{"host": "h", "port": 1}]},
+        }))
+        out = await asyncio.to_thread(
+            _run_tool, "state", str(path), "--config", str(cfg)
+        )
+        assert out.returncode == 1
+        assert "resumable = no (configHash)" in out.stdout
+
+
+class TestDrainCommand:
+    """`zkcli drain -f config`: external deregistration (ISSUE 5)."""
+
+    def _config(self, tmp_path, server):
+        cfg = tmp_path / "config.json"
+        cfg.write_text(json.dumps({
+            "registration": {
+                "domain": "cli.test.us",
+                "type": "load_balancer",
+                "service": {
+                    "type": "service",
+                    "service": {"srvce": "_http", "proto": "_tcp",
+                                "port": 80},
+                },
+            },
+            "adminIp": "10.5.5.5",
+            "zookeeper": {
+                "servers": [{"host": server.host, "port": server.port}],
+            },
+        }))
+        return cfg
+
+    async def test_drain_deletes_this_hosts_records(self, tmp_path):
+        server = await ZKServer().start()
+        client = await _seed(server)
+        try:
+            cfg = self._config(tmp_path, server)
+            out = await asyncio.to_thread(
+                _run_tool, "drain", "-f", str(cfg), "--hostname", "box0"
+            )
+            assert out.returncode == 0, out.stderr
+            assert "deleted /us/test/cli/box0" in out.stdout
+            assert "deleted /us/test/cli" in out.stdout  # childless now
+            assert await client.exists("/us/test/cli/box0") is None
+        finally:
+            await client.close()
+            await server.stop()
+
+    async def test_drain_keeps_shared_service_node(self, tmp_path):
+        from registrar_tpu.zk.protocol import CreateFlag
+
+        server = await ZKServer().start()
+        client = await _seed(server)
+        try:
+            # a live sibling keeps the shared domain node occupied
+            await client.create(
+                "/us/test/cli/sibling", b"{}", CreateFlag.EPHEMERAL
+            )
+            cfg = self._config(tmp_path, server)
+            out = await asyncio.to_thread(
+                _run_tool, "drain", "-f", str(cfg), "--hostname", "box0"
+            )
+            assert out.returncode == 0, out.stderr
+            assert "deleted /us/test/cli/box0" in out.stdout
+            assert "skipped /us/test/cli (shared (kept))" in out.stdout
+            assert await client.exists("/us/test/cli/sibling") is not None
+            assert await client.exists("/us/test/cli") is not None
+        finally:
+            await client.close()
+            await server.stop()
+
+    async def test_drain_of_absent_host_is_clean(self, tmp_path):
+        server = await ZKServer().start()
+        try:
+            cfg = self._config(tmp_path, server)
+            out = await asyncio.to_thread(
+                _run_tool, "drain", "-f", str(cfg), "--hostname", "ghost"
+            )
+            assert out.returncode == 0
+            assert "already absent" in out.stdout
+        finally:
+            await server.stop()
+
+    async def test_drain_unreachable_exits_two(self, tmp_path):
+        cfg = tmp_path / "config.json"
+        cfg.write_text(json.dumps({
+            "registration": {"domain": "cli.test.us", "type": "host"},
+            "zookeeper": {"servers": [{"host": "127.0.0.1", "port": 1}]},
+        }))
+        out = await asyncio.to_thread(
+            _run_tool, "drain", "-f", str(cfg), "--timeout", "2"
+        )
+        assert out.returncode == 2
+        assert "cannot connect" in out.stderr
