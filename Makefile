@@ -13,14 +13,18 @@ all: check test
 
 # Lint gate (the reference's `make check` runs jsl+jsstyle with shipped
 # configs, its Makefile:15,18 + tools/jsl.node.conf): byte-compile, the
-# in-tree static analysis suite (tools/checklib/ — name resolution plus
-# asyncio concurrency rules, suppressions, baseline; docs/CHECKS.md),
-# and a strict-warnings import smoke.  `check-core` is everything
-# EXCEPT the static checker, for callers that already ran
+# in-tree static analysis suite (tools/checklib/ — file-local name/
+# asyncio rules PLUS the whole-program pass: import-graph symbol table,
+# call graph, event-name and config-key contracts; docs/CHECKS.md),
+# and a strict-warnings import smoke.  The --max-seconds budget guards
+# against an analysis-cost regression (a quadratic fixpoint would turn
+# every build red, loudly, instead of slowly eating CI); the full tree
+# runs in a few seconds, 60 is slow-runner headroom.  `check-core` is
+# everything EXCEPT the static checker, for callers that already ran
 # tools/check.py themselves (CI invokes it once with --format json so
 # the report doubles as the gate and the build artifact).
 check: check-core
-	$(PYTHON) tools/check.py
+	$(PYTHON) tools/check.py --stats --max-seconds 60
 
 check-core:
 	$(PYTHON) -m compileall -q registrar_tpu tests tools bench.py __graft_entry__.py

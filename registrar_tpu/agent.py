@@ -254,7 +254,13 @@ async def _run(
         resumed = znodes is not None
     if znodes is None:
         try:
-            znodes = await do_register()
+            # Under the single-flight lock like every other pipeline run:
+            # no recovery actor exists yet to contend, but the invariant
+            # ("znode mutations hold the repair lock") is then true
+            # without exception — and machine-checked (checklib's
+            # await-in-lock-free-mutator rule).
+            async with repair_lock:
+                znodes = await do_register()
         except asyncio.CancelledError:
             raise
         except Exception as err:  # noqa: BLE001

@@ -208,6 +208,9 @@ async def _drain_unregister(zk: ZKClient, znodes, log) -> list:
     deleted = []
     for node in znodes:
         try:
+            # check: disable=await-in-lock-free-mutator -- shutdown-only
+            # walk: ee.stop() has already run, so no recovery actor is
+            # alive to contend, and the agent's lock died with it
             outcome = await unlink_tolerant(zk, node)
         except asyncio.CancelledError:
             raise

@@ -369,6 +369,11 @@ def instrument(ee, zk, registry: Optional[MetricsRegistry] = None) -> MetricsReg
         "registrar_config_reloads_total",
         "SIGHUP config reloads by result (applied|noop|failed)",
     )
+    watch_events = reg.counter(
+        "registrar_watch_events_total",
+        "ZooKeeper watch notifications delivered to this client "
+        "(the firehose behind cache invalidation and watch re-arm)",
+    )
 
     start = time.monotonic()
     uptime.set_function(lambda: time.monotonic() - start)
@@ -398,6 +403,7 @@ def instrument(ee, zk, registry: Optional[MetricsRegistry] = None) -> MetricsReg
 
     zk.on("session_reborn", lambda *_a: rebirths.inc())
     zk.on("rebirth_breaker_tripped", lambda *_a: breaker_trips.inc())
+    zk.on("watch", lambda *_a: watch_events.inc())
     ee.on("handoff", lambda *_a: handoffs.inc())
     ee.on("drain", lambda *_a: drains.inc())
     ee.on("resume", lambda outcome: resumes.inc(labels={"outcome": outcome}))

@@ -152,7 +152,92 @@ SEEDED_VIOLATIONS = {
         """,
 }
 
-EXPECTED_RULES = sorted(set(SEEDED_VIOLATIONS) - {"syntax-error"})
+#: whole-program rule -> {rel path: source} for a minimal tree that
+#: violates exactly that rule (the generation-2 analogs of
+#: SEEDED_VIOLATIONS; multi-file because the rules are cross-module).
+PROGRAM_SEEDED_VIOLATIONS = {
+    "cross-module-unawaited": {
+        "registrar_tpu/util.py": """\
+            import asyncio
+
+            async def notify():
+                await asyncio.sleep(0)
+            """,
+        "registrar_tpu/seeded.py": """\
+            from registrar_tpu import util
+
+            async def main():
+                util.notify()
+            """,
+    },
+    "transitive-blocking-call": {
+        "registrar_tpu/util.py": """\
+            import time
+
+            def pause():
+                time.sleep(1)
+            """,
+        "registrar_tpu/seeded.py": """\
+            from registrar_tpu import util
+
+            async def main():
+                util.pause()
+            """,
+    },
+    "await-in-lock-free-mutator": {
+        "registrar_tpu/registration.py": """\
+            async def rewrite(zk):
+                await zk.set_data("/a", b"x")
+            """,
+        "registrar_tpu/agent.py": """\
+            from registrar_tpu import registration
+
+            async def repair(zk):
+                await registration.rewrite(zk)
+            """,
+    },
+    "dead-event-name": {
+        "registrar_tpu/seeded.py": """\
+            def fire(ee):
+                ee.emit("registered", 1)
+            """,
+    },
+    "unknown-event-name": {
+        "registrar_tpu/seeded.py": """\
+            def wire(ee):
+                ee.on("registered", print)
+            """,
+    },
+    "secret-flow-to-log": {
+        "registrar_tpu/seeded.py": """\
+            import logging
+
+            log = logging.getLogger("registrar")
+
+            def announce(state):
+                log.info("resuming session with %r", state.passwd)
+            """,
+    },
+    "config-key-drift": {
+        "registrar_tpu/config.py": """\
+            def parse(raw):
+                return raw.get("ghostKey")
+            """,
+        "docs/CONFIG.md": """\
+            | Key | Meaning |
+            |---|---|
+            | `timeout` | documented but unread |
+            """,
+        "etc/config.example.json": """\
+            {"exampleOnly": 1}
+            """,
+    },
+}
+
+EXPECTED_RULES = sorted(
+    (set(SEEDED_VIOLATIONS) - {"syntax-error"})
+    | set(PROGRAM_SEEDED_VIOLATIONS)
+)
 
 
 def test_every_registered_rule_has_a_seeded_violation():
@@ -1004,6 +1089,568 @@ def test_class_scope_invisible_to_methods(tmp_path):
 
 def test_star_import_disables_undefined_check(tmp_path):
     assert messages("from os.path import *\nprint(join('a'))\n", tmp_path) == []
+
+
+# --- generation 2: whole-program rules ---------------------------------------
+
+
+def seed_program_tree(tmp_path, files):
+    """Materialize a {rel path: source} tree (the multi-file analog of
+    seed_package_tree, for the cross-module rules)."""
+    for rel, source in files.items():
+        path = tmp_path / rel
+        path.parent.mkdir(parents=True, exist_ok=True)
+        path.write_text(textwrap.dedent(source))
+    return tmp_path
+
+
+def program_rules_fired(proc):
+    """The distinct rule tags a subprocess run printed."""
+    import re
+
+    return sorted(set(re.findall(r"\[([a-z-]+)\]", proc.stdout)))
+
+
+@pytest.mark.parametrize("rule", sorted(PROGRAM_SEEDED_VIOLATIONS))
+def test_program_seeded_violation_fails_gate(rule, tmp_path):
+    """Mutation-style, like test_seeded_violation_fails_gate: inject the
+    cross-module violation and the full gate must fail on that rule —
+    and on ONLY that rule (the fixtures are clean otherwise)."""
+    tree = seed_program_tree(tmp_path, PROGRAM_SEEDED_VIOLATIONS[rule])
+    proc = run_checker("registrar_tpu", "--no-baseline", cwd=tree)
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    assert program_rules_fired(proc) == [rule]
+
+
+def test_transitive_blocking_chain_in_json_report(tmp_path):
+    tree = seed_program_tree(
+        tmp_path, PROGRAM_SEEDED_VIOLATIONS["transitive-blocking-call"]
+    )
+    proc = run_checker(
+        "registrar_tpu", "--no-baseline", "--format", "json", cwd=tree
+    )
+    assert proc.returncode == 1
+    report = json.loads(proc.stdout)
+    (finding,) = report["problems"]
+    assert finding["rule"] == "transitive-blocking-call"
+    # structured evidence: every hop carries symbol/path/line, ending at
+    # the blocking primitive
+    chain = finding["chain"]
+    assert [h["symbol"] for h in chain] == [
+        "registrar_tpu.seeded:main",
+        "registrar_tpu.util:pause",
+        "time.sleep",
+    ]
+    assert all(
+        set(h) == {"symbol", "path", "line"} and h["line"] > 0
+        for h in chain
+    )
+    # the chain also rides in the message (names only), so the text
+    # output and the baseline identity pin it too
+    assert "registrar_tpu.util:pause -> time.sleep" in finding["message"]
+
+
+def test_lock_free_mutator_chain_in_json_report(tmp_path):
+    tree = seed_program_tree(
+        tmp_path, PROGRAM_SEEDED_VIOLATIONS["await-in-lock-free-mutator"]
+    )
+    proc = run_checker(
+        "registrar_tpu", "--no-baseline", "--format", "json", cwd=tree
+    )
+    assert proc.returncode == 1
+    report = json.loads(proc.stdout)
+    (finding,) = report["problems"]
+    assert finding["rule"] == "await-in-lock-free-mutator"
+    chain = finding["chain"]
+    assert chain[-1]["symbol"] == "zk.set_data"
+    assert chain[0]["symbol"] == "registrar_tpu.agent:repair"
+
+
+def test_mutator_under_lock_passes(tmp_path):
+    tree = seed_program_tree(tmp_path, {
+        "registrar_tpu/agent.py": """\
+            async def repair(zk, lock):
+                async with lock:
+                    await zk.set_data("/a", b"x")
+            """,
+    })
+    proc = run_checker("registrar_tpu", "--no-baseline", cwd=tree)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+def test_mutator_in_helper_only_called_under_lock_passes(tmp_path):
+    # The interprocedural leg: the helper's own mutator site is bare,
+    # but every resolved caller holds the lock — the greatest-fixpoint
+    # "always locked" analysis must keep the gate green.
+    tree = seed_program_tree(tmp_path, {
+        "registrar_tpu/agent.py": """\
+            async def entry(zk, lock):
+                async with lock:
+                    await _helper(zk)
+
+            async def _helper(zk):
+                await zk.set_data("/a", b"x")
+            """,
+    })
+    proc = run_checker("registrar_tpu", "--no-baseline", cwd=tree)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+def test_op_delete_constructor_is_not_a_mutator(tmp_path):
+    # `Op.delete(path)` BUILDS a request object (a class attribute of a
+    # model class); only opaque-object receivers (zk, self.zk) count.
+    tree = seed_program_tree(tmp_path, {
+        "registrar_tpu/ops.py": """\
+            class Op:
+                @staticmethod
+                def delete(path):
+                    return ("delete", path)
+            """,
+        "registrar_tpu/agent.py": """\
+            from registrar_tpu.ops import Op
+
+            async def plan(paths):
+                return [Op.delete(p) for p in paths]
+            """,
+    })
+    proc = run_checker("registrar_tpu", "--no-baseline", cwd=tree)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+def test_wait_for_counts_as_listener(tmp_path):
+    tree = seed_program_tree(tmp_path, {
+        "registrar_tpu/seeded.py": """\
+            def fire(ee):
+                ee.emit("registered", 1)
+            """,
+        "registrar_tpu/consumer.py": """\
+            async def watch(ee):
+                return await ee.wait_for("registered")
+            """,
+    })
+    proc = run_checker("registrar_tpu", "--no-baseline", cwd=tree)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+def test_dynamic_event_names_are_not_modeled(tmp_path):
+    # The client's per-path watch emitter: emit(variable) / on(variable)
+    # must neither crash nor count as emits/listens (no guessed names).
+    tree = seed_program_tree(tmp_path, {
+        "registrar_tpu/seeded.py": """\
+            def relay(ee, event, payload):
+                ee.on(event, print)
+                ee.emit(event, payload)
+            """,
+    })
+    proc = run_checker("registrar_tpu", "--no-baseline", cwd=tree)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+def test_program_finding_is_suppressible_inline(tmp_path):
+    tree = seed_program_tree(tmp_path, {
+        "registrar_tpu/seeded.py": """\
+            def fire(ee):
+                # check: disable=dead-event-name -- embedders subscribe to this
+                ee.emit("registered", 1)
+            """,
+    })
+    proc = run_checker("registrar_tpu", "--no-baseline", cwd=tree)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+def test_program_finding_unused_suppression_reported(tmp_path):
+    tree = seed_program_tree(tmp_path, {
+        "registrar_tpu/seeded.py": """\
+            def fire(ee):
+                # check: disable=dead-event-name -- stale excuse
+                ee.on("registered", print)
+                ee.emit("registered", 1)
+            """,
+    })
+    proc = run_checker("registrar_tpu", "--no-baseline", cwd=tree)
+    assert proc.returncode == 1
+    assert program_rules_fired(proc) == ["unused-suppression"]
+
+
+def test_import_cycle_degrades_gracefully(tmp_path):
+    # a <-> b: the model never executes imports, so a cycle must neither
+    # crash nor lose resolution — the violation inside it still fires.
+    tree = seed_program_tree(tmp_path, {
+        "registrar_tpu/a.py": """\
+            from registrar_tpu import b
+
+            async def touch():
+                b.helper()
+            """,
+        "registrar_tpu/b.py": """\
+            from registrar_tpu import a
+
+            async def helper():
+                return a
+            """,
+    })
+    proc = run_checker("registrar_tpu", "--no-baseline", cwd=tree)
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    assert program_rules_fired(proc) == ["cross-module-unawaited"]
+
+
+def test_star_import_degrades_module_to_file_local(tmp_path):
+    # A `from x import *` can shadow ANY name at runtime; the program
+    # model must stop resolving names in that module (conservative
+    # silence) instead of false-positiving on the explicit import.
+    tree = seed_program_tree(tmp_path, {
+        "registrar_tpu/util.py": """\
+            import time
+
+            def pause():
+                time.sleep(1)
+            """,
+        "registrar_tpu/other.py": """\
+            VALUE = 1
+            """,
+        "registrar_tpu/seeded.py": """\
+            from registrar_tpu.util import pause
+            from registrar_tpu.other import *
+
+            async def main():
+                pause()
+            """,
+    })
+    proc = run_checker("registrar_tpu", "--no-baseline", cwd=tree)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+def test_dynamic_import_degrades_module_to_file_local(tmp_path):
+    tree = seed_program_tree(tmp_path, {
+        "registrar_tpu/util.py": """\
+            import time
+
+            def pause():
+                time.sleep(1)
+            """,
+        "registrar_tpu/seeded.py": """\
+            import importlib
+
+            from registrar_tpu.util import pause
+
+            plugin = importlib.import_module("registrar_tpu.util")
+
+            async def main():
+                pause()
+            """,
+    })
+    proc = run_checker("registrar_tpu", "--no-baseline", cwd=tree)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+def test_rebound_name_is_ambiguous_and_silent(tmp_path):
+    # An imported async def later rebound at module level: the bare call
+    # could hit either binding — a build gate must not guess.
+    tree = seed_program_tree(tmp_path, {
+        "registrar_tpu/util.py": """\
+            import asyncio
+
+            async def notify():
+                await asyncio.sleep(0)
+            """,
+        "registrar_tpu/seeded.py": """\
+            from registrar_tpu.util import notify
+
+            def quiet():
+                return None
+
+            notify = quiet
+
+            async def main():
+                notify()
+            """,
+    })
+    proc = run_checker("registrar_tpu", "--no-baseline", cwd=tree)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+def test_secret_flow_through_local_assignment(tmp_path):
+    tree = seed_program_tree(tmp_path, {
+        "registrar_tpu/seeded.py": """\
+            import logging
+
+            log = logging.getLogger("registrar")
+
+            def announce(state):
+                secret = state.passwd
+                shown = secret
+                log.info("resuming with %r", shown)
+            """,
+    })
+    proc = run_checker("registrar_tpu", "--no-baseline", cwd=tree)
+    assert proc.returncode == 1
+    assert program_rules_fired(proc) == ["secret-flow-to-log"]
+
+
+def test_secret_sibling_fields_log_fine(tmp_path):
+    # session_id is logged all over the tree by design — only the
+    # passwd is the secret.
+    tree = seed_program_tree(tmp_path, {
+        "registrar_tpu/seeded.py": """\
+            import logging
+
+            log = logging.getLogger("registrar")
+
+            def announce(state):
+                state.passwd = b"x" * 16
+                log.info("session 0x%x", state.session_id)
+            """,
+    })
+    proc = run_checker("registrar_tpu", "--no-baseline", cwd=tree)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+def test_config_key_drift_reports_each_direction(tmp_path):
+    tree = seed_program_tree(
+        tmp_path, PROGRAM_SEEDED_VIOLATIONS["config-key-drift"]
+    )
+    proc = run_checker("registrar_tpu", "--no-baseline", cwd=tree)
+    assert proc.returncode == 1
+    out = proc.stdout
+    # each drift direction is its own finding, anchored at its source
+    assert "'ghostKey' is read by the accessors but never documented" in out
+    assert "'ghostKey' is read by the accessors but not exercised" in out
+    assert "'timeout' is documented but no accessor reads it" in out
+    assert "'timeout' is documented but missing from etc/" in out
+    assert "'exampleOnly' is present in the example config but no accessor" in out
+    assert "'exampleOnly' is present in the example config but never documented" in out
+
+
+def test_subtree_run_skips_program_rules(tmp_path):
+    # `check.py registrar_tpu/zk` (the documented subtree convenience)
+    # must not judge cross-module contracts against an artificially
+    # small program — the real tree's zk/ subtree emits events whose
+    # listeners live elsewhere, and that run must stay green.
+    proc = run_checker(os.path.join("registrar_tpu", "zk"), "--no-baseline")
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    # ... while a tree-rooted run still arms them (the fixture suite
+    # above relies on it); single-file runs skip them too
+    tree = seed_program_tree(
+        tmp_path, PROGRAM_SEEDED_VIOLATIONS["dead-event-name"]
+    )
+    proc = run_checker(
+        os.path.join("registrar_tpu", "seeded.py"), "--no-baseline",
+        cwd=tree,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+def test_checklib_modules_resolve_in_import_graph():
+    # tools/ sits on sys.path for the checker, so tools/checklib/*.py
+    # import as checklib.* — the model must name them that way or the
+    # --changed-only reverse-dependency closure silently loses every
+    # consumer of a checklib helper.
+    from checklib.engine import _parse_file
+    from checklib.program import ProgramModel, module_name_for
+
+    assert module_name_for("tools/checklib/program.py") == "checklib.program"
+    contexts = []
+    for rel in (
+        "tools/checklib/program.py",
+        "tools/checklib/callgraph.py",
+        "tools/checklib/engine.py",
+    ):
+        ctx, _ = _parse_file(os.path.join(REPO, rel), rel)
+        contexts.append(ctx)
+    model = ProgramModel(contexts)
+    closure = model.reverse_import_closure({"tools/checklib/program.py"})
+    assert "tools/checklib/callgraph.py" in closure  # imports program
+    assert "tools/checklib/engine.py" in closure  # imports program
+
+
+def test_secret_taint_not_inherited_by_shadowing_param(tmp_path):
+    # A nested function whose PARAMETER shares a tainted outer name is
+    # not handling the secret — the closure-taint inheritance must drop
+    # shadowed names (zero-false-positive contract).
+    tree = seed_program_tree(tmp_path, {
+        "registrar_tpu/seeded.py": """\
+            import logging
+
+            log = logging.getLogger("registrar")
+
+            def outer(state):
+                data = state.passwd
+
+                def fmt(data):
+                    log.info("payload %r", data)
+
+                return fmt, data
+            """,
+    })
+    proc = run_checker("registrar_tpu", "--no-baseline", cwd=tree)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+def test_config_key_drift_silent_without_accessor_module(tmp_path):
+    # Fixture trees for the OTHER rules carry no config.py: the drift
+    # rule must not condemn their (absent) docs.
+    tree = seed_program_tree(tmp_path, {
+        "registrar_tpu/seeded.py": "x = 1\n",
+    })
+    proc = run_checker("registrar_tpu", "--no-baseline", cwd=tree)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+# --- --changed-only / --stats / --max-seconds --------------------------------
+
+
+def _git(tree, *args):
+    return subprocess.run(
+        ["git", "-C", str(tree), "-c", "user.email=check@test",
+         "-c", "user.name=check", *args],
+        capture_output=True,
+        text=True,
+        check=True,
+    )
+
+
+def seed_changed_only_tree(tmp_path):
+    """A scratch git repo with its own copy of tools/ (REPO_ROOT anchors
+    there), a helper, a dependent with a file-local violation and a dead
+    event, and an unrelated module."""
+    import shutil
+
+    shutil.copytree(os.path.join(REPO, "tools"), tmp_path / "tools")
+    seed_program_tree(tmp_path, {
+        "registrar_tpu/util.py": "def helper():\n    return 1\n",
+        "registrar_tpu/consumer.py": """\
+            from registrar_tpu.util import helper
+
+            def f(items=[]):
+                items.append(helper())
+                return items
+
+            def fire(ee):
+                ee.emit("registered", 1)
+            """,
+        "registrar_tpu/unrelated.py": "x = 1\n",
+    })
+    _git(tmp_path, "init", "-q")
+    _git(tmp_path, "add", "-A")
+    _git(tmp_path, "commit", "-qm", "seed")
+    return tmp_path
+
+
+def run_changed_only(tree, *extra):
+    # explicit targets compose with --changed-only: they define the
+    # coverage universe, the git status narrows within it
+    return subprocess.run(
+        [sys.executable, os.path.join(str(tree), "tools", "check.py"),
+         "registrar_tpu", "--changed-only", "--no-baseline", *extra],
+        capture_output=True,
+        text=True,
+        cwd=str(tree),
+    )
+
+
+def test_changed_only_pulls_in_reverse_dependencies(tmp_path):
+    tree = seed_changed_only_tree(tmp_path)
+    # touch ONLY the helper: the dependent module imports it, so the
+    # reverse-dependency closure must re-lint consumer.py and find its
+    # file-local violation (plus the program-wide dead event, which a
+    # narrowed run still reports — full model).
+    (tree / "registrar_tpu" / "util.py").write_text(
+        "def helper():\n    return 2\n"
+    )
+    proc = run_changed_only(tree)
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    assert "[mutable-default]" in proc.stdout
+    assert "consumer.py" in proc.stdout
+
+
+def test_changed_only_skips_unrelated_file_rules(tmp_path):
+    tree = seed_changed_only_tree(tmp_path)
+    (tree / "registrar_tpu" / "unrelated.py").write_text("x = 2\n")
+    proc = run_changed_only(tree)
+    # consumer.py was not re-linted (its mutable-default is invisible to
+    # this narrowed run) but the whole-program rules still saw the full
+    # model: the dead event name fails the gate regardless.
+    assert "[mutable-default]" not in proc.stdout
+    assert "[dead-event-name]" in proc.stdout
+    assert proc.returncode == 1
+
+
+def test_changed_only_clean_when_nothing_changed(tmp_path):
+    tree = seed_changed_only_tree(tmp_path)
+    # fix the seeded problems, commit, touch only the unrelated file
+    (tree / "registrar_tpu" / "consumer.py").write_text(
+        "from registrar_tpu.util import helper\n\n\n"
+        "def f():\n    return [helper()]\n"
+    )
+    _git(tree, "add", "-A")
+    _git(tree, "commit", "-qm", "fix")
+    (tree / "registrar_tpu" / "unrelated.py").write_text("x = 3\n")
+    proc = run_changed_only(tree)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+def test_check_file_exempts_program_rule_suppressions():
+    # check_file runs file rules only; a suppression the FULL gate
+    # requires (main.py's drain-walk await-in-lock-free-mutator opt-out)
+    # must not surface as 'unused — remove it' on the single-file path.
+    findings = check.check_file(
+        os.path.join(REPO, "registrar_tpu", "main.py"),
+        rel_path="registrar_tpu/main.py",
+    )
+    assert findings == [], [f.render() for f in findings]
+
+
+def test_changed_only_from_nested_checkout(tmp_path):
+    # git prints status paths relative to the repo TOP-LEVEL: when the
+    # project lives in a subdirectory of a larger checkout, the subdir
+    # prefix must be stripped or the narrowed set goes empty and the
+    # gate silently passes on real violations.
+    outer = tmp_path
+    tree = outer / "vendor" / "project"
+    tree.mkdir(parents=True)
+    seed_changed_only_tree(tree)
+    # re-root git at the OUTER directory (the nested-checkout shape)
+    import shutil
+
+    shutil.rmtree(tree / ".git")
+    _git(outer, "init", "-q")
+    _git(outer, "add", "-A")
+    _git(outer, "commit", "-qm", "seed")
+    (tree / "registrar_tpu" / "util.py").write_text(
+        "def helper():\n    return 2\n"
+    )
+    proc = run_changed_only(tree)
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    assert "[mutable-default]" in proc.stdout
+
+
+def test_stats_summary_and_json_stats(tmp_path):
+    tree = seed_program_tree(tmp_path, {
+        "registrar_tpu/seeded.py": "x = 1\n",
+    })
+    proc = run_checker("registrar_tpu", "--no-baseline", "--stats", cwd=tree)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "check --stats:" in proc.stderr
+    assert "modules" in proc.stderr
+    proc = run_checker(
+        "registrar_tpu", "--no-baseline", "--format", "json", cwd=tree
+    )
+    report = json.loads(proc.stdout)
+    stats = report["stats"]
+    assert stats["program"]["modules"] == 1
+    assert "elapsed_s" in stats
+    assert set(stats["program_rules_s"]) == set(PROGRAM_SEEDED_VIOLATIONS)
+
+
+def test_max_seconds_budget_fails_gate(tmp_path):
+    tree = seed_program_tree(tmp_path, {
+        "registrar_tpu/seeded.py": "x = 1\n",
+    })
+    proc = run_checker(
+        "registrar_tpu", "--no-baseline", "--max-seconds", "0", cwd=tree
+    )
+    assert proc.returncode == 1
+    assert "--max-seconds" in proc.stderr
 
 
 @pytest.mark.skipif(

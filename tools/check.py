@@ -6,14 +6,18 @@ The reference gates its build on jsl + jsstyle with shipped configs
 this is the rebuild's equivalent, implemented on the stdlib ``ast``
 module because the image ships no third-party linter.  It grew from two
 rules (undefined names, unused imports) into the multi-rule framework in
-``tools/checklib/`` — asyncio concurrency rules, inline suppressions,
-and a checked-in baseline; see docs/CHECKS.md for the catalog, the
-suppression syntax, and how to add a rule.
+``tools/checklib/`` — file-local asyncio/hygiene rules plus a
+whole-program generation (cross-module symbol table over the real
+import graph, call graph with async propagation, event-name and
+config-key contract diffs), inline suppressions, and a checked-in
+baseline; see docs/CHECKS.md for the catalog, the suppression syntax,
+and how to add a rule.
 
 Usage::
 
     python tools/check.py [paths...] [--format json] [--output FILE]
                           [--no-baseline] [--write-baseline] [--list-rules]
+                          [--changed-only] [--stats] [--max-seconds N]
 
 Defaults to the package, tests, and top-level scripts; exits 1 if
 anything is flagged (after suppressions and the baseline), 2 on a

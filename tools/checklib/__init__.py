@@ -15,10 +15,19 @@ Layout:
   * ``scopes.py``    — scope-chain resolver (undefined-name / unused-import)
   * ``context.py``   — per-file parse + derived facts shared by rules
   * ``registry.py``  — the rule registry and ``@rule`` decorator
-  * ``rules_names.py``, ``rules_async.py``, ``rules_hygiene.py`` — rules
+  * ``program.py``   — the whole-program model: cross-module symbol
+    table over the real import graph, per-function call sites, event
+    names, config-key reads (generation 2)
+  * ``callgraph.py`` — resolved call graph + the interprocedural
+    fixpoints (async→blocking chains, single-flight-lock protection)
+  * ``rules_names.py``, ``rules_async.py``, ``rules_hygiene.py`` —
+    file-local rules; ``rules_flow.py``, ``rules_contracts.py`` — the
+    whole-program rules
   * ``suppress.py``  — ``# check: disable=<rule> -- why`` comments
   * ``baseline.py``  — grandfathered findings (tools/check-baseline.json)
-  * ``engine.py``    — file iteration, orchestration, output, exit code
+  * ``engine.py``    — file iteration, program-model orchestration,
+    output, ``--changed-only`` / ``--stats`` / ``--max-seconds``, exit
+    code
 
 ``tools/check.py`` is the CLI shim; docs/CHECKS.md is the operator-facing
 rule catalog (including how to add a rule).
@@ -32,5 +41,7 @@ from checklib.engine import check_file, main, run  # noqa: F401
 import checklib.rules_names  # check: disable=unused-import -- import registers the rules
 import checklib.rules_async  # check: disable=unused-import -- import registers the rules
 import checklib.rules_hygiene  # check: disable=unused-import -- import registers the rules
+import checklib.rules_flow  # check: disable=unused-import -- import registers the rules
+import checklib.rules_contracts  # check: disable=unused-import -- import registers the rules
 
 __all__ = ["Finding", "RULES", "rule", "check_file", "run", "main"]

@@ -1,0 +1,379 @@
+"""Call-graph construction and the interprocedural fixpoints.
+
+Built lazily on top of :class:`checklib.program.ProgramModel`: every
+call site is resolved (or deliberately left unresolved — see program.py
+for the conservatism contract) to one of
+
+  * ``("func", FunctionInfo)`` — a function/method the model holds;
+  * ``("ext", "dotted.name")`` — a callable outside the model whose full
+    dotted name is still known (``time.sleep``, ``subprocess.run``);
+  * ``None`` — unknown (shadowed name, object-attribute dispatch,
+    degraded module, dynamic anything).
+
+On the resolved edges three analyses run:
+
+  * **blocking facts** — per function, the event-loop-blocking
+    primitives it calls *directly* (the rules_async.BLOCKING_CALLS set
+    plus write-mode ``open``), and from those the shortest sync-only
+    call chain from any function to a blocking primitive;
+  * **lock protection** — a greatest fixpoint marking functions whose
+    every resolved incoming call edge is protected by the single-flight
+    lock (``async with <...lock>`` lexically, or a caller that is itself
+    always-locked);
+  * **mutator chains** — shortest resolved chain from a call site to a
+    ZooKeeper-mutating primitive (program.ZK_MUTATORS), skipping
+    interior call sites that are already under a lexical lock block
+    (those sites honor the invariant on their own).
+"""
+
+from __future__ import annotations
+
+from collections import deque
+from typing import Dict, List, Optional, Set, Tuple
+
+from checklib.program import (
+    CallSite,
+    FunctionInfo,
+    ModuleInfo,
+    ProgramModel,
+    ZK_MUTATORS,
+)
+from checklib.rules_async import BLOCKING_CALLS, _open_mode
+
+
+class CallGraph:
+    def __init__(self, model: ProgramModel):
+        self.model = model
+        #: FunctionInfo -> list of (caller CallSite) — resolved edges in
+        self.callers: Dict[FunctionInfo, List[CallSite]] = {}
+        #: CallSite -> resolution (computed once, cached)
+        self._resolved: Dict[int, object] = {}
+        self.edge_count = 0
+        for site in model.all_call_sites():
+            res = self.resolve(site)
+            if res is not None and res[0] == "func":
+                self.callers.setdefault(res[1], []).append(site)
+                self.edge_count += 1
+        self._always_locked: Optional[Set[FunctionInfo]] = None
+        self._blocking_facts: Optional[
+            Dict[FunctionInfo, List[Tuple[str, int]]]
+        ] = None
+
+    # -- resolution -------------------------------------------------------
+
+    def resolve(self, site: CallSite):
+        key = id(site)
+        if key not in self._resolved:
+            self._resolved[key] = self._resolve(site)
+        return self._resolved[key]
+
+    def _resolve(self, site: CallSite):
+        mod = site.func.module
+        if site.shape[0] == "opaque":
+            return None
+        if site.shape[0] == "name":
+            return self._resolve_name(site, mod, site.shape[1])
+        base, attrs = site.shape[1], site.shape[2]
+        if base in ("self", "cls"):
+            if len(attrs) != 1 or site.func.cls is None:
+                return None
+            return self._resolve_method(mod, site.func.cls, attrs[0])
+        if base in site.func.param_chain():
+            return None  # the receiver is a parameter: unknown object
+        target = self._module_binding_target(mod, base)
+        if target is None:
+            return None
+        kind, value = target
+        if kind == "module":
+            return self._resolve_module_attr(value, attrs)
+        if kind == "ext":
+            return "ext", value + "." + ".".join(attrs)
+        return None  # class/def/assign receiver: object dispatch
+
+    def _resolve_name(self, site: CallSite, mod: ModuleInfo, name: str):
+        if name in site.func.param_chain():
+            return None
+        # nested defs of the enclosing function chain win first
+        f: Optional[FunctionInfo] = site.func
+        while f is not None:
+            if name in f.children:
+                return "func", f.children[name]
+            f = f.parent
+        if mod.degraded:
+            return None
+        kinds = mod.bindings.get(name)
+        if kinds is None or len(kinds) != 1:
+            return None  # unbound here, or ambiguous (re-bound)
+        kind = next(iter(kinds))
+        if kind == "def":
+            target = mod.functions.get(name)
+            return ("func", target) if target is not None else None
+        if kind == "import":
+            if name in mod.from_imports:
+                source, orig = mod.from_imports[name]
+                sub = f"{source}.{orig}"
+                if sub in self.model.modules:
+                    return None  # a module object called bare: not a call
+                if source in self.model.modules:
+                    target = self.model.modules[source].functions.get(orig)
+                    return ("func", target) if target is not None else None
+                return "ext", f"{source}.{orig}"
+            # `import x` then bare `x()` — not a function call we model
+            return None
+        return None
+
+    def _resolve_method(self, mod: ModuleInfo, cls_name: str, attr: str):
+        seen: Set[str] = set()
+        frontier = [(mod, cls_name)]
+        while frontier:
+            m, cname = frontier.pop()
+            if (m.name, cname) in seen:
+                continue
+            seen.add((m.name, cname))
+            cls = m.classes.get(cname)
+            if cls is None:
+                continue
+            if attr in cls.methods:
+                return "func", cls.methods[attr]
+            for base, battrs in cls.bases:
+                resolved = self._resolve_class_ref(m, base, battrs)
+                if resolved is not None:
+                    frontier.append(resolved)
+        return None
+
+    def _resolve_class_ref(self, mod: ModuleInfo, base: str, attrs):
+        """(module, class-name) for a base-class expression, if the model
+        can see it."""
+        if not attrs:
+            if base in mod.classes:
+                return mod, base
+            src = mod.from_imports.get(base)
+            if src is not None and src[0] in self.model.modules:
+                target = self.model.modules[src[0]]
+                if src[1] in target.classes:
+                    return target, src[1]
+            return None
+        if len(attrs) == 1 and base in mod.imports:
+            target_name = mod.imports[base]
+            target = self.model.modules.get(target_name)
+            if target is not None and attrs[0] in target.classes:
+                return target, attrs[0]
+        return None
+
+    def _module_binding_target(self, mod: ModuleInfo, base: str):
+        """What a dotted call's base name IS at module level: a model or
+        external module, or an in-model object (class/def) — None when
+        ambiguous or unknown."""
+        if mod.degraded:
+            return None
+        kinds = mod.bindings.get(base)
+        if kinds is None or len(kinds) != 1:
+            return None
+        kind = next(iter(kinds))
+        if kind != "import":
+            return ("obj", base) if kind in ("class", "def") else None
+        if base in mod.imports:
+            target = mod.imports[base]
+            if target in self.model.modules:
+                return "module", self.model.modules[target]
+            return "ext", target
+        if base in mod.from_imports:
+            source, orig = mod.from_imports[base]
+            sub = f"{source}.{orig}"
+            if sub in self.model.modules:
+                return "module", self.model.modules[sub]
+            if source in self.model.modules:
+                src_mod = self.model.modules[source]
+                if orig in src_mod.classes:
+                    return "obj", orig
+                return None  # some in-model object we can't follow
+            return "ext", f"{source}.{orig}"
+        return None
+
+    def _resolve_module_attr(self, target: ModuleInfo, attrs):
+        if len(attrs) == 1:
+            fn = target.functions.get(attrs[0])
+            if fn is not None:
+                return "func", fn
+        return None
+
+    # -- blocking facts ---------------------------------------------------
+
+    def blocking_facts(self) -> Dict[FunctionInfo, List[Tuple[str, int]]]:
+        """function -> [(primitive, lineno)] it calls *directly*."""
+        if self._blocking_facts is not None:
+            return self._blocking_facts
+        facts: Dict[FunctionInfo, List[Tuple[str, int]]] = {}
+        for site in self.model.all_call_sites():
+            prim = self.blocking_primitive(site)
+            if prim is not None:
+                facts.setdefault(site.func, []).append(
+                    (prim, site.lineno)
+                )
+        self._blocking_facts = facts
+        return facts
+
+    def blocking_primitive(self, site: CallSite) -> Optional[str]:
+        """The loop-blocking primitive this site calls, if any."""
+        if site.shape[0] == "name":
+            if site.shape[1] == "open" and "open" not in (
+                site.func.param_chain()
+            ):
+                mode = _open_mode(site.node)
+                if mode is not None and any(c in mode for c in "wax+"):
+                    return f"open(..., {mode!r})"
+            res = self.resolve(site)
+            if res is not None and res[0] == "ext" and res[1] in BLOCKING_CALLS:
+                return res[1]
+            return None
+        if site.shape[0] == "dotted":
+            dotted = ".".join((site.shape[1],) + site.shape[2])
+            if dotted in BLOCKING_CALLS:
+                # only when the base really is that module (not shadowed)
+                if site.shape[1] not in site.func.param_chain():
+                    return dotted
+            res = self.resolve(site)
+            if res is not None and res[0] == "ext" and res[1] in BLOCKING_CALLS:
+                return res[1]
+        return None
+
+    def blocking_chain(
+        self, start: FunctionInfo
+    ) -> Optional[List[Tuple[str, str, int]]]:
+        """Shortest sync-only chain ``[(func-ref, rel_path, line), ...,
+        (primitive, rel_path, line)]`` from ``start`` (a sync function)
+        to a blocking primitive, or None."""
+        facts = self.blocking_facts()
+        seen: Set[FunctionInfo] = {start}
+        queue: deque = deque([(start, [])])
+        while queue:
+            func, path = queue.popleft()
+            direct = facts.get(func)
+            if direct:
+                prim, line = direct[0]
+                return path + [
+                    (func.ref, func.module.rel_path, func.lineno),
+                    (prim, func.module.rel_path, line),
+                ]
+            for site in func.calls:
+                res = self.resolve(site)
+                if res is None or res[0] != "func":
+                    continue
+                callee = res[1]
+                if callee.is_async or callee in seen:
+                    continue
+                seen.add(callee)
+                queue.append(
+                    (
+                        callee,
+                        path + [(func.ref, func.module.rel_path,
+                                 site.lineno)],
+                    )
+                )
+        return None
+
+    # -- lock protection --------------------------------------------------
+
+    def always_locked(self) -> Set[FunctionInfo]:
+        """Functions whose every resolved incoming call edge is lock-
+        protected.  Greatest fixpoint: start from "every function with at
+        least one caller", then drop any with an unprotected edge until
+        stable.  (A call cycle with no outside caller stays optimistic —
+        the conservative direction for a *reporting* rule is fewer
+        findings, never a guessed one.)"""
+        if self._always_locked is not None:
+            return self._always_locked
+        locked = {f for f in self.callers if self.callers[f]}
+        changed = True
+        while changed:
+            changed = False
+            for func in list(locked):
+                for site in self.callers[func]:
+                    if site.under_lock:
+                        continue
+                    if site.func in locked:
+                        continue
+                    locked.discard(func)
+                    changed = True
+                    break
+        self._always_locked = locked
+        return locked
+
+    # -- mutator chains ---------------------------------------------------
+
+    def mutator_primitive(self, site: CallSite) -> Optional[str]:
+        """``zk.put``-style ZooKeeper mutator at this site, if any.
+
+        The receiver must be an *opaque object* (a parameter, ``self``,
+        a local) — a base resolving to a module (``os.unlink``) or to a
+        model class/def (``Op.delete`` building a request) is something
+        else wearing the same method name."""
+        if site.shape[0] != "dotted":
+            return None
+        base, attrs = site.shape[1], site.shape[2]
+        if attrs[-1] not in ZK_MUTATORS:
+            return None
+        if base not in ("self", "cls") and base not in (
+            site.func.param_chain()
+        ):
+            if self._module_binding_target(site.func.module, base) is not None:
+                return None
+        return ".".join((base,) + attrs)
+
+    def mutator_chain(
+        self, site: CallSite
+    ) -> Optional[List[Tuple[str, str, int]]]:
+        """Shortest chain from ``site`` to a ZK mutator primitive through
+        resolved, *unlocked* interior call sites.  The site itself being
+        a primitive yields a single-hop chain."""
+        prim = self.mutator_primitive(site)
+        start_hop = (site.func.ref, site.func.module.rel_path, site.lineno)
+        if prim is not None:
+            return [start_hop, (prim, site.func.module.rel_path,
+                                site.lineno)]
+        res = self.resolve(site)
+        if res is None or res[0] != "func":
+            return None
+        seen: Set[FunctionInfo] = {res[1]}
+        queue: deque = deque([(res[1], [start_hop])])
+        while queue:
+            func, path = queue.popleft()
+            hop = (func.ref, func.module.rel_path, func.lineno)
+            for inner in func.calls:
+                if inner.under_lock:
+                    continue  # honors the invariant on its own
+                prim = self.mutator_primitive(inner)
+                if prim is not None:
+                    return path + [
+                        (func.ref, func.module.rel_path, inner.lineno),
+                        (prim, func.module.rel_path, inner.lineno),
+                    ]
+            for inner in func.calls:
+                if inner.under_lock:
+                    continue
+                r = self.resolve(inner)
+                if r is None or r[0] != "func" or r[1] in seen:
+                    continue
+                seen.add(r[1])
+                queue.append(
+                    (r[1], path + [(func.ref, func.module.rel_path,
+                                    inner.lineno)])
+                )
+        return None
+
+    def stats(self) -> dict:
+        return {"resolved_edges": self.edge_count}
+
+
+def chain_names(chain) -> str:
+    """Render a chain as ``a -> b -> c`` (names only: stable under line
+    drift, so it can live in the finding message / baseline key)."""
+    return " -> ".join(hop[0] for hop in chain)
+
+
+def chain_evidence(chain) -> List[dict]:
+    """Structured chain for the JSON report."""
+    return [
+        {"symbol": sym, "path": path, "line": line}
+        for sym, path, line in chain
+    ]

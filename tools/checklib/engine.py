@@ -1,5 +1,22 @@
-"""Orchestration: iterate files, run rules, apply suppressions and the
-baseline, render text or JSON, exit nonzero on anything left."""
+"""Orchestration: iterate files, run the file rules, build the
+whole-program model once, run the program rules over it, apply
+suppressions and the baseline, render text or JSON, exit nonzero on
+anything left.
+
+Two rule generations share one run (docs/CHECKS.md):
+
+  * file rules see one :class:`~checklib.context.FileContext` each;
+  * program rules (``scope="program"``) see the single
+    :class:`~checklib.program.ProgramModel` built from EVERY parsed
+    file, and their findings are routed back through the target file's
+    inline suppressions before the unused-suppression sweep runs.
+
+``--changed-only`` narrows the *file-rule* pass to ``git status`` files
+plus their reverse-dependency closure over the import graph; the program
+model (and every program rule) still sees the full target set, so a
+change that breaks a cross-module contract is reported even when the
+breakage surfaces in an unchanged file.
+"""
 
 from __future__ import annotations
 
@@ -7,14 +24,21 @@ import argparse
 import ast
 import json
 import os
+import subprocess
 import sys
-from typing import List, Optional
+import time
+from typing import Dict, List, Optional
 
 from checklib import baseline as baseline_mod
-from checklib.context import FileContext
+from checklib.context import PACKAGE_PREFIX, FileContext
 from checklib.model import Finding
 from checklib.registry import ENGINE_RULES, RULES
-from checklib.suppress import apply_suppressions, parse_suppressions
+from checklib.suppress import (
+    apply_suppressions,
+    filter_findings,
+    parse_suppressions,
+    unused_findings,
+)
 
 DEFAULT_TARGETS = [
     "registrar_tpu",
@@ -67,22 +91,15 @@ def iter_python_files(targets):
             raise FileNotFoundError(f"check target does not exist: {target}")
 
 
-def check_file(path: str, rel_path: Optional[str] = None) -> List[Finding]:
-    """All findings for one file, inline suppressions applied (the
-    baseline is a whole-run concept and is applied by :func:`run`).
-
-    ``rel_path`` overrides the reported path — the package-scoped rules
-    key off it (see checklib.context.PACKAGE_PREFIX), and tests use it
-    to exercise them on fixtures outside the package tree.
-    """
-    if rel_path is None:
-        rel_path = _default_rel_path(path)
+def _parse_file(path: str, rel_path: str):
+    """(ctx, engine_findings): ctx is None when the file doesn't parse
+    (the syntax-error finding replaces every analysis)."""
     with open(path, "rb") as fh:
         source = fh.read()
     try:
         tree = ast.parse(source, filename=path)
     except SyntaxError as err:
-        return [
+        return None, [
             Finding(
                 "syntax-error",
                 rel_path,
@@ -92,6 +109,24 @@ def check_file(path: str, rel_path: Optional[str] = None) -> List[Finding]:
         ]
     ctx = FileContext(path, rel_path, source, tree)
     problems = parse_suppressions(ctx)
+    return ctx, problems
+
+
+def check_file(path: str, rel_path: Optional[str] = None) -> List[Finding]:
+    """All FILE-rule findings for one file, inline suppressions applied
+    (the baseline is a whole-run concept and is applied by :func:`run`;
+    the whole-program rules need the full program model and only run
+    there too).
+
+    ``rel_path`` overrides the reported path — the package-scoped rules
+    key off it (see checklib.context.PACKAGE_PREFIX), and tests use it
+    to exercise them on fixtures outside the package tree.
+    """
+    if rel_path is None:
+        rel_path = _default_rel_path(path)
+    ctx, problems = _parse_file(path, rel_path)
+    if ctx is None:
+        return problems
     findings: List[Finding] = []
     for rule in RULES.values():
         if rule.applies_to(ctx):
@@ -101,12 +136,62 @@ def check_file(path: str, rel_path: Optional[str] = None) -> List[Finding]:
     return findings
 
 
+def _git_changed_rel_paths() -> List[str]:
+    """REPO_ROOT-relative paths `git status --porcelain` reports changed
+    (staged, unstaged, and untracked — the pre-commit surface).
+
+    git prints paths relative to the repository TOP-LEVEL; when this
+    tree is checked out as a subdirectory of a larger repo the subdir
+    prefix must be stripped (and paths outside it dropped), or the
+    intersection with the checked files would be empty and the narrowed
+    run would silently pass on everything."""
+    proc = subprocess.run(
+        ["git", "-C", REPO_ROOT, "status", "--porcelain",
+         "--untracked-files=all"],
+        capture_output=True,
+        text=True,
+    )
+    if proc.returncode != 0:
+        raise ValueError(
+            "--changed-only needs a git checkout: "
+            + proc.stderr.strip()
+        )
+    prefix_proc = subprocess.run(
+        ["git", "-C", REPO_ROOT, "rev-parse", "--show-prefix"],
+        capture_output=True,
+        text=True,
+    )
+    prefix = prefix_proc.stdout.strip() if prefix_proc.returncode == 0 else ""
+    out: List[str] = []
+    for line in proc.stdout.split("\n"):
+        if len(line) < 4:
+            continue
+        path = line[3:]
+        if " -> " in path:  # rename: the new side is the checked one
+            path = path.split(" -> ", 1)[1]
+        path = path.strip().strip('"')
+        if not path:
+            continue
+        path = path.replace(os.sep, "/")
+        if prefix:
+            if not path.startswith(prefix):
+                continue  # changed outside this tree: not ours to lint
+            path = path[len(prefix):]
+        out.append(path)
+    return out
+
+
 def run(
     targets,
     baseline_path: Optional[str] = None,
+    changed_only: bool = False,
 ) -> "RunResult":
     """Check every file under ``targets``; apply the baseline if given."""
+    from checklib.program import ProgramModel
+
+    t0 = time.monotonic()
     findings: List[Finding] = []
+    contexts: List[FileContext] = []
     checked_rel_paths = set()
     # Directory targets define the run's *coverage*: a baseline entry
     # under one of these prefixes was either checked, or names a file
@@ -126,14 +211,89 @@ def run(
         if rel in checked_rel_paths:
             continue  # overlapping targets: check (and count) each file once
         checked_rel_paths.add(rel)
-        findings.extend(check_file(path, rel_path=rel))
+        ctx, engine_findings = _parse_file(path, rel)
+        findings.extend(engine_findings)
+        if ctx is not None:
+            contexts.append(ctx)
+
+    t_parse = time.monotonic()
+    model = ProgramModel(contexts)
+
+    # --changed-only: the file-rule pass narrows to changed files plus
+    # everything that imports them (a helper's contract change must
+    # re-lint its consumers); the program rules below still see the
+    # full model.
+    if changed_only:
+        changed = set(_git_changed_rel_paths())
+        narrowed_set = model.reverse_import_closure(
+            {c.rel_path for c in contexts if c.rel_path in changed}
+        )
+        narrowed = [c for c in contexts if c.rel_path in narrowed_set]
+    else:
+        narrowed = contexts
+
+    t_model = time.monotonic()
+    file_rules = [r for r in RULES.values() if not r.is_program]
+    program_rules = [r for r in RULES.values() if r.is_program]
+    for ctx in narrowed:
+        ctx_findings: List[Finding] = []
+        for rule in file_rules:
+            if rule.applies_to(ctx):
+                ctx_findings.extend(rule.run(ctx))
+        findings.extend(filter_findings(ctx, ctx_findings))
+
+    t_file_rules = time.monotonic()
+    # Program rules need a real program: a run whose directory coverage
+    # does not include the package root (`check.py registrar_tpu/zk`, a
+    # single file) would hand them an artificially small model and turn
+    # out-of-coverage listeners/accessors into false findings — skip
+    # them instead; the gate invocations (full tree, --changed-only)
+    # always cover the package.
+    package_covered = any(
+        pre == "" or PACKAGE_PREFIX.startswith(pre)
+        for pre in covered_prefixes
+    )
+    if not package_covered:
+        program_rules = []
+    ctx_by_path = {c.rel_path: c for c in contexts}
+    program_timings: Dict[str, float] = {}
+    for rule in program_rules:
+        r0 = time.monotonic()
+        produced = list(rule.func(model))
+        by_ctx: Dict[str, List[Finding]] = {}
+        passthrough: List[Finding] = []
+        for f in produced:
+            if f.path in ctx_by_path:
+                by_ctx.setdefault(f.path, []).append(f)
+            else:
+                passthrough.append(f)  # docs/json targets: no directives
+        for rel, fs in by_ctx.items():
+            findings.extend(filter_findings(ctx_by_path[rel], fs))
+        findings.extend(passthrough)
+        program_timings[rule.name] = round(time.monotonic() - r0, 4)
+
+    # Unused-suppression sweep LAST, and only over files whose file
+    # rules actually ran — in a narrowed run, a suppression in an
+    # unchecked file may well cover a finding this run never produced.
+    for ctx in narrowed:
+        findings.extend(unused_findings(ctx))
+
     findings.sort(key=Finding.sort_key)
     grandfathered = 0
 
-    def in_scope(p):
-        return p in checked_rel_paths or any(
-            p.startswith(pre) for pre in covered_prefixes
-        )
+    if changed_only:
+        # Staleness in a narrowed run is only judged for files the file
+        # rules covered (program findings for other files still match
+        # their baseline entries; they are just never condemned here).
+        narrowed_rels = {c.rel_path for c in narrowed}
+
+        def in_scope(p):
+            return p in narrowed_rels
+    else:
+        def in_scope(p):
+            return p in checked_rel_paths or any(
+                p.startswith(pre) for pre in covered_prefixes
+            )
 
     if baseline_path is not None:
         bl = baseline_mod.load(baseline_path)
@@ -144,18 +304,42 @@ def run(
             findings, bl, rel_bl, in_scope=in_scope
         )
         findings.sort(key=Finding.sort_key)
-    return RunResult(findings, len(checked_rel_paths), grandfathered, in_scope)
+    t_end = time.monotonic()
+    stats = {
+        "elapsed_s": round(t_end - t0, 4),
+        "parse_s": round(t_parse - t0, 4),
+        "model_s": round(t_model - t_parse, 4),
+        "file_rules_s": round(t_file_rules - t_model, 4),
+        "program_rules_s": {
+            k: v for k, v in sorted(program_timings.items())
+        },
+        "checked_files": len(checked_rel_paths),
+        "analyzed_files": len(narrowed),
+        "program": model.stats(),
+    }
+    graph = getattr(model, "_callgraph", None)
+    if graph is not None:
+        stats["program"].update(graph.stats())
+    return RunResult(
+        findings, len(checked_rel_paths), grandfathered, in_scope, stats
+    )
 
 
 class RunResult:
-    __slots__ = ("findings", "checked_files", "grandfathered", "in_scope")
+    __slots__ = (
+        "findings", "checked_files", "grandfathered", "in_scope", "stats",
+    )
 
-    def __init__(self, findings, checked_files, grandfathered, in_scope=None):
+    def __init__(
+        self, findings, checked_files, grandfathered, in_scope=None,
+        stats=None,
+    ):
         self.findings = findings
         self.checked_files = checked_files
         self.grandfathered = grandfathered
         #: rel-path -> bool: was this path covered by the run's targets?
         self.in_scope = in_scope or (lambda p: True)
+        self.stats = stats or {}
 
     def to_dict(self) -> dict:
         return {
@@ -164,6 +348,7 @@ class RunResult:
             "grandfathered": self.grandfathered,
             "problem_count": len(self.findings),
             "problems": [f.to_dict() for f in self.findings],
+            "stats": self.stats,
         }
 
 
@@ -184,10 +369,39 @@ def _summary(result: RunResult) -> str:
     )
 
 
+def _render_stats(result: RunResult) -> str:
+    s = result.stats
+    prog = s.get("program", {})
+    rule_times = ", ".join(
+        f"{k}={v:.3f}s" for k, v in s.get("program_rules_s", {}).items()
+    )
+    return (
+        "check --stats: "
+        f"{s.get('checked_files', 0)} files "
+        f"({s.get('analyzed_files', 0)} through file rules), "
+        f"{prog.get('modules', 0)} modules, "
+        f"{prog.get('import_edges', 0)} import edges, "
+        f"{prog.get('functions', 0)} functions, "
+        f"{prog.get('call_sites', 0)} call sites, "
+        f"{prog.get('resolved_edges', 0)} resolved call edges, "
+        f"{prog.get('event_sites', 0)} event sites; "
+        f"parse {s.get('parse_s', 0):.3f}s, "
+        f"model {s.get('model_s', 0):.3f}s, "
+        f"file rules {s.get('file_rules_s', 0):.3f}s, "
+        f"program rules [{rule_times}]; "
+        f"total {s.get('elapsed_s', 0):.3f}s"
+    )
+
+
 def _list_rules() -> str:
     lines = ["rules (suppress with '# check: disable=<rule> -- <why>'):"]
     for rule in RULES.values():
-        where = "" if rule.scope == "all" else "  [package-only]"
+        if rule.is_program:
+            where = "  [whole-program]"
+        elif rule.scope == "package":
+            where = "  [package-only]"
+        else:
+            where = ""
         lines.append(f"  {rule.name:24s} {rule.description}{where}")
     lines.append("engine findings (not directly suppressible rules):")
     for name, desc in ENGINE_RULES.items():
@@ -223,6 +437,25 @@ def main(argv) -> int:
         "--write-baseline",
         action="store_true",
         help="regenerate the baseline from the current findings and exit",
+    )
+    parser.add_argument(
+        "--changed-only",
+        action="store_true",
+        help="file rules only on `git status` files + their reverse-"
+        "dependency closure; program rules still see the full target "
+        "set (the fast pre-commit path)",
+    )
+    parser.add_argument(
+        "--stats",
+        action="store_true",
+        help="print a program-model/timing summary to stderr",
+    )
+    parser.add_argument(
+        "--max-seconds",
+        type=float,
+        default=None,
+        help="fail (exit 1) when the run exceeds this wall-clock budget "
+        "(the CI guard against an analysis-cost regression)",
     )
     parser.add_argument(
         "--list-rules", action="store_true", help="print the rule catalog"
@@ -271,6 +504,7 @@ def main(argv) -> int:
         result = run(
             targets,
             baseline_path=None if args.no_baseline else args.baseline,
+            changed_only=args.changed_only,
         )
     except (FileNotFoundError, ValueError) as err:
         print(f"check: {err}", file=sys.stderr)
@@ -289,6 +523,20 @@ def main(argv) -> int:
     finally:
         if close is not None:
             close.close()
+
+    if args.stats:
+        print(_render_stats(result), file=sys.stderr)
+
+    if args.max_seconds is not None:
+        elapsed = result.stats.get("elapsed_s", 0.0)
+        if elapsed > args.max_seconds:
+            print(
+                f"check: analysis took {elapsed:.2f}s, over the "
+                f"--max-seconds {args.max_seconds:.2f}s budget "
+                "(quadratic fixpoint regression?)",
+                file=sys.stderr,
+            )
+            return 1
 
     if result.findings:
         print(_summary(result), file=sys.stderr)

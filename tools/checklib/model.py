@@ -15,13 +15,21 @@ class Finding:
     entry or an unreadable file).
     """
 
-    __slots__ = ("rule", "path", "line", "message")
+    __slots__ = ("rule", "path", "line", "message", "chain")
 
-    def __init__(self, rule: str, path: str, line: int, message: str):
+    def __init__(
+        self, rule: str, path: str, line: int, message: str, chain=None
+    ):
         self.rule = rule
         self.path = path
         self.line = line
         self.message = message
+        #: Optional structured call-chain evidence (whole-program rules):
+        #: a list of {"symbol", "path", "line"} hops, rendered into the
+        #: JSON report only.  The message carries the chain as names —
+        #: stable under line drift — so the baseline identity (path,
+        #: rule, message) still pins WHICH chain was grandfathered.
+        self.chain = chain
 
     def key(self):
         """Baseline identity: everything but the line number."""
@@ -31,12 +39,15 @@ class Finding:
         return (self.path, self.line, self.rule, self.message)
 
     def to_dict(self) -> dict:
-        return {
+        out = {
             "path": self.path,
             "line": self.line,
             "rule": self.rule,
             "message": self.message,
         }
+        if self.chain is not None:
+            out["chain"] = self.chain
+        return out
 
     def render(self) -> str:
         return f"{self.path}:{self.line}: [{self.rule}] {self.message}"

@@ -1,0 +1,668 @@
+"""The whole-program model (generation 2 of the checker).
+
+One pass over every parsed file builds a *program* out of the per-file
+contexts the engine already holds: a cross-module symbol table (module
+-> exported defs/classes, resolved through the package's real import
+graph), per-function call sites annotated with the facts the flow rules
+need (enclosing async frame, lexical single-flight-lock block, awaited /
+bare-statement position), the event-name surface (constant-string
+``.emit``/``.on``/``.once``/``.wait_for`` sites), and the config-key
+reads of the accessor modules.  :mod:`checklib.callgraph` turns the call
+sites into a resolved call graph; the rules in ``rules_flow.py`` /
+``rules_contracts.py`` consume both.
+
+Resolution is deliberately conservative — the same zero-false-positive
+contract as the file-local rules:
+
+  * a name is only resolved when it has exactly ONE module-level binding
+    kind (one ``def``, or one import) and is not shadowed by a parameter
+    of any enclosing function at the call site;
+  * a module containing ``from x import *`` or a dynamic import
+    (``__import__``, ``importlib.import_module``) degrades to
+    file-local: no name inside it resolves cross-module (its own
+    top-level defs stay resolvable *from elsewhere* — a def is a def);
+  * ``getattr`` dispatch, calls through parameters/attributes of
+    unknown objects, and non-constant event names are simply not
+    modeled (conservative silence, never a guess).
+
+Import cycles are harmless by construction: the model never executes
+imports, it only maps names, so ``a -> b -> a`` resolves exactly like
+any other edge.
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+import os
+import re
+from typing import Dict, List, Optional, Set, Tuple
+
+from checklib.context import FileContext, PACKAGE_PREFIX
+
+#: Attribute names that mutate znode state on a ZKClient (or build the
+#: mutating ops of a multi/pipeline) — the primitives the
+#: await-in-lock-free-mutator rule treats as "touches ZooKeeper".
+ZK_MUTATORS = frozenset(
+    {
+        "create",
+        "create_ephemeral_plus",
+        "put",
+        "set_data",
+        "unlink",
+        "delete",
+        "mkdirp",
+        "multi",
+        "pipeline",
+    }
+)
+
+#: ``async with <name>:`` context expressions whose final component
+#: matches this are treated as the agent's single-flight guard (the
+#: PR 3 invariant: ``repair_lock`` / ``lock`` / ``self.lock``).
+_LOCK_NAME = re.compile(r"(^|_)lock$", re.IGNORECASE)
+
+#: Listener-registering EventEmitter methods with a constant event name.
+_LISTEN_METHODS = frozenset({"on", "once", "wait_for"})
+
+
+def module_name_for(rel_path: str) -> str:
+    """Dotted module name a checked file imports as (posix rel path).
+
+    The checker's own tree is special: ``tools/`` sits on sys.path (the
+    tools/check.py shim inserts it), so ``tools/checklib/engine.py`` is
+    imported as ``checklib.engine`` — without the strip, no import edge
+    into checklib would ever resolve and --changed-only's
+    reverse-dependency closure would silently miss its consumers."""
+    name = rel_path[:-3] if rel_path.endswith(".py") else rel_path
+    if name.endswith("/__init__"):
+        name = name[: -len("/__init__")]
+    if name.startswith("tools/"):
+        name = name[len("tools/"):]
+    return name.replace("/", ".")
+
+
+def _dotted(node) -> Optional[Tuple[str, Tuple[str, ...]]]:
+    """(base-name, attr chain) for ``a.b.c`` — (``a``, (``b``, ``c``))."""
+    attrs: List[str] = []
+    while isinstance(node, ast.Attribute):
+        attrs.append(node.attr)
+        node = node.value
+    if not isinstance(node, ast.Name):
+        return None
+    return node.id, tuple(reversed(attrs))
+
+
+def _is_lock_expr(expr) -> bool:
+    d = _dotted(expr)
+    if d is None:
+        return False
+    base, attrs = d
+    last = attrs[-1] if attrs else base
+    return bool(_LOCK_NAME.search(last))
+
+
+class CallSite:
+    """One call expression inside a function body."""
+
+    __slots__ = (
+        "node", "lineno", "shape", "awaited", "bare_stmt", "under_lock",
+        "func",
+    )
+
+    def __init__(self, node, shape, awaited, bare_stmt, under_lock, func):
+        self.node = node
+        self.lineno = node.lineno
+        #: ("name", id) | ("dotted", base, attrs) | ("opaque",)
+        self.shape = shape
+        self.awaited = awaited
+        self.bare_stmt = bare_stmt  # Expr statement: result discarded
+        self.under_lock = under_lock  # lexically inside async-with-lock
+        self.func: "FunctionInfo" = func  # enclosing function
+
+    def render(self) -> str:
+        if self.shape[0] == "name":
+            return f"{self.shape[1]}()"
+        if self.shape[0] == "dotted":
+            return ".".join((self.shape[1],) + self.shape[2]) + "()"
+        return "<call>()"
+
+
+class FunctionInfo:
+    """One ``def``/``async def`` (module-level, method, or nested)."""
+
+    __slots__ = (
+        "module", "qualname", "name", "is_async", "lineno", "cls",
+        "params", "parent", "children", "calls",
+    )
+
+    def __init__(self, module, qualname, name, is_async, lineno, cls, parent):
+        self.module: "ModuleInfo" = module
+        self.qualname = qualname  # "mod:Outer.inner" style, module-relative
+        self.name = name
+        self.is_async = is_async
+        self.lineno = lineno
+        self.cls: Optional[str] = cls  # enclosing class name, if a method
+        self.params: Set[str] = set()
+        self.parent: Optional["FunctionInfo"] = None if parent is None else parent
+        self.children: Dict[str, "FunctionInfo"] = {}
+        self.calls: List[CallSite] = []
+
+    @property
+    def ref(self) -> str:
+        return f"{self.module.name}:{self.qualname}"
+
+    def param_chain(self) -> Set[str]:
+        out: Set[str] = set()
+        f: Optional[FunctionInfo] = self
+        while f is not None:
+            out |= f.params
+            f = f.parent
+        return out
+
+
+class ClassInfo:
+    __slots__ = ("name", "methods", "bases")
+
+    def __init__(self, name):
+        self.name = name
+        self.methods: Dict[str, FunctionInfo] = {}
+        self.bases: List[Tuple[str, Tuple[str, ...]]] = []  # dotted refs
+
+
+class EventSite:
+    __slots__ = ("kind", "event", "lineno", "rel_path")
+
+    def __init__(self, kind, event, lineno, rel_path):
+        self.kind = kind  # "emit" | "listen"
+        self.event = event
+        self.lineno = lineno
+        self.rel_path = rel_path
+
+
+class ModuleInfo:
+    """Symbol-table entry for one checked file."""
+
+    __slots__ = (
+        "name", "rel_path", "ctx", "imports", "from_imports", "bindings",
+        "functions", "classes", "degraded", "dep_names", "module_func",
+        "event_sites", "key_reads",
+    )
+
+    def __init__(self, ctx: FileContext):
+        self.ctx = ctx
+        self.rel_path = ctx.rel_path
+        self.name = module_name_for(ctx.rel_path)
+        #: local alias -> full module name (``import x.y as z``)
+        self.imports: Dict[str, str] = {}
+        #: local name -> (source module, original name)
+        self.from_imports: Dict[str, Tuple[str, str]] = {}
+        #: module-level name -> set of binding kinds seen
+        #: ({"def","class","import","assign"}) — >1 kind = ambiguous
+        self.bindings: Dict[str, Set[str]] = {}
+        self.functions: Dict[str, FunctionInfo] = {}  # top-level defs
+        self.classes: Dict[str, ClassInfo] = {}
+        #: star import or dynamic import: no cross-module resolution
+        #: *inside* this module (its own defs stay visible from outside)
+        self.degraded = False
+        #: every module name ANY import statement references (function-
+        #: level imports included) — the import-graph edge set, which is
+        #: broader than the name-binding maps above (those stay
+        #: top-level: a function-local import binds no module name)
+        self.dep_names: Set[str] = set()
+        #: pseudo-function holding module-level call sites
+        self.module_func = FunctionInfo(
+            self, "<module>", "<module>", False, 0, None, None
+        )
+        self.event_sites: List[EventSite] = []
+        #: constant config keys read in this module: key -> first lineno
+        self.key_reads: Dict[str, int] = {}
+
+    def _bind(self, name: str, kind: str) -> None:
+        self.bindings.setdefault(name, set()).add(kind)
+
+
+class ProgramModel:
+    """The program: every module, plus the shared lookup helpers."""
+
+    def __init__(self, contexts: List[FileContext]):
+        self.contexts = list(contexts)
+        self.modules: Dict[str, ModuleInfo] = {}
+        #: rel_path -> ModuleInfo (rule scoping is path-based)
+        self.by_path: Dict[str, ModuleInfo] = {}
+        for ctx in contexts:
+            mod = _build_module(ctx)
+            self.modules[mod.name] = mod
+            self.by_path[mod.rel_path] = mod
+        #: importer module name -> set of imported model-module names
+        self.import_edges: Dict[str, Set[str]] = {}
+        for mod in self.modules.values():
+            deps = {d for d in mod.dep_names if d in self.modules}
+            deps.discard(mod.name)
+            self.import_edges[mod.name] = deps
+
+    # -- lookups ----------------------------------------------------------
+
+    def functions(self):
+        for mod in self.modules.values():
+            stack = list(mod.functions.values())
+            for cls in mod.classes.values():
+                stack.extend(cls.methods.values())
+            while stack:
+                f = stack.pop()
+                yield f
+                stack.extend(f.children.values())
+
+    def all_call_sites(self):
+        for f in self.functions():
+            for site in f.calls:
+                yield site
+        for mod in self.modules.values():
+            for site in mod.module_func.calls:
+                yield site
+
+    def reverse_import_closure(self, rel_paths) -> Set[str]:
+        """rel paths + everything that (transitively) imports them."""
+        by_name = {m.name: m for m in self.modules.values()}
+        importers: Dict[str, Set[str]] = {name: set() for name in by_name}
+        for src, deps in self.import_edges.items():
+            for dep in deps:
+                importers.setdefault(dep, set()).add(src)
+        seeds = [
+            self.by_path[p].name for p in rel_paths if p in self.by_path
+        ]
+        seen: Set[str] = set(seeds)
+        frontier = list(seeds)
+        while frontier:
+            name = frontier.pop()
+            for up in importers.get(name, ()):
+                if up not in seen:
+                    seen.add(up)
+                    frontier.append(up)
+        out = {p for p in rel_paths}
+        out |= {by_name[n].rel_path for n in seen}
+        return out
+
+    def package_root(self) -> Optional[str]:
+        """Filesystem directory containing the checked package tree —
+        derived from any package file's (abs path, rel path) pair, so a
+        scratch fixture tree resolves to its own docs/etc siblings."""
+        for ctx in self.contexts:
+            if not ctx.rel_path.startswith(PACKAGE_PREFIX):
+                continue
+            ap = os.path.abspath(ctx.path).replace(os.sep, "/")
+            if ap.endswith("/" + ctx.rel_path):
+                return ap[: -len("/" + ctx.rel_path)]
+        return None
+
+    def stats(self) -> dict:
+        return {
+            "modules": len(self.modules),
+            "import_edges": sum(
+                len(v) for v in self.import_edges.values()
+            ),
+            "functions": sum(1 for _ in self.functions()),
+            "call_sites": sum(1 for _ in self.all_call_sites()),
+            "event_sites": sum(
+                len(m.event_sites) for m in self.modules.values()
+            ),
+        }
+
+
+# -- per-module construction --------------------------------------------------
+
+
+_DYNAMIC_IMPORT_CALLS = frozenset({"__import__", "import_module"})
+
+
+def _build_module(ctx: FileContext) -> ModuleInfo:
+    mod = ModuleInfo(ctx)
+    pkg_parts = mod.name.split(".")[:-1]
+
+    for node in ctx.tree.body:
+        _collect_top_level(mod, node, pkg_parts)
+    # Imports / assignments hiding below conditionals still bind at
+    # module level; a second walk catches them (kind-ambiguity handles
+    # the try/except-ImportError fallback shape without guessing).
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, ast.Call):
+            d = _dotted(node.func)
+            if d is not None:
+                base, attrs = d
+                last = attrs[-1] if attrs else base
+                if last in _DYNAMIC_IMPORT_CALLS:
+                    mod.degraded = True
+        elif isinstance(node, ast.Import):
+            for alias in node.names:
+                mod.dep_names.add(alias.name)
+        elif isinstance(node, ast.ImportFrom):
+            if any(a.name == "*" for a in node.names):
+                mod.degraded = True
+            source = _resolve_from(mod, node, pkg_parts)
+            if source is not None:
+                mod.dep_names.add(source)
+                for alias in node.names:
+                    if alias.name != "*":
+                        mod.dep_names.add(f"{source}.{alias.name}")
+
+    _collect_functions(mod, ctx.tree)
+    _collect_event_sites(mod, ctx.tree)
+    _collect_key_reads(mod, ctx.tree)
+    return mod
+
+
+def _collect_top_level(mod: ModuleInfo, node, pkg_parts) -> None:
+    if isinstance(node, ast.Import):
+        for alias in node.names:
+            local = alias.asname or alias.name.split(".")[0]
+            # `import a.b` binds `a`; `import a.b as c` binds c -> a.b
+            target = alias.name if alias.asname else alias.name.split(".")[0]
+            mod.imports[local] = target
+            mod._bind(local, "import")
+    elif isinstance(node, ast.ImportFrom):
+        source = _resolve_from(mod, node, pkg_parts)
+        for alias in node.names:
+            if alias.name == "*":
+                continue
+            local = alias.asname or alias.name
+            if source is not None:
+                mod.from_imports[local] = (source, alias.name)
+            mod._bind(local, "import")
+    elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+        mod._bind(node.name, "def")
+    elif isinstance(node, ast.ClassDef):
+        mod._bind(node.name, "class")
+    elif isinstance(node, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+        targets = (
+            node.targets if isinstance(node, ast.Assign) else [node.target]
+        )
+        for t in targets:
+            for sub in ast.walk(t):
+                if isinstance(sub, ast.Name):
+                    mod._bind(sub.id, "assign")
+    elif isinstance(node, (ast.If, ast.Try)):
+        # body/orelse/finalbody statements are direct child nodes; only
+        # handler bodies hide behind a non-stmt (ExceptHandler) layer.
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, ast.stmt):
+                _collect_top_level(mod, child, pkg_parts)
+        for handler in getattr(node, "handlers", []):
+            for child in handler.body:
+                _collect_top_level(mod, child, pkg_parts)
+
+
+def _resolve_from(mod, node: ast.ImportFrom, pkg_parts) -> Optional[str]:
+    if node.level == 0:
+        return node.module
+    # relative import: drop (level-1) package components beyond the
+    # module's own package
+    base = pkg_parts[: len(pkg_parts) - (node.level - 1)]
+    if node.level - 1 > len(pkg_parts):
+        return None
+    parts = base + (node.module.split(".") if node.module else [])
+    return ".".join(parts) if parts else None
+
+
+def _collect_functions(mod: ModuleInfo, tree: ast.Module) -> None:
+    """Register every def and its call sites, threading the lexical
+    facts (enclosing function, class, async-with-lock block) along in
+    one walk.  Lambda bodies are skipped entirely (deferred execution,
+    conservative silence); decorators and argument defaults evaluate in
+    the *enclosing* frame, like rules_async._walk_state."""
+
+    def register(child, func, cls, in_class_body, qual) -> FunctionInfo:
+        name = child.name
+        child_qual = f"{qual}.{name}" if qual else name
+        info = FunctionInfo(
+            mod, child_qual, name,
+            isinstance(child, ast.AsyncFunctionDef),
+            child.lineno,
+            cls.name if (cls is not None and in_class_body) else None,
+            func if func is not mod.module_func else None,
+        )
+        args = child.args
+        for a in (
+            list(args.posonlyargs) + list(args.args)
+            + list(args.kwonlyargs)
+            + ([args.vararg] if args.vararg else [])
+            + ([args.kwarg] if args.kwarg else [])
+        ):
+            info.params.add(a.arg)
+        if cls is not None and in_class_body:
+            cls.methods[name] = info
+        elif func is mod.module_func:
+            mod.functions[name] = info
+        else:
+            func.children[name] = info
+        return info
+
+    def walk(node, func, cls, under_lock, in_class_body, qual) -> None:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            info = register(node, func, cls, in_class_body, qual)
+            for dec in node.decorator_list:
+                walk(dec, func, cls, under_lock, False, qual)
+            for default in list(node.args.defaults) + [
+                d for d in node.args.kw_defaults if d is not None
+            ]:
+                walk(default, func, cls, under_lock, False, qual)
+            for stmt in node.body:
+                walk(stmt, info, cls, False, False, info.qualname)
+            return
+        if isinstance(node, ast.ClassDef):
+            cinfo = mod.classes.setdefault(node.name, ClassInfo(node.name))
+            for base in node.bases:
+                d = _dotted(base)
+                if d is not None:
+                    cinfo.bases.append(d)
+            for dec in node.decorator_list:
+                walk(dec, func, cls, under_lock, False, qual)
+            body_qual = f"{qual}.{node.name}" if qual else node.name
+            for stmt in node.body:
+                walk(stmt, func, cinfo, under_lock, True, body_qual)
+            return
+        if isinstance(node, ast.AsyncWith):
+            locked = under_lock or any(
+                _is_lock_expr(item.context_expr) for item in node.items
+            )
+            for item in node.items:
+                walk(item.context_expr, func, cls, under_lock, False, qual)
+                if item.optional_vars is not None:
+                    walk(item.optional_vars, func, cls, under_lock,
+                         False, qual)
+            for stmt in node.body:
+                walk(stmt, func, cls, locked, False, qual)
+            return
+        if isinstance(node, ast.Lambda):
+            return
+        if isinstance(node, ast.Call):
+            d = _dotted(node.func)
+            if d is None:
+                shape = ("opaque",)
+            elif not d[1]:
+                shape = ("name", d[0])
+            else:
+                shape = ("dotted", d[0], d[1])
+            func.calls.append(
+                CallSite(
+                    node, shape,
+                    awaited=bool(getattr(node, "_chk_awaited", False)),
+                    bare_stmt=bool(getattr(node, "_chk_bare", False)),
+                    under_lock=under_lock,
+                    func=func,
+                )
+            )
+        for child in ast.iter_child_nodes(node):
+            walk(child, func, cls, under_lock, False, qual)
+
+    # Pre-annotate awaited / bare-statement calls so the walker needs no
+    # parent pointers.
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Await) and isinstance(node.value, ast.Call):
+            node.value._chk_awaited = True
+        elif isinstance(node, ast.Expr) and isinstance(node.value, ast.Call):
+            node.value._chk_bare = True
+
+    for stmt in tree.body:
+        walk(stmt, mod.module_func, None, False, False, "")
+
+
+def _collect_event_sites(mod: ModuleInfo, tree: ast.Module) -> None:
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call) or not isinstance(
+            node.func, ast.Attribute
+        ):
+            continue
+        attr = node.func.attr
+        if attr != "emit" and attr not in _LISTEN_METHODS:
+            continue
+        if not node.args:
+            continue
+        first = node.args[0]
+        if not (
+            isinstance(first, ast.Constant) and isinstance(first.value, str)
+        ):
+            continue  # dynamic event name: not modeled
+        mod.event_sites.append(
+            EventSite(
+                "emit" if attr == "emit" else "listen",
+                first.value,
+                node.lineno,
+                mod.rel_path,
+            )
+        )
+
+
+#: functions whose second positional string argument is a config key
+#: (config.py's `_ms(obj, "timeout", ...)` translation helpers).
+_KEY_HELPER = re.compile(r"(^|_)(ms|optional_ms)$")
+
+
+def _collect_key_reads(mod: ModuleInfo, tree: ast.Module) -> None:
+    def record(key: str, lineno: int) -> None:
+        if key and key not in mod.key_reads:
+            mod.key_reads[key] = lineno
+
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call):
+            d = _dotted(node.func)
+            if (
+                isinstance(node.func, ast.Attribute)
+                and node.func.attr in ("get", "pop")
+                and node.args
+                and isinstance(node.args[0], ast.Constant)
+                and isinstance(node.args[0].value, str)
+            ):
+                record(node.args[0].value, node.lineno)
+            elif (
+                d is not None
+                and not d[1]
+                and _KEY_HELPER.search(d[0])
+                and len(node.args) >= 2
+                and isinstance(node.args[1], ast.Constant)
+                and isinstance(node.args[1].value, str)
+            ):
+                record(node.args[1].value, node.lineno)
+        elif isinstance(node, ast.Subscript):
+            # Load context only: a store (`out["stdout_match"] = sm`)
+            # writes an INTERNAL dict, not a key the operator config
+            # carries.
+            sl = node.slice
+            if (
+                isinstance(node.ctx, ast.Load)
+                and isinstance(sl, ast.Constant)
+                and isinstance(sl.value, str)
+            ):
+                record(sl.value, node.lineno)
+        elif isinstance(node, ast.Compare):
+            if (
+                len(node.ops) == 1
+                and isinstance(node.ops[0], (ast.In, ast.NotIn))
+                and isinstance(node.left, ast.Constant)
+                and isinstance(node.left.value, str)
+            ):
+                record(node.left.value, node.lineno)
+        elif isinstance(node, ast.Assign):
+            # KNOWN_*_KEYS = frozenset({...}) declarations
+            names = [
+                t.id for t in node.targets if isinstance(t, ast.Name)
+            ]
+            if any("KEYS" in n for n in names):
+                for sub in ast.walk(node.value):
+                    if isinstance(sub, ast.Constant) and isinstance(
+                        sub.value, str
+                    ):
+                        record(sub.value, node.lineno)
+
+
+# -- documentation / example sources for config-key-drift ---------------------
+
+
+_BACKTICK = re.compile(r"`([^`]+)`")
+_KEY_TOKEN = re.compile(r"^[A-Za-z_][A-Za-z0-9_]*(\.[A-Za-z_][A-Za-z0-9_]*)*$")
+_IDENTIFIER = re.compile(r"[A-Za-z_][A-Za-z0-9_]*")
+
+
+def parse_config_doc(path: str):
+    """(table_keys, mentions): ``table_keys`` maps each documented key —
+    the first backticked cell of a markdown table row, last dotted
+    component — to its line; ``mentions`` is every identifier appearing
+    in backticks or fenced code anywhere (the loose "is it documented at
+    all" set, so `{host, port}` inside a type cell still counts).  None
+    when the doc is absent/unreadable — the rule skips that leg instead
+    of condemning every key as undocumented."""
+    table_keys: Dict[str, int] = {}
+    mentions: Set[str] = set()
+    try:
+        with open(path, "r", encoding="utf-8") as fh:
+            lines = fh.read().split("\n")
+    except OSError:
+        return None
+    in_fence = False
+    for i, line in enumerate(lines, start=1):
+        stripped = line.strip()
+        if stripped.startswith("```"):
+            in_fence = not in_fence
+            continue
+        if in_fence:
+            mentions.update(_IDENTIFIER.findall(line))
+            continue
+        for m in _BACKTICK.finditer(line):
+            mentions.update(_IDENTIFIER.findall(m.group(1)))
+        if not stripped.startswith("|"):
+            continue
+        cells = [c.strip() for c in stripped.strip("|").split("|")]
+        if not cells:
+            continue
+        first = cells[0]
+        m = _BACKTICK.match(first)
+        if m is None or m.end() != len(first):
+            continue  # header / separator / prose cell
+        token = m.group(1)
+        if _KEY_TOKEN.match(token):
+            key = token.split(".")[-1]
+            if key not in table_keys:
+                table_keys[key] = i
+    return table_keys, mentions
+
+
+def parse_config_example(path: str) -> Optional[Set[str]]:
+    """Every object key (recursively) in a JSON config sample; None when
+    the file is absent or unparseable (the rule then skips that leg)."""
+    try:
+        with open(path, "r", encoding="utf-8") as fh:
+            data = json.load(fh)
+    except (OSError, ValueError):
+        return None
+    keys: Set[str] = set()
+
+    def walk(value) -> None:
+        if isinstance(value, dict):
+            for k, v in value.items():
+                keys.add(k)
+                walk(v)
+        elif isinstance(value, list):
+            for v in value:
+                walk(v)
+
+    walk(data)
+    return keys

@@ -1,10 +1,15 @@
 """The rule registry.
 
-A rule is a generator function ``(ctx: FileContext) -> Iterator[Finding]``
-registered with the :func:`rule` decorator.  ``scope`` controls where it
-runs: ``"all"`` (every checked file) or ``"package"`` (shipped daemon
-code under ``registrar_tpu/`` only — tests and tooling legitimately
-assert, block, and poke privates).
+A rule is a generator function registered with the :func:`rule`
+decorator.  ``scope`` controls where — and over what — it runs:
+``"all"`` (every checked file) and ``"package"`` (shipped daemon code
+under ``registrar_tpu/`` only — tests and tooling legitimately assert,
+block, and poke privates) rules receive one
+:class:`~checklib.context.FileContext` per file; ``"program"`` rules run
+ONCE per run over the shared :class:`~checklib.program.ProgramModel`
+(built from every parsed file) and may yield findings anchored in any
+file — the engine routes each finding through that file's inline
+suppressions, so ``# check: disable=`` works identically.
 
 Adding a rule (the full recipe is in docs/CHECKS.md):
 
@@ -32,10 +37,16 @@ class Rule:
     def __init__(self, name: str, description: str, scope: str, func: Callable):
         self.name = name
         self.description = description
-        self.scope = scope  # "all" | "package"
+        self.scope = scope  # "all" | "package" | "program"
         self.func = func
 
+    @property
+    def is_program(self) -> bool:
+        return self.scope == "program"
+
     def applies_to(self, ctx) -> bool:
+        if self.is_program:
+            return False  # runs once per run, not per file
         return self.scope == "all" or ctx.in_package
 
     def run(self, ctx) -> Iterable[Finding]:
@@ -57,7 +68,7 @@ ENGINE_RULES = {
 
 
 def rule(name: str, description: str, scope: str = "all"):
-    if scope not in ("all", "package"):
+    if scope not in ("all", "package", "program"):
         raise ValueError(f"bad rule scope {scope!r}")
 
     def register(func: Callable) -> Callable:

@@ -1,0 +1,182 @@
+"""Generation-2 contract rules: the program's string-keyed surfaces.
+
+Two contracts in this tree live entirely in string literals — the
+EventEmitter event names every subsystem hangs off, and the config keys
+shared between the accessors, docs/CONFIG.md, and
+etc/config.example.json.  A typo in either compiles, imports, and passes
+every unit test that doesn't exercise that exact wiring; these rules
+diff the surfaces program-wide instead.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Iterator
+
+from checklib.model import Finding
+from checklib.program import (
+    ProgramModel,
+    parse_config_doc,
+    parse_config_example,
+)
+from checklib.registry import rule
+
+#: The modules that translate operator-facing JSON into the package's
+#: runtime surface — the "accessors" of the config-key-drift contract
+#: (config.py parses the file; records/registration consume the
+#: passed-through ``registration`` block verbatim).
+CONFIG_ACCESSOR_PATHS = (
+    "registrar_tpu/config.py",
+    "registrar_tpu/records.py",
+    "registrar_tpu/registration.py",
+)
+
+CONFIG_DOC = "docs/CONFIG.md"
+CONFIG_EXAMPLE = "etc/config.example.json"
+
+
+@rule(
+    "dead-event-name",
+    "event emitted with no .on/.once/.wait_for listener in the program",
+    scope="program",
+)
+def dead_event_name(model: ProgramModel) -> Iterator[Finding]:
+    # emit("hearbeat") [sic] compiles and runs: the event silently never
+    # reaches anyone, which is exactly how the session_reborn /
+    # watch_rearm_failed / resume_refused wiring would fail.  Constant
+    # event names only — dynamic emits (the client's per-path watch
+    # emitter) are not modeled, and listeners anywhere in the checked
+    # program (tests observing an event keep it alive) count.
+    listened = {
+        s.event
+        for mod in model.modules.values()
+        for s in mod.event_sites
+        if s.kind == "listen"
+    }
+    for mod in model.modules.values():
+        for site in mod.event_sites:
+            if site.kind == "emit" and site.event not in listened:
+                yield Finding(
+                    "dead-event-name",
+                    site.rel_path,
+                    site.lineno,
+                    f"event '{site.event}' is emitted but nothing in the "
+                    "program listens for it (.on/.once/.wait_for)",
+                )
+
+
+@rule(
+    "unknown-event-name",
+    "listener registered for an event nothing in the program emits",
+    scope="program",
+)
+def unknown_event_name(model: ProgramModel) -> Iterator[Finding]:
+    # The mirror image: .on("hearbeat") registers happily and fires
+    # never — a monitoring hook or a test waiting on a typo'd name.
+    emitted = {
+        s.event
+        for mod in model.modules.values()
+        for s in mod.event_sites
+        if s.kind == "emit"
+    }
+    for mod in model.modules.values():
+        for site in mod.event_sites:
+            if site.kind == "listen" and site.event not in emitted:
+                yield Finding(
+                    "unknown-event-name",
+                    site.rel_path,
+                    site.lineno,
+                    f"listener for '{site.event}' matches no .emit() in "
+                    "the program (typo'd or removed event name?)",
+                )
+
+
+@rule(
+    "config-key-drift",
+    "config keys drift between accessors, docs/CONFIG.md, and the "
+    "example config",
+    scope="program",
+)
+def config_key_drift(model: ProgramModel) -> Iterator[Finding]:
+    # Three sources of truth for the same key set, each consumed by a
+    # different audience (the daemon, operators, deploy templating); a
+    # key present in one and missing in another is a distinct failure
+    # mode per direction, so each direction is its own finding message.
+    if CONFIG_ACCESSOR_PATHS[0] not in model.by_path:
+        return  # no config accessor in this program: nothing to diff
+    root = model.package_root()
+    if root is None:
+        return
+
+    code: dict = {}
+    for rel in CONFIG_ACCESSOR_PATHS:
+        mod = model.by_path.get(rel)
+        if mod is None:
+            continue
+        for key, lineno in sorted(mod.key_reads.items()):
+            code.setdefault(key, (rel, lineno))
+
+    doc_path = os.path.join(root, *CONFIG_DOC.split("/"))
+    example_path = os.path.join(root, *CONFIG_EXAMPLE.split("/"))
+    doc = parse_config_doc(doc_path)
+    example = parse_config_example(example_path)
+
+    if doc is not None:
+        table_keys, mentions = doc
+        for key, (rel, lineno) in sorted(code.items()):
+            if key not in mentions:
+                yield Finding(
+                    "config-key-drift",
+                    rel,
+                    lineno,
+                    f"config key '{key}' is read by the accessors but "
+                    f"never documented in {CONFIG_DOC}",
+                )
+        for key, lineno in sorted(table_keys.items()):
+            if key not in code:
+                yield Finding(
+                    "config-key-drift",
+                    CONFIG_DOC,
+                    lineno,
+                    f"config key '{key}' is documented but no accessor "
+                    "reads it (dead documentation or a missing feature)",
+                )
+        if example is not None:
+            for key, lineno in sorted(table_keys.items()):
+                if key not in example:
+                    yield Finding(
+                        "config-key-drift",
+                        CONFIG_DOC,
+                        lineno,
+                        f"config key '{key}' is documented but missing "
+                        f"from {CONFIG_EXAMPLE} (which claims to "
+                        "exercise every documented key)",
+                    )
+    if example is not None:
+        for key, (rel, lineno) in sorted(code.items()):
+            if key not in example:
+                yield Finding(
+                    "config-key-drift",
+                    rel,
+                    lineno,
+                    f"config key '{key}' is read by the accessors but "
+                    f"not exercised by {CONFIG_EXAMPLE}",
+                )
+        for key in sorted(example - set(code)):
+            yield Finding(
+                "config-key-drift",
+                CONFIG_EXAMPLE,
+                0,
+                f"config key '{key}' is present in the example config "
+                "but no accessor reads it (typo'd or removed key?)",
+            )
+        if doc is not None:
+            _, mentions = doc
+            for key in sorted(example - mentions):
+                yield Finding(
+                    "config-key-drift",
+                    CONFIG_EXAMPLE,
+                    0,
+                    f"config key '{key}' is present in the example "
+                    f"config but never documented in {CONFIG_DOC}",
+                )

@@ -1,0 +1,305 @@
+"""Generation-2 flow rules (whole-program; see program.py/callgraph.py).
+
+These express what the file-local generation cannot: cross-module
+coroutine misuse, event-loop stalls hidden behind sync helpers in other
+modules, znode mutations that bypass the agent's single-flight lock, and
+session secrets flowing into log lines.  Every rule consumes the shared
+:class:`~checklib.program.ProgramModel` the engine builds once per run;
+none of them re-parses anything.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator, List, Set
+
+from checklib.callgraph import CallGraph, chain_evidence, chain_names
+from checklib.context import PACKAGE_PREFIX
+from checklib.model import Finding
+from checklib.program import ProgramModel
+from checklib.registry import rule
+
+#: The modules PR 3's single-flight-lock + epoch-guard invariant covers:
+#: every znode-mutating flow that starts here must hold the repair lock.
+LOCK_SCOPED_MODULES = frozenset(
+    {
+        PACKAGE_PREFIX + "agent.py",
+        PACKAGE_PREFIX + "reconcile.py",
+        PACKAGE_PREFIX + "main.py",
+    }
+)
+
+
+def graph_for(model: ProgramModel) -> CallGraph:
+    """One CallGraph per program model, shared by every flow rule."""
+    g = getattr(model, "_callgraph", None)
+    if g is None:
+        g = CallGraph(model)
+        model._callgraph = g
+    return g
+
+
+@rule(
+    "cross-module-unawaited",
+    "call to an async def imported from another module, never awaited",
+    scope="program",
+)
+def cross_module_unawaited(model: ProgramModel) -> Iterator[Finding]:
+    # The file-local unawaited-coroutine rule stops at the module edge by
+    # design; this one resolves the call through the import graph.  Same
+    # zero-false-positive contract: only single-binding, unshadowed names
+    # resolve (program.py), so a name that is *ever* rebound stays silent.
+    graph = graph_for(model)
+    for site in model.all_call_sites():
+        if not site.bare_stmt or site.awaited:
+            continue
+        res = graph.resolve(site)
+        if res is None or res[0] != "func":
+            continue
+        target = res[1]
+        if not target.is_async or target.module is site.func.module:
+            continue  # same module: the file-local rule's jurisdiction
+        yield Finding(
+            "cross-module-unawaited",
+            site.func.module.rel_path,
+            site.lineno,
+            f"coroutine '{site.render()}' ({target.module.name}."
+            f"{target.qualname} is an async def) is never awaited",
+        )
+
+
+@rule(
+    "transitive-blocking-call",
+    "sync helper reached from async code blocks the event loop",
+    scope="program",
+)
+def transitive_blocking_call(model: ProgramModel) -> Iterator[Finding]:
+    # blocking-call-in-async flags the primitive lexically inside the
+    # async def; this rule walks the call graph instead: an async frame
+    # calling a sync helper (any module deep) that eventually hits
+    # time.sleep / sync subprocess / blocking socket ops / write-mode
+    # open stalls the loop exactly the same way.  The finding carries
+    # the full chain.
+    graph = graph_for(model)
+    for site in model.all_call_sites():
+        func = site.func
+        if not func.is_async:
+            continue
+        if not func.module.rel_path.startswith(PACKAGE_PREFIX):
+            continue  # package scope, like blocking-call-in-async
+        res = graph.resolve(site)
+        if res is None or res[0] != "func" or res[1].is_async:
+            continue
+        chain = graph.blocking_chain(res[1])
+        if chain is None:
+            continue
+        full = [(func.ref, func.module.rel_path, site.lineno)] + chain
+        primitive = chain[-1][0]
+        yield Finding(
+            "transitive-blocking-call",
+            func.module.rel_path,
+            site.lineno,
+            f"async '{func.qualname}' blocks the event loop through "
+            f"'{primitive}' (chain: {chain_names(full)})",
+            chain=chain_evidence(full),
+        )
+
+
+@rule(
+    "await-in-lock-free-mutator",
+    "znode mutation reached from agent/reconcile/main outside the "
+    "single-flight lock",
+    scope="program",
+)
+def await_in_lock_free_mutator(model: ProgramModel) -> Iterator[Finding]:
+    # PR 3's invariant: every znode-mutating flow in the agent's orbit
+    # (heartbeat repair, rebirth, health transitions, reconciler repair,
+    # reload delta) is single-flight through one asyncio.Lock plus the
+    # registration-epoch guard, or two recovery actors interleave their
+    # cleanup stages (the repair tug-of-war).  A mutator call site in the
+    # scoped modules passes when it is lexically inside an
+    # ``async with <...lock>`` block, or its enclosing function is only
+    # ever called from lock-protected sites (interprocedural fixpoint).
+    # To report each violation once, a site is only flagged where the
+    # flow LEAVES the scoped modules (or hits a zk.* primitive
+    # directly) — interior scoped-module callees get their own scan.
+    graph = graph_for(model)
+    locked = graph.always_locked()
+    for site in model.all_call_sites():
+        func = site.func
+        if func.module.rel_path not in LOCK_SCOPED_MODULES:
+            continue
+        if site.under_lock or func in locked:
+            continue
+        primitive = graph.mutator_primitive(site)
+        if primitive is None:
+            res = graph.resolve(site)
+            if res is None or res[0] != "func":
+                continue
+            if res[1].module.rel_path in LOCK_SCOPED_MODULES:
+                continue  # its own sites are scanned directly
+            chain = graph.mutator_chain(site)
+            if chain is None:
+                continue
+            primitive = chain[-1][0]
+        else:
+            chain = graph.mutator_chain(site)
+        yield Finding(
+            "await-in-lock-free-mutator",
+            func.module.rel_path,
+            site.lineno,
+            f"'{primitive}' reached from '{func.qualname}' outside the "
+            f"single-flight lock + epoch guard "
+            f"(chain: {chain_names(chain)})",
+            chain=chain_evidence(chain),
+        )
+
+
+# -- secret-flow-to-log -------------------------------------------------------
+
+#: Attribute / subscript names that hold the statefile session secret
+#: (docs/OPERATIONS.md: "the state file IS the session secret").
+SECRET_NAMES = frozenset({"passwd", "password", "session_passwd"})
+
+_LOG_METHODS = frozenset(
+    {"debug", "info", "warning", "warn", "error", "exception", "critical",
+     "log"}
+)
+
+
+def _is_log_sink(call: ast.Call) -> bool:
+    parts: List[str] = []
+    node = call.func
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if not isinstance(node, ast.Name) or not parts:
+        return False
+    parts.append(node.id)
+    parts.reverse()
+    if parts[-1] not in _LOG_METHODS:
+        return False
+    return any("log" in p.lower() or p == "jlog" for p in parts[:-1])
+
+
+def _mentions_secret(node, tainted: Set[str]) -> bool:
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Attribute) and sub.attr in SECRET_NAMES:
+            return True
+        if isinstance(sub, ast.Subscript):
+            sl = sub.slice
+            if (
+                isinstance(sl, ast.Constant)
+                and isinstance(sl.value, str)
+                and sl.value in SECRET_NAMES
+            ):
+                return True
+        if isinstance(sub, ast.Name) and sub.id in (
+            tainted | SECRET_NAMES
+        ):
+            return True
+    return False
+
+
+@rule(
+    "secret-flow-to-log",
+    "statefile session secret (passwd) flows into a log call",
+    scope="program",
+)
+def secret_flow_to_log(model: ProgramModel) -> Iterator[Finding]:
+    # PR 5's security posture: whoever holds the session passwd can adopt
+    # the session and delete the host's DNS records, so it must never
+    # reach a log line (logs ship to aggregators outside the statefile's
+    # trust domain).  Lightweight per-scope dataflow: a name assigned
+    # from an expression mentioning a secret source is tainted (iterated
+    # to a local fixpoint), and any log.* / jlog sink whose arguments
+    # mention a source or tainted name is flagged.  Cross-function flows
+    # through calls are NOT tracked (conservative silence) — keep secret
+    # values out of helper plumbing near log calls.
+    for ctx in model.contexts:
+        if not ctx.rel_path.startswith(PACKAGE_PREFIX):
+            continue
+        yield from _scan_scope(ctx.rel_path, ctx.tree.body, set())
+
+
+def _name_targets(target) -> Iterator[str]:
+    if isinstance(target, ast.Name):
+        yield target.id
+    elif isinstance(target, (ast.Tuple, ast.List)):
+        for elt in target.elts:
+            yield from _name_targets(elt)
+    elif isinstance(target, ast.Starred):
+        yield from _name_targets(target.value)
+
+
+def _scan_scope(rel_path: str, body, inherited: Set[str]):
+    tainted = set(inherited)
+    statements: List[ast.stmt] = list(body)
+    nested: List[ast.AST] = []
+
+    # local taint fixpoint over this scope's assignments (nested def
+    # bodies are their own scopes — pruned here, recursed below)
+    def iter_scope_nodes(root):
+        stack = [root]
+        while stack:
+            n = stack.pop()
+            yield n
+            for c in ast.iter_child_nodes(n):
+                if not isinstance(
+                    c, (ast.FunctionDef, ast.AsyncFunctionDef)
+                ):
+                    stack.append(c)
+
+    changed = True
+    while changed:
+        changed = False
+        for node in statements:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            for sub in iter_scope_nodes(node):
+                if isinstance(sub, ast.Assign):
+                    if _mentions_secret(sub.value, tainted):
+                        for t in sub.targets:
+                            # Only NAME targets become tainted: an
+                            # attribute target (self.session_passwd =
+                            # resp.passwd) stores INTO an object — its
+                            # base name ('self') is not the secret.
+                            for n in _name_targets(t):
+                                if n not in tainted:
+                                    tainted.add(n)
+                                    changed = True
+
+    def walk(node) -> Iterator[Finding]:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            nested.append(node)
+            return
+        if isinstance(node, ast.Call) and _is_log_sink(node):
+            payload = list(node.args) + [kw.value for kw in node.keywords]
+            if any(_mentions_secret(a, tainted) for a in payload):
+                yield Finding(
+                    "secret-flow-to-log",
+                    rel_path,
+                    node.lineno,
+                    "session secret (passwd) reaches a log call "
+                    "(the statefile secret must never be logged)",
+                )
+        for child in ast.iter_child_nodes(node):
+            yield from walk(child)
+
+    for stmt in statements:
+        yield from walk(stmt)
+    for fn in nested:
+        # A closure sees the enclosing taint — minus any name its own
+        # parameters shadow (an unrelated parameter named like a tainted
+        # outer local is NOT the secret).
+        args = fn.args
+        params = {
+            a.arg
+            for a in (
+                list(args.posonlyargs) + list(args.args)
+                + list(args.kwonlyargs)
+                + ([args.vararg] if args.vararg else [])
+                + ([args.kwarg] if args.kwarg else [])
+            )
+        }
+        yield from _scan_scope(rel_path, fn.body, tainted - params)

@@ -193,8 +193,11 @@ def parse_suppressions(ctx) -> List[Finding]:
     return problems
 
 
-def apply_suppressions(ctx, findings: List[Finding]) -> List[Finding]:
-    """Drop suppressed findings; append unused-suppression findings."""
+def filter_findings(ctx, findings: List[Finding]) -> List[Finding]:
+    """Drop findings covered by this file's suppressions (marking the
+    matched rules used).  The unused-suppression sweep is separate —
+    the engine runs it only after EVERY producer (file rules first,
+    whole-program rules later) has had its findings routed through."""
     kept: List[Finding] = []
     for f in findings:
         suppressed = False
@@ -204,10 +207,23 @@ def apply_suppressions(ctx, findings: List[Finding]) -> List[Finding]:
                 suppressed = True
         if not suppressed:
             kept.append(f)
+    return kept
+
+
+def unused_findings(ctx, exempt=frozenset()) -> List[Finding]:
+    """unused-suppression findings for directives nothing matched.
+
+    ``exempt`` rules are never reported stale — the single-file path
+    passes the program-scoped rule names, since those rules did not run
+    and their suppressions legitimately matched nothing."""
+    out: List[Finding] = []
     for s in ctx.suppressions:
-        stale = [r for r in s.rules if r not in s.used_rules]
+        stale = [
+            r for r in s.rules
+            if r not in s.used_rules and r not in exempt
+        ]
         if stale:
-            kept.append(
+            out.append(
                 Finding(
                     "unused-suppression",
                     ctx.rel_path,
@@ -217,4 +233,19 @@ def apply_suppressions(ctx, findings: List[Finding]) -> List[Finding]:
                     + " matched no finding; remove it",
                 )
             )
-    return kept
+    return out
+
+
+def apply_suppressions(ctx, findings: List[Finding]) -> List[Finding]:
+    """One-shot filter + unused sweep (the single-file check_file path).
+
+    Program-rule suppressions are exempt from the unused sweep here:
+    check_file runs file rules only, so a directive the full gate
+    REQUIRES (e.g. the drain walk's await-in-lock-free-mutator opt-out)
+    must not read as "matched no finding; remove it"."""
+    program_rules = frozenset(
+        name for name, r in RULES.items() if r.is_program
+    )
+    return filter_findings(ctx, findings) + unused_findings(
+        ctx, exempt=program_rules
+    )
