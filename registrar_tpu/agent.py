@@ -203,8 +203,16 @@ def register_plus(
     desired records, not the manifest, are the verification truth).
     """
     ee = RegistrarEvents()
+    # Health-check construction fails HERE, synchronously: built inside
+    # the spawned _run task, a bad healthCheck mapping raised ValueError
+    # into a fire-and-forget task AFTER registration landed — the error
+    # vanished in the loop's default handler and the host stayed
+    # registered with no health checking at all (caught by checklib's
+    # task-exception-blackhole rule).  The consumer still STARTS only
+    # after registration completes, as before.
+    health = create_health_check(**health_check) if health_check else None
     ee._track(_run(ee, zk, registration, admin_ip,
-                   health_check, heartbeat_interval,
+                   health, heartbeat_interval,
                    hostname, settle_delay,
                    heartbeat_retry,
                    repair_heartbeat_miss,
@@ -219,7 +227,7 @@ async def _run(
     zk: ZKClient,
     registration: Mapping[str, Any],
     admin_ip: Optional[str],
-    health_check: Optional[Mapping[str, Any]],
+    health_check: Optional[HealthCheck],
     heartbeat_interval: float,
     hostname: Optional[str],
     settle_delay: float,
@@ -281,7 +289,7 @@ async def _run(
         do_register if repair_heartbeat_miss else None,
         repair_lock,
     ))
-    if health_check:
+    if health_check is not None:
         _start_health_consumer(ee, zk, do_register, health_check, repair_lock)
 
     # Session lifecycle supervisor consumer (ISSUE 3): a reborn session
@@ -727,11 +735,13 @@ def _start_health_consumer(
     ee: RegistrarEvents,
     zk: ZKClient,
     do_register,
-    health_check: Mapping[str, Any],
+    check: HealthCheck,
     lock: Optional[asyncio.Lock] = None,
 ) -> None:
     """Hot loop #2 (SURVEY.md §3.3): health stream -> deregister/re-register.
 
+    ``check`` is constructed by :func:`register_plus` (synchronously, so
+    a bad mapping fails at the call site, not inside the task).
     Transitions run under the agent-wide single-flight ``lock`` so a
     rebirth/reconciler/heartbeat repair can never interleave its pipeline
     with a deliberate deregistration.  A failed ``unregister`` leaves
@@ -740,7 +750,6 @@ def _start_health_consumer(
     later tick (ISSUE 3 satellite fix; without a reconciler the error is
     surfaced for the operator, the pre-existing behavior).
     """
-    check = create_health_check(**health_check)
     ee._health = check
     if lock is None:
         lock = asyncio.Lock()

@@ -116,9 +116,20 @@ def is_transient(err: BaseException) -> bool:
     to life — the orchestrator must build a new one) and every other
     ZooKeeper semantic error (NO_NODE, NODE_EXISTS, NO_AUTH, ...), where a
     retry would just repeat the same answer.
+
+    Explicitly FATAL: ``ValueError``/``RuntimeError`` (and subclasses —
+    record validation, the interface-probe failure in
+    ``records.default_address``, jute encode errors): the operation's
+    *input* is wrong, so every retry replays the same failure.  These
+    were always non-transient by the fall-through default; naming them
+    keeps the classification deliberate — checklib's
+    retry-contract-drift rule verifies every class that can reach a
+    retry boundary is decided HERE, not by silence.
     """
     if isinstance(err, ZKError):
         return err.code in (Err.CONNECTION_LOSS, Err.OPERATION_TIMEOUT)
+    if isinstance(err, (ValueError, RuntimeError)):
+        return False
     return isinstance(err, (ConnectionError, asyncio.TimeoutError, OSError))
 
 

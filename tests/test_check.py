@@ -232,6 +232,118 @@ PROGRAM_SEEDED_VIOLATIONS = {
             {"exampleOnly": 1}
             """,
     },
+    # -- generation 3: exception-flow rules (ISSUE 7) --
+    "retry-contract-drift": {
+        "registrar_tpu/retry.py": """\
+            def is_transient(err):
+                return isinstance(err, ConnectionError)
+
+
+            async def call_with_backoff(fn, retryable=None):
+                return await fn()
+            """,
+        "registrar_tpu/seeded.py": """\
+            from registrar_tpu.retry import call_with_backoff, is_transient
+
+
+            class QuotaError(Exception):
+                pass
+
+
+            async def push(payload):
+                if not payload:
+                    raise QuotaError()
+                return payload
+
+
+            async def main(payload):
+                return await call_with_backoff(
+                    lambda: push(payload), retryable=is_transient
+                )
+            """,
+    },
+    "task-exception-blackhole": {
+        "registrar_tpu/seeded.py": """\
+            import asyncio
+
+
+            class DropError(Exception):
+                pass
+
+
+            async def pump():
+                raise DropError("queue gone")
+
+
+            def start(tasks):
+                t = asyncio.create_task(pump())
+                tasks.add(t)
+                t.add_done_callback(tasks.discard)
+            """,
+    },
+    "overbroad-handler": {
+        "registrar_tpu/errs.py": """\
+            class SessionExpiredError(Exception):
+                pass
+            """,
+        "registrar_tpu/store.py": """\
+            from registrar_tpu.errs import SessionExpiredError
+
+
+            async def refresh(tree):
+                if tree is None:
+                    raise SessionExpiredError()
+                return tree
+            """,
+        "registrar_tpu/seeded.py": """\
+            import logging
+
+            from registrar_tpu import store
+            from registrar_tpu.errs import SessionExpiredError
+
+            log = logging.getLogger("seeded")
+
+
+            async def tick(tree):
+                try:
+                    return await store.refresh(tree)
+                except Exception:
+                    log.info("refresh failed")
+                    return None
+
+
+            async def drive(tree):
+                try:
+                    return await tick(tree)
+                except SessionExpiredError:
+                    return None
+            """,
+    },
+    "fault-matrix-drift": {
+        "registrar_tpu/seeded.py": "x = 1\n",
+        "docs/FAULTS.md": """\
+            # Faults
+
+            On a half-open reply the client raises `GhostTimeoutError`
+            and reconnects.
+            """,
+    },
+    "metric-name-drift": {
+        "registrar_tpu/metrics.py": """\
+            class Counter:
+                def __init__(self, name):
+                    self.name = name
+
+
+            def build():
+                return Counter("registrar_beats_total")
+            """,
+        "docs/OPERATIONS.md": """\
+            # Operating
+
+            Alert when `registrar_heartbeats_total` stops increasing.
+            """,
+    },
 }
 
 EXPECTED_RULES = sorted(
@@ -1494,6 +1606,922 @@ def test_config_key_drift_silent_without_accessor_module(tmp_path):
     })
     proc = run_checker("registrar_tpu", "--no-baseline", cwd=tree)
     assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+# --- generation 3: exception-flow rules (ISSUE 7) ----------------------------
+
+
+def _flow_for_tree(tmp_path, files):
+    """ProgramModel + ExceptionFlow over a materialized scratch tree
+    (the direct-API harness for the escape-set unit tests)."""
+    from checklib.engine import _parse_file
+    from checklib.exceptions import flow_for
+    from checklib.program import ProgramModel
+
+    seed_program_tree(tmp_path, files)
+    contexts = []
+    for rel in sorted(files):
+        if not rel.endswith(".py"):
+            continue
+        ctx, _ = _parse_file(str(tmp_path / rel), rel)
+        assert ctx is not None, rel
+        contexts.append(ctx)
+    model = ProgramModel(contexts)
+    return model, flow_for(model)
+
+
+def _escape_names(model, flow, ref):
+    """Bare class names escaping the function with qualref ``ref``."""
+    for f in model.functions():
+        if f.ref == ref:
+            return {t.rsplit(":", 1)[-1] for t in flow.escapes(f)}
+    raise AssertionError(f"no function {ref}")
+
+
+def test_escape_tuple_handler_catches_both(tmp_path):
+    model, flow = _flow_for_tree(tmp_path, {
+        "registrar_tpu/seeded.py": """\
+            class AErr(Exception):
+                pass
+
+
+            class BErr(Exception):
+                pass
+
+
+            def both(flag):
+                try:
+                    if flag:
+                        raise AErr()
+                    raise BErr()
+                except (AErr, BErr):
+                    return None
+
+
+            def narrow(flag):
+                try:
+                    if flag:
+                        raise AErr()
+                    raise BErr()
+                except (AErr,):
+                    return None
+            """,
+    })
+    assert _escape_names(model, flow, "registrar_tpu.seeded:both") == set()
+    assert _escape_names(model, flow, "registrar_tpu.seeded:narrow") == {
+        "BErr"
+    }
+
+
+def test_escape_bare_and_named_reraise(tmp_path):
+    model, flow = _flow_for_tree(tmp_path, {
+        "registrar_tpu/seeded.py": """\
+            class AErr(Exception):
+                pass
+
+
+            def bare():
+                try:
+                    raise AErr()
+                except AErr:
+                    raise
+
+
+            def named():
+                try:
+                    raise AErr()
+                except AErr as e:
+                    raise e
+
+
+            def swallowed():
+                try:
+                    raise AErr()
+                except AErr:
+                    return None
+            """,
+    })
+    assert _escape_names(model, flow, "registrar_tpu.seeded:bare") == {"AErr"}
+    assert _escape_names(model, flow, "registrar_tpu.seeded:named") == {
+        "AErr"
+    }
+    assert (
+        _escape_names(model, flow, "registrar_tpu.seeded:swallowed") == set()
+    )
+
+
+def test_escape_hierarchy_across_modules(tmp_path):
+    # `except Base` must catch a Sub raised two modules away, with the
+    # base resolved through the cross-module symbol table.
+    model, flow = _flow_for_tree(tmp_path, {
+        "registrar_tpu/errs.py": """\
+            class BaseErr(Exception):
+                pass
+
+
+            class SubErr(BaseErr):
+                pass
+            """,
+        "registrar_tpu/seeded.py": """\
+            from registrar_tpu.errs import BaseErr, SubErr
+
+
+            def boom():
+                raise SubErr()
+
+
+            def caught():
+                try:
+                    boom()
+                except BaseErr:
+                    return None
+
+
+            def wrong_way():
+                try:
+                    raise BaseErr()
+                except SubErr:
+                    return None
+            """,
+    })
+    assert _escape_names(model, flow, "registrar_tpu.seeded:boom") == {
+        "SubErr"
+    }
+    assert _escape_names(model, flow, "registrar_tpu.seeded:caught") == set()
+    # a SubErr clause does NOT catch the base class
+    assert _escape_names(model, flow, "registrar_tpu.seeded:wrong_way") == {
+        "BaseErr"
+    }
+
+
+def test_escape_unresolvable_edges_widen_conservatively(tmp_path):
+    # An opaque call widens to the UNKNOWN marker (never a named claim);
+    # an unresolvable HANDLER clause is assumed to catch everything —
+    # both are the fewer-findings direction.
+    from checklib.exceptions import UNKNOWN
+
+    model, flow = _flow_for_tree(tmp_path, {
+        "registrar_tpu/seeded.py": """\
+            class AErr(Exception):
+                pass
+
+
+            def opaque(helper):
+                helper()
+
+
+            def shielded(ns):
+                try:
+                    raise AErr()
+                except ns.Error:
+                    return None
+            """,
+    })
+    for f in model.functions():
+        if f.ref == "registrar_tpu.seeded:opaque":
+            assert flow.escapes(f) == frozenset({UNKNOWN})
+    assert (
+        _escape_names(model, flow, "registrar_tpu.seeded:shielded") == set()
+    )
+
+
+def test_escape_propagates_through_import_cycle(tmp_path):
+    model, flow = _flow_for_tree(tmp_path, {
+        "registrar_tpu/a.py": """\
+            from registrar_tpu import b
+
+
+            class CycleErr(Exception):
+                pass
+
+
+            def boom():
+                raise CycleErr()
+            """,
+        "registrar_tpu/b.py": """\
+            from registrar_tpu import a
+
+
+            def relay():
+                return a.boom()
+            """,
+    })
+    assert "CycleErr" in _escape_names(model, flow, "registrar_tpu.b:relay")
+
+
+def test_escape_excludes_cancellation_signals(tmp_path):
+    model, flow = _flow_for_tree(tmp_path, {
+        "registrar_tpu/seeded.py": """\
+            import asyncio
+
+
+            async def quit_loop():
+                raise asyncio.CancelledError()
+            """,
+    })
+    assert (
+        _escape_names(model, flow, "registrar_tpu.seeded:quit_loop") == set()
+    )
+
+
+def test_unawaited_async_call_does_not_propagate_escapes(tmp_path):
+    # Calling an async def without awaiting builds a coroutine object:
+    # nothing raises HERE (the blackhole rule reasons about where the
+    # task's exception goes instead).
+    model, flow = _flow_for_tree(tmp_path, {
+        "registrar_tpu/seeded.py": """\
+            class AErr(Exception):
+                pass
+
+
+            async def boom():
+                raise AErr()
+
+
+            async def spawns():
+                coro = boom()
+                return coro
+
+
+            async def awaits():
+                await boom()
+            """,
+    })
+    assert (
+        _escape_names(model, flow, "registrar_tpu.seeded:spawns") == set()
+    )
+    assert _escape_names(model, flow, "registrar_tpu.seeded:awaits") == {
+        "AErr"
+    }
+
+
+def test_escape_finally_and_orelse_propagate(tmp_path):
+    model, flow = _flow_for_tree(tmp_path, {
+        "registrar_tpu/seeded.py": """\
+            class AErr(Exception):
+                pass
+
+
+            class BErr(Exception):
+                pass
+
+
+            def f(flag):
+                try:
+                    pass
+                except ValueError:
+                    return None
+                else:
+                    raise AErr()
+                finally:
+                    if flag:
+                        raise BErr()
+            """,
+    })
+    assert _escape_names(model, flow, "registrar_tpu.seeded:f") == {
+        "AErr", "BErr",
+    }
+
+
+def test_retry_contract_chain_in_json_report(tmp_path):
+    tree = seed_program_tree(
+        tmp_path, PROGRAM_SEEDED_VIOLATIONS["retry-contract-drift"]
+    )
+    proc = run_checker(
+        "registrar_tpu", "--no-baseline", "--format", "json", cwd=tree
+    )
+    assert proc.returncode == 1
+    report = json.loads(proc.stdout)
+    (finding,) = report["problems"]
+    assert finding["rule"] == "retry-contract-drift"
+    chain = finding["chain"]
+    assert chain[0]["symbol"] == "registrar_tpu.seeded:main"
+    assert chain[-1]["symbol"] == "raise QuotaError"
+    assert all(h["line"] > 0 for h in chain)
+
+
+def test_retry_contract_classified_subclass_passes(tmp_path):
+    # A class is_transient's body DOES name (here: any ConnectionError
+    # subclass) is classified — no drift.
+    tree = seed_program_tree(tmp_path, {
+        "registrar_tpu/retry.py": PROGRAM_SEEDED_VIOLATIONS[
+            "retry-contract-drift"
+        ]["registrar_tpu/retry.py"],
+        "registrar_tpu/seeded.py": """\
+            from registrar_tpu.retry import call_with_backoff, is_transient
+
+
+            class FlakyWire(ConnectionError):
+                pass
+
+
+            async def push(payload):
+                if not payload:
+                    raise FlakyWire()
+                return payload
+
+
+            async def main(payload):
+                return await call_with_backoff(
+                    lambda: push(payload), retryable=is_transient
+                )
+            """,
+    })
+    proc = run_checker("registrar_tpu", "--no-baseline", cwd=tree)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+def test_retry_boundary_without_is_transient_is_silent(tmp_path):
+    # A custom retryable predicate makes no is_transient promise — the
+    # rule must not hold the boundary to a contract it never adopted.
+    tree = seed_program_tree(tmp_path, {
+        "registrar_tpu/retry.py": PROGRAM_SEEDED_VIOLATIONS[
+            "retry-contract-drift"
+        ]["registrar_tpu/retry.py"],
+        "registrar_tpu/seeded.py": """\
+            from registrar_tpu.retry import call_with_backoff
+
+
+            class QuotaError(Exception):
+                pass
+
+
+            async def push(payload):
+                if not payload:
+                    raise QuotaError()
+                return payload
+
+
+            async def main(payload):
+                return await call_with_backoff(
+                    lambda: push(payload),
+                    retryable=lambda err: True,
+                )
+            """,
+    })
+    proc = run_checker("registrar_tpu", "--no-baseline", cwd=tree)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+def test_blackhole_awaited_handle_passes(tmp_path):
+    # The spawned task's handle IS awaited somewhere in the module: the
+    # exception has a consumer; no blackhole.
+    tree = seed_program_tree(tmp_path, {
+        "registrar_tpu/seeded.py": """\
+            import asyncio
+
+
+            class DropError(Exception):
+                pass
+
+
+            async def pump():
+                raise DropError("queue gone")
+
+
+            def start(tasks):
+                t = asyncio.create_task(pump())
+                tasks.add(t)
+                t.add_done_callback(tasks.discard)
+                return t
+
+
+            async def stop(t):
+                await t
+            """,
+    })
+    proc = run_checker("registrar_tpu", "--no-baseline", cwd=tree)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+def test_blackhole_asyncio_run_is_a_consumer(tmp_path):
+    # asyncio.run() re-raises the coroutine's exception in its sync
+    # caller — handing a raising coroutine to it is not a blackhole.
+    tree = seed_program_tree(tmp_path, {
+        "registrar_tpu/seeded.py": """\
+            import asyncio
+
+
+            class DropError(Exception):
+                pass
+
+
+            async def pump():
+                raise DropError("queue gone")
+
+
+            def main():
+                asyncio.run(pump())
+            """,
+    })
+    proc = run_checker("registrar_tpu", "--no-baseline", cwd=tree)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+def test_blackhole_quiet_task_passes(tmp_path):
+    # A spawned coroutine that provably raises nothing named is fine —
+    # the rule needs a proven escape, not just a fire-and-forget shape.
+    tree = seed_program_tree(tmp_path, {
+        "registrar_tpu/seeded.py": """\
+            import asyncio
+
+
+            async def pump():
+                try:
+                    await asyncio.sleep(0)
+                except Exception:
+                    return None
+
+
+            def start(tasks):
+                t = asyncio.create_task(pump())
+                tasks.add(t)
+                t.add_done_callback(tasks.discard)
+            """,
+    })
+    proc = run_checker("registrar_tpu", "--no-baseline", cwd=tree)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+def test_broad_handler_catches_unknown_hierarchy_ext_class(tmp_path):
+    # `except Exception` must catch a named EXTERNAL class whose
+    # hierarchy the model cannot see (zlib.error): the only modeled
+    # BaseException-not-Exception descendants are the excluded signals,
+    # so letting it "escape" a broad handler would be a false positive.
+    model, flow = _flow_for_tree(tmp_path, {
+        "registrar_tpu/seeded.py": """\
+            import zlib
+
+
+            def unpack(blob):
+                try:
+                    raise zlib.error("boom")
+                except Exception:
+                    return None
+            """,
+    })
+    assert _escape_names(model, flow, "registrar_tpu.seeded:unpack") == set()
+
+
+def test_blackhole_batched_gather_passes(tmp_path):
+    # A coroutine appended to a batch and gathered later is consumed —
+    # only a real spawner (create_task/ensure_future/spawn_owned) makes
+    # a fire-and-forget task root.
+    tree = seed_program_tree(tmp_path, {
+        "registrar_tpu/seeded.py": """\
+            import asyncio
+
+
+            class DropError(Exception):
+                pass
+
+
+            async def refresh():
+                raise DropError()
+
+
+            async def drive(pending):
+                pending.append(refresh())
+                await asyncio.gather(*pending)
+            """,
+    })
+    proc = run_checker("registrar_tpu", "--no-baseline", cwd=tree)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+def test_overbroad_without_upstream_handler_passes(tmp_path):
+    # Swallowing a contract class is only condemned when a caller
+    # handles that class explicitly (evidence the design wants it).
+    files = dict(PROGRAM_SEEDED_VIOLATIONS["overbroad-handler"])
+    files["registrar_tpu/seeded.py"] = """\
+        import logging
+
+        from registrar_tpu import store
+
+        log = logging.getLogger("seeded")
+
+
+        async def tick(tree):
+            try:
+                return await store.refresh(tree)
+            except Exception:
+                log.info("refresh failed")
+                return None
+
+
+        async def drive(tree):
+            return await tick(tree)
+        """
+    tree = seed_program_tree(tmp_path, files)
+    proc = run_checker("registrar_tpu", "--no-baseline", cwd=tree)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+def test_overbroad_handler_requires_enclosing_try(tmp_path):
+    # The caller's narrow handler must ENCLOSE the call into the
+    # flagged function: a handler around some unrelated statement could
+    # never receive the exception, so it is not evidence.
+    files = dict(PROGRAM_SEEDED_VIOLATIONS["overbroad-handler"])
+    files["registrar_tpu/seeded.py"] = """\
+        import logging
+
+        from registrar_tpu import store
+        from registrar_tpu.errs import SessionExpiredError
+
+        log = logging.getLogger("seeded")
+
+
+        async def tick(tree):
+            try:
+                return await store.refresh(tree)
+            except Exception:
+                log.info("refresh failed")
+                return None
+
+
+        async def drive(tree):
+            try:
+                log.info("starting")
+            except SessionExpiredError:
+                return None
+            return await tick(tree)
+        """
+    tree = seed_program_tree(tmp_path, files)
+    proc = run_checker("registrar_tpu", "--no-baseline", cwd=tree)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+def test_escape_chain_skips_caught_raise_sites(tmp_path):
+    # Witnesses travel with their tokens through handler filtering: a
+    # raise that is locally caught must never anchor the evidence chain
+    # for a token that escaped some other way.
+    model, flow = _flow_for_tree(tmp_path, {
+        "registrar_tpu/errs.py": """\
+            class WireError(Exception):
+                pass
+
+
+            def probe():
+                raise WireError()
+            """,
+        "registrar_tpu/seeded.py": """\
+            from registrar_tpu.errs import WireError, probe
+
+
+            def f():
+                try:
+                    raise WireError()
+                except WireError:
+                    pass
+                probe()
+            """,
+    })
+    for func in model.functions():
+        if func.ref == "registrar_tpu.seeded:f":
+            token = next(iter(flow.named_escapes(func)))
+            chain = flow.escape_chain(func, token)
+            # the witness is the probe() call (line 9), not the caught
+            # raise (line 6)
+            assert chain[0][2] == 9, chain
+            assert chain[-1][0] == "raise WireError"
+            break
+    else:
+        raise AssertionError("f not found")
+
+
+def test_overbroad_narrow_then_broad_passes(tmp_path):
+    # The canonical defensive pattern: a narrow clause for the contract
+    # class AHEAD of the broad catch-all.  Clause order means the broad
+    # handler can never receive the class — not a swallow.
+    files = dict(PROGRAM_SEEDED_VIOLATIONS["overbroad-handler"])
+    files["registrar_tpu/seeded.py"] = """\
+        import logging
+
+        from registrar_tpu import store
+        from registrar_tpu.errs import SessionExpiredError
+
+        log = logging.getLogger("seeded")
+
+
+        async def recover():
+            log.info("recovering")
+
+
+        async def tick(tree):
+            try:
+                return await store.refresh(tree)
+            except SessionExpiredError:
+                await recover()
+                return None
+            except Exception:
+                log.info("refresh failed")
+                return None
+
+
+        async def drive(tree):
+            try:
+                return await tick(tree)
+            except SessionExpiredError:
+                return None
+        """
+    tree = seed_program_tree(tmp_path, files)
+    proc = run_checker("registrar_tpu", "--no-baseline", cwd=tree)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+def test_blackhole_annassign_stored_handle_passes(tmp_path):
+    # A handle stored through an ANNOTATED assignment and awaited in
+    # another method is consumed — AnnAssign targets must enter the
+    # consumed-handle check like plain Assign targets.
+    tree = seed_program_tree(tmp_path, {
+        "registrar_tpu/seeded.py": """\
+            import asyncio
+
+
+            class DropError(Exception):
+                pass
+
+
+            async def pump():
+                raise DropError("queue gone")
+
+
+            class Owner:
+                def start(self):
+                    self._task: asyncio.Task = asyncio.create_task(pump())
+
+                async def stop(self):
+                    await self._task
+            """,
+    })
+    proc = run_checker("registrar_tpu", "--no-baseline", cwd=tree)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+def test_retry_contract_keyword_thunk_fires(tmp_path):
+    # Refactoring a boundary to `fn=...` keyword style must not drop it
+    # from the contract check.
+    files = dict(PROGRAM_SEEDED_VIOLATIONS["retry-contract-drift"])
+    files["registrar_tpu/seeded.py"] = textwrap.dedent(
+        files["registrar_tpu/seeded.py"]
+    ).replace(
+        "lambda: push(payload), retryable=is_transient",
+        "fn=lambda: push(payload), retryable=is_transient",
+    )
+    tree = seed_program_tree(tmp_path, files)
+    proc = run_checker("registrar_tpu", "--no-baseline", cwd=tree)
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    assert program_rules_fired(proc) == ["retry-contract-drift"]
+
+
+def test_retry_contract_chain_names_the_real_origin(tmp_path):
+    # A lambda combining several calls must attribute the token to the
+    # callee it actually escaped from, with the chain ending at the
+    # raise — never at an innocent function.
+    files = dict(PROGRAM_SEEDED_VIOLATIONS["retry-contract-drift"])
+    files["registrar_tpu/seeded.py"] = """\
+        from registrar_tpu.retry import call_with_backoff, is_transient
+
+
+        class QuotaError(Exception):
+            pass
+
+
+        def prep(payload):
+            return payload
+
+
+        async def push(payload):
+            if not payload:
+                raise QuotaError()
+            return payload
+
+
+        async def main(payload):
+            return await call_with_backoff(
+                lambda: push(prep(payload)), retryable=is_transient
+            )
+        """
+    tree = seed_program_tree(tmp_path, files)
+    proc = run_checker(
+        "registrar_tpu", "--no-baseline", "--format", "json", cwd=tree
+    )
+    assert proc.returncode == 1
+    report = json.loads(proc.stdout)
+    (finding,) = report["problems"]
+    chain = finding["chain"]
+    assert chain[1]["symbol"] == "registrar_tpu.seeded:push"
+    assert chain[-1]["symbol"] == "raise QuotaError"
+
+
+def test_overbroad_reraising_handler_passes(tmp_path):
+    # A broad handler that may re-throw is not a swallow.
+    files = dict(PROGRAM_SEEDED_VIOLATIONS["overbroad-handler"])
+    files["registrar_tpu/seeded.py"] = """\
+        import logging
+
+        from registrar_tpu import store
+        from registrar_tpu.errs import SessionExpiredError
+
+        log = logging.getLogger("seeded")
+
+
+        async def tick(tree):
+            try:
+                return await store.refresh(tree)
+            except Exception:
+                log.info("refresh failed")
+                raise
+
+
+        async def drive(tree):
+            try:
+                return await tick(tree)
+            except SessionExpiredError:
+                return None
+        """
+    tree = seed_program_tree(tmp_path, files)
+    proc = run_checker("registrar_tpu", "--no-baseline", cwd=tree)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+def test_fault_matrix_live_class_passes(tmp_path):
+    # Docs naming a class the program really raises (or constructs) is
+    # in sync — even when every raise of it is locally handled.
+    tree = seed_program_tree(tmp_path, {
+        "registrar_tpu/seeded.py": """\
+            class QuotaError(Exception):
+                pass
+
+
+            def check(n):
+                try:
+                    if n > 5:
+                        raise QuotaError()
+                except QuotaError:
+                    return None
+            """,
+        "docs/FAULTS.md": """\
+            # Faults
+
+            Quota exhaustion raises `QuotaError`.
+            """,
+    })
+    proc = run_checker("registrar_tpu", "--no-baseline", cwd=tree)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+def test_fault_matrix_builtin_mentions_pass(tmp_path):
+    # A runbook may name any builtin the analysis itself knows
+    # (BrokenPipeError, EOFError, ...) without being condemned as
+    # naming a nonexistent class.
+    tree = seed_program_tree(tmp_path, {
+        "registrar_tpu/seeded.py": "x = 1\n",
+        "docs/FAULTS.md": """\
+            # Faults
+
+            A half-closed socket surfaces as `BrokenPipeError` or
+            `ConnectionResetError`; an aborted handshake as `EOFError`.
+            """,
+    })
+    proc = run_checker("registrar_tpu", "--no-baseline", cwd=tree)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+def test_metric_wildcard_and_known_names_pass(tmp_path):
+    tree = seed_program_tree(tmp_path, {
+        "registrar_tpu/metrics.py": PROGRAM_SEEDED_VIOLATIONS[
+            "metric-name-drift"
+        ]["registrar_tpu/metrics.py"],
+        "docs/OPERATIONS.md": """\
+            # Operating
+
+            Alert on `registrar_beats_total`; the whole family is
+            `registrar_*` (grep registrar_ for everything).
+            """,
+    })
+    proc = run_checker("registrar_tpu", "--no-baseline", cwd=tree)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+def test_new_rule_inline_suppressions(tmp_path):
+    # Each code-anchored generation-3 finding must be suppressible at
+    # its anchor line like any other finding (doc-anchored ones ride
+    # the baseline instead — no inline directives in markdown).
+    files = dict(PROGRAM_SEEDED_VIOLATIONS["retry-contract-drift"])
+    files["registrar_tpu/seeded.py"] = textwrap.dedent(
+        files["registrar_tpu/seeded.py"]
+    ).replace(
+        "    return await call_with_backoff(",
+        "    # check: disable=retry-contract-drift -- fixture accepts the "
+        "silent non-retry\n    return await call_with_backoff(",
+    )
+    tree = seed_program_tree(tmp_path, files)
+    proc = run_checker("registrar_tpu", "--no-baseline", cwd=tree)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+    files = dict(PROGRAM_SEEDED_VIOLATIONS["task-exception-blackhole"])
+    files["registrar_tpu/seeded.py"] = textwrap.dedent(
+        files["registrar_tpu/seeded.py"]
+    ).replace(
+        "    t = asyncio.create_task(pump())",
+        "    # check: disable=task-exception-blackhole -- fixture drops it\n"
+        "    t = asyncio.create_task(pump())",
+    )
+    tree2 = tmp_path / "blackhole"
+    tree2.mkdir()
+    seed_program_tree(tree2, files)
+    proc = run_checker("registrar_tpu", "--no-baseline", cwd=tree2)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+    files = dict(PROGRAM_SEEDED_VIOLATIONS["overbroad-handler"])
+    files["registrar_tpu/seeded.py"] = textwrap.dedent(
+        files["registrar_tpu/seeded.py"]
+    ).replace(
+        "    except Exception:",
+        "    # check: disable=overbroad-handler -- fixture flattens all "
+        "failures\n    except Exception:",
+    )
+    tree3 = tmp_path / "overbroad"
+    tree3.mkdir()
+    seed_program_tree(tree3, files)
+    proc = run_checker("registrar_tpu", "--no-baseline", cwd=tree3)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+@pytest.mark.parametrize(
+    "rule",
+    [
+        "retry-contract-drift",
+        "task-exception-blackhole",
+        "overbroad-handler",
+        "fault-matrix-drift",
+        "metric-name-drift",
+    ],
+)
+def test_new_rule_baseline_round_trip(rule, tmp_path):
+    tree = seed_program_tree(tmp_path, PROGRAM_SEEDED_VIOLATIONS[rule])
+    bl = str(tmp_path / "bl.json")
+    proc = run_checker(
+        "registrar_tpu", "--write-baseline", "--baseline", bl, cwd=tree
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert json.load(open(bl))["findings"], "nothing grandfathered?"
+    proc = run_checker("registrar_tpu", "--baseline", bl, cwd=tree)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+# --- SARIF output ------------------------------------------------------------
+
+
+def test_sarif_shape_and_chain(tmp_path):
+    tree = seed_program_tree(
+        tmp_path, PROGRAM_SEEDED_VIOLATIONS["transitive-blocking-call"]
+    )
+    proc = run_checker(
+        "registrar_tpu", "--no-baseline", "--format", "sarif", cwd=tree
+    )
+    assert proc.returncode == 1
+    sarif = json.loads(proc.stdout)
+    assert sarif["version"] == "2.1.0"
+    assert "sarif-2.1.0" in sarif["$schema"]
+    (run,) = sarif["runs"]
+    driver = run["tool"]["driver"]
+    assert driver["name"] == "checklib"
+    rule_ids = {r["id"] for r in driver["rules"]}
+    # every registered rule AND the engine findings are declared
+    for rule in EXPECTED_RULES + ["syntax-error", "stale-baseline"]:
+        assert rule in rule_ids
+    (result,) = run["results"]
+    assert result["ruleId"] == "transitive-blocking-call"
+    assert result["level"] == "error"
+    (loc,) = result["locations"]
+    phys = loc["physicalLocation"]
+    assert phys["artifactLocation"]["uri"] == "registrar_tpu/seeded.py"
+    assert phys["region"]["startLine"] >= 1
+    # chain evidence maps onto codeFlows/threadFlows hop-for-hop
+    (flow,) = result["codeFlows"]
+    (thread,) = flow["threadFlows"]
+    symbols = [
+        h["location"]["message"]["text"] for h in thread["locations"]
+    ]
+    assert symbols[-1] == "time.sleep"
+    assert all(
+        h["location"]["physicalLocation"]["region"]["startLine"] >= 1
+        for h in thread["locations"]
+    )
+
+
+def test_sarif_clean_tree_has_no_results(tmp_path):
+    tree = seed_program_tree(tmp_path, {"registrar_tpu/seeded.py": "x = 1\n"})
+    out = tmp_path / "report.sarif"
+    proc = run_checker(
+        "registrar_tpu", "--no-baseline", "--format", "sarif",
+        "--output", str(out), cwd=tree,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    sarif = json.loads(out.read_text())
+    assert sarif["runs"][0]["results"] == []
 
 
 # --- --changed-only / --stats / --max-seconds --------------------------------

@@ -20,9 +20,13 @@ Layout:
     names, config-key reads (generation 2)
   * ``callgraph.py`` — resolved call graph + the interprocedural
     fixpoints (async→blocking chains, single-flight-lock protection)
+  * ``exceptions.py`` — the exception-escape analysis: per-function
+    escape sets by fixpoint over the call edges, try/except and class
+    hierarchy modeled, unresolvable edges widened (generation 3)
   * ``rules_names.py``, ``rules_async.py``, ``rules_hygiene.py`` —
     file-local rules; ``rules_flow.py``, ``rules_contracts.py`` — the
-    whole-program rules
+    whole-program rules; ``rules_errors.py`` — the exception-flow
+    rules (retry/blackhole/overbroad/fault-matrix contract drift)
   * ``suppress.py``  — ``# check: disable=<rule> -- why`` comments
   * ``baseline.py``  — grandfathered findings (tools/check-baseline.json)
   * ``engine.py``    — file iteration, program-model orchestration,
@@ -43,5 +47,6 @@ import checklib.rules_async  # check: disable=unused-import -- import registers 
 import checklib.rules_hygiene  # check: disable=unused-import -- import registers the rules
 import checklib.rules_flow  # check: disable=unused-import -- import registers the rules
 import checklib.rules_contracts  # check: disable=unused-import -- import registers the rules
+import checklib.rules_errors  # check: disable=unused-import -- import registers the rules
 
 __all__ = ["Finding", "RULES", "rule", "check_file", "run", "main"]

@@ -255,6 +255,16 @@ def run(
     )
     if not package_covered:
         program_rules = []
+    if program_rules:
+        # Pre-build the shared analyses HERE, outside the per-rule
+        # timing loop: built lazily by the first consuming rule, the
+        # escape fixpoint's cost would be double-reported — attributed
+        # to that rule AND printed as the 'escape fixpoint' phase — and
+        # a maintainer chasing a --max-seconds regression would profile
+        # the wrong module.
+        from checklib.exceptions import flow_for
+
+        flow_for(model)
     ctx_by_path = {c.rel_path: c for c in contexts}
     program_timings: Dict[str, float] = {}
     for rule in program_rules:
@@ -320,6 +330,12 @@ def run(
     graph = getattr(model, "_callgraph", None)
     if graph is not None:
         stats["program"].update(graph.stats())
+    flow = getattr(model, "_excflow", None)
+    if flow is not None:
+        # the exception-escape phase (generation 3): built lazily by the
+        # first errors rule, shared by the rest; its fixpoint cost is
+        # what --max-seconds is guarding against growing quadratic
+        stats["program"].update(flow.stats())
     return RunResult(
         findings, len(checked_rel_paths), grandfathered, in_scope, stats
     )
@@ -388,9 +404,103 @@ def _render_stats(result: RunResult) -> str:
         f"parse {s.get('parse_s', 0):.3f}s, "
         f"model {s.get('model_s', 0):.3f}s, "
         f"file rules {s.get('file_rules_s', 0):.3f}s, "
+        f"escape fixpoint {prog.get('escape_build_s', 0):.3f}s "
+        f"({prog.get('escape_functions', 0)} functions, "
+        f"{prog.get('escape_iterations', 0)} rounds), "
         f"program rules [{rule_times}]; "
         f"total {s.get('elapsed_s', 0):.3f}s"
     )
+
+
+#: SARIF 2.1.0 (the GitHub code-scanning ingestion format): findings
+#: become `results`, rule metadata rides in the tool.driver block, and
+#: whole-program chain evidence maps onto codeFlows/threadFlows so the
+#: annotation UI can walk the call chain hop by hop.
+_SARIF_SCHEMA = "https://json.schemastore.org/sarif-2.1.0.json"
+
+
+def _render_sarif(result: RunResult, out) -> None:
+    rules_meta = [
+        {
+            "id": r.name,
+            "shortDescription": {"text": r.description},
+            "defaultConfiguration": {"level": "error"},
+        }
+        for r in RULES.values()
+    ] + [
+        {
+            "id": name,
+            "shortDescription": {"text": desc},
+            "defaultConfiguration": {"level": "error"},
+        }
+        for name, desc in ENGINE_RULES.items()
+    ]
+    results = []
+    for f in result.findings:
+        entry = {
+            "ruleId": f.rule,
+            "level": "error",
+            "message": {"text": f.message},
+            "locations": [
+                {
+                    "physicalLocation": {
+                        "artifactLocation": {"uri": f.path},
+                        # SARIF regions are 1-based; line 0 ("whole
+                        # file") findings anchor at the first line
+                        "region": {"startLine": max(f.line, 1)},
+                    }
+                }
+            ],
+        }
+        if f.chain:
+            entry["codeFlows"] = [
+                {
+                    "threadFlows": [
+                        {
+                            "locations": [
+                                {
+                                    "location": {
+                                        "physicalLocation": {
+                                            "artifactLocation": {
+                                                "uri": hop["path"]
+                                            },
+                                            "region": {
+                                                "startLine": max(
+                                                    hop["line"], 1
+                                                )
+                                            },
+                                        },
+                                        "message": {"text": hop["symbol"]},
+                                    }
+                                }
+                                for hop in f.chain
+                            ]
+                        }
+                    ]
+                }
+            ]
+        results.append(entry)
+    doc = {
+        "version": "2.1.0",
+        "$schema": _SARIF_SCHEMA,
+        "runs": [
+            {
+                "tool": {
+                    "driver": {
+                        # informationUri is omitted: the spec requires
+                        # an ABSOLUTE URI and this tree has no canonical
+                        # home to point at; docs/CHECKS.md is the
+                        # operator-facing reference
+                        "name": "checklib",
+                        "rules": rules_meta,
+                    }
+                },
+                "results": results,
+            }
+        ],
+    }
+    json.dump(doc, out, indent=2)
+    out.write("\n")
 
 
 def _list_rules() -> str:
@@ -418,7 +528,10 @@ def main(argv) -> int:
         "targets", nargs="*", help="files/directories (default: the tree)"
     )
     parser.add_argument(
-        "--format", choices=("text", "json"), default="text", dest="fmt"
+        "--format",
+        choices=("text", "json", "sarif"),
+        default="text",
+        dest="fmt",
     )
     parser.add_argument(
         "--output", help="write the report here instead of stdout"
@@ -518,6 +631,8 @@ def main(argv) -> int:
         if args.fmt == "json":
             json.dump(result.to_dict(), out, indent=2)
             out.write("\n")
+        elif args.fmt == "sarif":
+            _render_sarif(result, out)
         else:
             _render_text(result, out)
     finally:

@@ -1,0 +1,767 @@
+"""Interprocedural exception-escape analysis (generation 3).
+
+Per-function *escape sets* — which exception classes can propagate out of
+each ``def`` — computed by fixpoint over the PR-6 call graph:
+
+  * **raise sites**: ``raise X(...)`` / ``raise X`` resolved through the
+    cross-module symbol table to the defining class (in-model classes
+    canonicalize to ``module:Class``; builtins to their bare name);
+  * **callee propagation**: a resolved call contributes its callee's
+    current escape set (awaited calls for ``async def`` callees — an
+    un-awaited coroutine call raises nothing *here*, which is exactly
+    what the task-blackhole rule reasons about instead);
+  * **handler modeling**: ``try/except`` filters the body's escapes per
+    clause, in clause order, including tuple clauses
+    (``except (A, B):``), bare re-raise (``raise`` inside a handler
+    re-throws the subset that clause caught), ``except X as e: raise e``
+    (same), ``else``/``finally`` blocks, and the exception class
+    hierarchy (an ``except StateFileError`` catches ``StateFileMissing``)
+    resolved through in-model bases plus a curated builtin hierarchy;
+  * **conservative widening**: every edge the model cannot resolve — an
+    opaque call, a dynamic raise (``raise err``), an external callable —
+    contributes the ``UNKNOWN`` token.  Escape sets therefore *over*-
+    approximate with an explicit marker, and the rules in
+    ``rules_errors.py`` only ever act on **named** classes: a finding
+    claims "this class provably flows here", never "nothing else can".
+
+Two deliberate asymmetries keep the zero-false-positive contract:
+
+  * an **unresolvable handler clause** (``except plugin.Error:`` where
+    the name doesn't resolve) is assumed to catch *everything* — the
+    direction that yields fewer findings;
+  * ``CancelledError`` / ``GeneratorExit`` / ``KeyboardInterrupt`` /
+    ``SystemExit`` are excluded from the domain entirely: they are
+    control-flow signals with their own rule (swallowed-cancel), not
+    part of the error contract.
+
+One resolution step goes beyond the call graph's: a method call on an
+*opaque* receiver (``zk.heartbeat(...)`` where ``zk`` is a parameter)
+resolves to the method when **exactly one** class in the whole model
+defines that method name — the same duck-typing bet the mutator rule
+makes for ``zk.put``, applied to exception propagation.  Ambiguous names
+(``get``, ``close``, ``run``) stay unresolved.
+"""
+
+from __future__ import annotations
+
+import ast
+import time
+from typing import Dict, List, Optional, Set, Tuple
+
+from checklib.program import (
+    CallSite,
+    FunctionInfo,
+    ModuleInfo,
+    ProgramModel,
+    _dotted,
+)
+
+#: The widening marker: "something the model cannot name can also
+#: escape here".  Rules never act on it.
+UNKNOWN = "<unknown>"
+
+#: Control-flow signals excluded from the escape domain (module docstring).
+_SIGNALS = frozenset(
+    {"CancelledError", "GeneratorExit", "KeyboardInterrupt", "SystemExit"}
+)
+
+#: Curated builtin exception hierarchy (child -> parent).  Only classes
+#: this tree can plausibly meet; anything absent resolves to UNKNOWN.
+BUILTIN_PARENTS: Dict[str, str] = {
+    "Exception": "BaseException",
+    "ArithmeticError": "Exception",
+    "ZeroDivisionError": "ArithmeticError",
+    "FloatingPointError": "ArithmeticError",
+    "OverflowError": "ArithmeticError",
+    "AssertionError": "Exception",
+    "AttributeError": "Exception",
+    "BufferError": "Exception",
+    "EOFError": "Exception",
+    "ImportError": "Exception",
+    "ModuleNotFoundError": "ImportError",
+    "LookupError": "Exception",
+    "IndexError": "LookupError",
+    "KeyError": "LookupError",
+    "MemoryError": "Exception",
+    "NameError": "Exception",
+    "UnboundLocalError": "NameError",
+    "OSError": "Exception",
+    "ConnectionError": "OSError",
+    "BrokenPipeError": "ConnectionError",
+    "ConnectionAbortedError": "ConnectionError",
+    "ConnectionRefusedError": "ConnectionError",
+    "ConnectionResetError": "ConnectionError",
+    "BlockingIOError": "OSError",
+    "ChildProcessError": "OSError",
+    "FileExistsError": "OSError",
+    "FileNotFoundError": "OSError",
+    "InterruptedError": "OSError",
+    "IsADirectoryError": "OSError",
+    "NotADirectoryError": "OSError",
+    "PermissionError": "OSError",
+    "ProcessLookupError": "OSError",
+    "TimeoutError": "OSError",
+    "ReferenceError": "Exception",
+    "RuntimeError": "Exception",
+    "NotImplementedError": "RuntimeError",
+    "RecursionError": "RuntimeError",
+    "StopIteration": "Exception",
+    "StopAsyncIteration": "Exception",
+    "SyntaxError": "Exception",
+    "IndentationError": "SyntaxError",
+    "SystemError": "Exception",
+    "TypeError": "Exception",
+    "ValueError": "Exception",
+    "UnicodeError": "ValueError",
+    "UnicodeDecodeError": "UnicodeError",
+    "UnicodeEncodeError": "UnicodeError",
+}
+
+#: External dotted names that alias a builtin (version drift absorbed:
+#: asyncio.TimeoutError IS TimeoutError on 3.11+, a distinct Exception
+#: subclass before — parenting it at Exception is sound either way
+#: because TimeoutError is itself an Exception subclass).
+EXT_ALIASES: Dict[str, str] = {
+    "asyncio.CancelledError": "CancelledError",
+    "asyncio.TimeoutError": "TimeoutError",
+    "asyncio.exceptions.CancelledError": "CancelledError",
+    "socket.timeout": "TimeoutError",
+    "socket.error": "OSError",
+    "socket.gaierror": "OSError",
+    "binascii.Error": "ValueError",  # parent, not alias — close enough
+    "json.JSONDecodeError": "ValueError",
+    "asyncio.IncompleteReadError": "EOFError",
+}
+
+
+def display_name(token: str) -> str:
+    """Operator-facing class name for a token (``a.b:X`` -> ``X``)."""
+    return token.rsplit(":", 1)[-1].rsplit(".", 1)[-1]
+
+
+class ExceptionFlow:
+    """The analysis: build once per run (``flow_for``), query per rule."""
+
+    def __init__(self, model: ProgramModel, graph):
+        self.model = model
+        self.graph = graph
+        t0 = time.monotonic()
+        #: in-model class token -> list of parent tokens
+        self.class_parents: Dict[str, List[str]] = {}
+        #: bare class name -> list of in-model tokens carrying it
+        self.classes_by_name: Dict[str, List[str]] = {}
+        self._build_class_table()
+        #: method name -> FunctionInfo when exactly ONE model class
+        #: defines it (the opaque-receiver duck resolution); None when
+        #: ambiguous.
+        self._unique_methods: Dict[str, Optional[FunctionInfo]] = {}
+        self._build_method_index()
+        self._subclass_cache: Dict[Tuple[str, str], bool] = {}
+        #: FunctionInfo -> compiled body IR
+        self._ir: Dict[FunctionInfo, list] = {}
+        #: FunctionInfo -> escape token set (the fixpoint result)
+        self._escapes: Dict[FunctionInfo, Set[str]] = {}
+        #: (FunctionInfo, token) -> witness hop: (lineno, callee|None)
+        #: — callee None means a raise site in this very function.
+        self._witness: Dict[Tuple[FunctionInfo, str], Tuple[int, object]] = {}
+        #: every token with a literal raise site anywhere (caught or not)
+        self._raised: Set[str] = set()
+        #: synthetic CallSites (thunk/lambda resolution) pinned for the
+        #: flow's lifetime: CallGraph.resolve caches by id(site), so a
+        #: garbage-collected synthetic could let a NEW site inherit a
+        #: stale resolution through id reuse
+        self._pinned: List[CallSite] = []
+        self._functions = list(model.functions())
+        self._compile_all()
+        self.iterations = self._fixpoint()
+        self.build_seconds = round(time.monotonic() - t0, 4)
+
+    # -- class table ------------------------------------------------------
+
+    def _build_class_table(self) -> None:
+        for mod in self.model.modules.values():
+            for cname, cls in mod.classes.items():
+                token = f"{mod.name}:{cname}"
+                self.classes_by_name.setdefault(cname, []).append(token)
+                parents: List[str] = []
+                for base, battrs in cls.bases:
+                    parent = self._resolve_class_ref(mod, base, battrs)
+                    if parent is not None:
+                        parents.append(parent)
+                self.class_parents[token] = parents
+
+    def _resolve_class_ref(self, mod: ModuleInfo, base: str, attrs) -> Optional[str]:
+        """Token for a class *reference expression* in ``mod`` — an
+        in-model token, a builtin name, an ext alias, or None."""
+        if mod.degraded:
+            # a star/dynamic import can shadow ANY name, builtins
+            # included: nothing in this module resolves (program.py's
+            # degradation contract applied to the class domain)
+            return None
+        if not attrs:
+            if base in mod.classes:
+                return f"{mod.name}:{base}"
+            src = mod.from_imports.get(base)
+            if src is not None:
+                source, orig = src
+                sub = f"{source}.{orig}"
+                if sub in self.model.modules:
+                    return None  # a module, not a class
+                if source in self.model.modules:
+                    target = self.model.modules[source]
+                    if orig in target.classes:
+                        return f"{target.name}:{orig}"
+                    return None
+                dotted = f"{source}.{orig}"
+                return EXT_ALIASES.get(dotted, dotted)
+            if base in mod.imports:
+                return None  # a module called bare: not a class
+            if base in BUILTIN_PARENTS or base == "BaseException":
+                # only when nothing module-level shadows the builtin
+                if base not in mod.bindings:
+                    return base
+            return None
+        if len(attrs) == 1 and base in mod.imports:
+            target_name = mod.imports[base]
+            target = self.model.modules.get(target_name)
+            if target is not None:
+                if attrs[0] in target.classes:
+                    return f"{target.name}:{attrs[0]}"
+                return None
+            dotted = f"{target_name}.{attrs[0]}"
+            return EXT_ALIASES.get(dotted, dotted)
+        if len(attrs) == 1 and base in mod.from_imports:
+            source, orig = mod.from_imports[base]
+            sub = f"{source}.{orig}"
+            target = self.model.modules.get(sub)
+            if target is not None:
+                if attrs[0] in target.classes:
+                    return f"{target.name}:{attrs[0]}"
+                return None
+        return None
+
+    def _build_method_index(self) -> None:
+        counts: Dict[str, List[FunctionInfo]] = {}
+        for mod in self.model.modules.values():
+            for cls in mod.classes.values():
+                for name, fn in cls.methods.items():
+                    counts.setdefault(name, []).append(fn)
+        for name, fns in counts.items():
+            self._unique_methods[name] = fns[0] if len(fns) == 1 else None
+
+    def is_subclass(self, token: str, ancestor: str) -> bool:
+        """Reflexive-transitive subclass test over in-model bases plus
+        the builtin table.  UNKNOWN is a subclass of nothing."""
+        if token == UNKNOWN:
+            return False
+        key = (token, ancestor)
+        cached = self._subclass_cache.get(key)
+        if cached is not None:
+            return cached
+        seen: Set[str] = set()
+        frontier = [token]
+        result = False
+        while frontier:
+            t = frontier.pop()
+            if t in seen:
+                continue
+            seen.add(t)
+            if t == ancestor:
+                result = True
+                break
+            frontier.extend(self.class_parents.get(t, ()))
+            parent = BUILTIN_PARENTS.get(t)
+            if parent is not None:
+                frontier.append(parent)
+            # ext dotted names with no known parent simply contribute no
+            # further ancestors — the walk ends there
+        self._subclass_cache[key] = result
+        return result
+
+    def caught_by(self, token: str, handler_tokens) -> bool:
+        """Would a handler naming ``handler_tokens`` catch ``token``?
+
+        ``handler_tokens`` of None means a bare ``except:``.  An UNKNOWN
+        *handler* element catches everything (conservative: fewer
+        escapes).  An ``except Exception`` clause also catches EVERY
+        token — UNKNOWN, external classes with no known hierarchy
+        (``extlib.WireError``), and in-model classes whose base chain
+        the model cannot follow: the only BaseException-not-Exception
+        descendants this domain could meet are the control-flow signals,
+        and those are excluded from it entirely.  Anything else would
+        let a named external class "escape" a broad handler that
+        provably swallows it — a false positive."""
+        if handler_tokens is None:
+            return True
+        if (
+            UNKNOWN in handler_tokens
+            or "BaseException" in handler_tokens
+            or "Exception" in handler_tokens
+        ):
+            return True
+        if token == UNKNOWN:
+            return False
+        return any(self.is_subclass(token, h) for h in handler_tokens)
+
+    # -- expression -> exception token ------------------------------------
+
+    def class_token(self, func: FunctionInfo, expr) -> str:
+        """Token for an exception-class expression at a site inside
+        ``func`` (handler clause element, or the callee of
+        ``raise X(...)``).  UNKNOWN when unresolvable or shadowed."""
+        d = _dotted(expr)
+        if d is None:
+            return UNKNOWN
+        base, attrs = d
+        if base in func.param_chain():
+            return UNKNOWN
+        token = self._resolve_class_ref(func.module, base, attrs)
+        return token if token is not None else UNKNOWN
+
+    def handler_tokens(self, func: FunctionInfo, handler_type) -> Optional[frozenset]:
+        """Clause classes for one except handler; None = bare except."""
+        if handler_type is None:
+            return None
+        elts = (
+            handler_type.elts
+            if isinstance(handler_type, ast.Tuple)
+            else [handler_type]
+        )
+        return frozenset(self.class_token(func, e) for e in elts)
+
+    # -- IR ----------------------------------------------------------------
+
+    def _compile_all(self) -> None:
+        for func in self._functions:
+            self._escapes[func] = set()
+            if func.node is None:
+                self._ir[func] = []
+                continue
+            sites = {id(s.node): s for s in func.calls}
+            self._ir[func] = self._compile_block(func, func.node.body, sites)
+
+    def _compile_block(self, func, stmts, sites) -> list:
+        out: list = []
+
+        def walk_expr(node) -> None:
+            if isinstance(
+                node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda,
+                       ast.ClassDef)
+            ):
+                return  # separate scopes (lambdas: conservative silence)
+            if isinstance(node, ast.Call):
+                site = sites.get(id(node))
+                if site is not None:
+                    out.append(("call", site))
+            for child in ast.iter_child_nodes(node):
+                walk_expr(child)
+
+        for stmt in stmts:
+            if isinstance(
+                stmt, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)
+            ):
+                continue
+            if isinstance(stmt, ast.Raise):
+                if stmt.exc is not None:
+                    walk_expr(stmt.exc)  # constructor args can call too
+                out.append(self._compile_raise(func, stmt))
+                continue
+            if isinstance(stmt, ast.Try):
+                for item in getattr(stmt, "handlers", []):
+                    if item.type is not None:
+                        walk_expr(item.type)
+                body = self._compile_block(func, stmt.body, sites)
+                handlers = [
+                    (
+                        self.handler_tokens(func, h.type),
+                        self._compile_block(func, h.body, sites),
+                    )
+                    for h in stmt.handlers
+                ]
+                orelse = self._compile_block(func, stmt.orelse, sites)
+                final = self._compile_block(func, stmt.finalbody, sites)
+                out.append(("try", body, handlers, orelse, final))
+                continue
+            match_cls = getattr(ast, "Match", None)
+            if match_cls is not None and isinstance(stmt, match_cls):
+                walk_expr(stmt.subject)
+                for case in stmt.cases:
+                    out.extend(self._compile_block(func, case.body, sites))
+                continue
+            # every other statement: harvest call sites in source order,
+            # recursing into nested blocks (if/for/while/with bodies are
+            # transparent to exception flow)
+            nested_blocks = []
+            for field in ("body", "orelse", "finalbody"):
+                sub = getattr(stmt, field, None)
+                if isinstance(sub, list) and sub and isinstance(
+                    sub[0], ast.stmt
+                ):
+                    nested_blocks.append(sub)
+            if nested_blocks:
+                for child in ast.iter_child_nodes(stmt):
+                    if not isinstance(child, ast.stmt):
+                        walk_expr(child)
+                for sub in nested_blocks:
+                    out.extend(self._compile_block(func, sub, sites))
+            else:
+                walk_expr(stmt)
+        return out
+
+    def _compile_raise(self, func: FunctionInfo, stmt: ast.Raise):
+        if stmt.exc is None:
+            return ("reraise", stmt.lineno)
+        exc = stmt.exc
+        # `raise X(...)` -> the class is the callee; `raise X` -> X itself
+        target = exc.func if isinstance(exc, ast.Call) else exc
+        if isinstance(target, ast.Name) and self._is_handler_bound(
+            func, stmt, target.id
+        ):
+            return ("reraise", stmt.lineno)
+        token = self.class_token(func, target)
+        if display_name(token) in _SIGNALS:
+            return ("raise", frozenset(), stmt.lineno)
+        if token != UNKNOWN:
+            self._raised.add(token)
+        return ("raise", frozenset({token}), stmt.lineno)
+
+    def _is_handler_bound(self, func, stmt, name: str) -> bool:
+        """Is ``raise <name>`` at ``stmt`` re-raising the innermost
+        enclosing ``except ... as <name>`` binding?"""
+        if func.node is None:
+            return False
+        best: Optional[str] = None
+
+        def walk(node, current):
+            nonlocal best
+            if node is stmt:
+                best = current
+                return True
+            if isinstance(
+                node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+            ) and node is not func.node:
+                return False
+            if isinstance(node, ast.Try):
+                for child in node.body + node.orelse + node.finalbody:
+                    if walk(child, current):
+                        return True
+                for h in node.handlers:
+                    inner = h.name if h.name else current
+                    for child in h.body:
+                        if walk(child, inner):
+                            return True
+                return False
+            for child in ast.iter_child_nodes(node):
+                if walk(child, current):
+                    return True
+            return False
+
+        walk(func.node, None)
+        return best == name
+
+    # -- fixpoint ----------------------------------------------------------
+
+    def call_escapes(self, site: CallSite) -> Tuple[Set[str], object]:
+        """(escape set, resolved callee or None) for one call site under
+        the CURRENT fixpoint state."""
+        res = self.graph.resolve(site)
+        if res is not None and res[0] == "func":
+            callee = res[1]
+            if callee.is_async and not site.awaited:
+                return set(), callee  # coroutine object only: raises nowhere
+            return set(self._escapes.get(callee, ())), callee
+        # a CLASS call is a constructor: its exceptions are __init__'s
+        # (an in-model class with no modeled __init__ — field record,
+        # plain Exception subclass — raises nothing named; a builtin
+        # exception constructor likewise).  Checked before the ext
+        # branch so `asyncio.CancelledError()` resolves as a signal
+        # constructor, not an unknown external callable.
+        ctor = self._constructor_target(site)
+        if ctor is not None:
+            init, token = ctor
+            if init is not None:
+                return set(self._escapes.get(init, ())), init
+            return set(), None
+        if res is not None and res[0] == "ext":
+            return {UNKNOWN}, None
+        # opaque-receiver duck resolution (module docstring)
+        callee = self._duck_resolve(site)
+        if callee is not None:
+            if callee.is_async and not site.awaited:
+                return set(), callee
+            return set(self._escapes.get(callee, ())), callee
+        return {UNKNOWN}, None
+
+    def _constructor_target(self, site: CallSite):
+        """(init FunctionInfo or None, class token) when the call's
+        callee expression resolves to a class; None when it is not a
+        class reference at all."""
+        if site.shape[0] == "name":
+            base, attrs = site.shape[1], ()
+        elif site.shape[0] == "dotted":
+            base, attrs = site.shape[1], site.shape[2]
+        else:
+            return None
+        if base in site.func.param_chain():
+            return None
+        token = self._resolve_class_ref(site.func.module, base, attrs)
+        if token is None:
+            return None
+        if ":" in token:
+            mod_name, cname = token.rsplit(":", 1)
+            mod = self.model.modules.get(mod_name)
+            cls = mod.classes.get(cname) if mod is not None else None
+            init = cls.methods.get("__init__") if cls is not None else None
+            return init, token
+        if (
+            token in BUILTIN_PARENTS
+            or token == "BaseException"
+            or display_name(token) in _SIGNALS
+        ):
+            return None, token  # builtin exception ctor: raises nothing
+        return None
+
+    def _duck_resolve(self, site: CallSite) -> Optional[FunctionInfo]:
+        if site.shape[0] != "dotted":
+            return None
+        base, attrs = site.shape[1], site.shape[2]
+        method = attrs[-1]
+        target = self._unique_methods.get(method)
+        if target is None:
+            return None
+        # the receiver must be opaque: a parameter, self/cls, or a name
+        # with no module-level resolution (a local) — a base resolving
+        # to a module or model object is something else entirely.
+        if base not in ("self", "cls") and base not in site.func.param_chain():
+            if self.graph._module_binding_target(site.func.module, base) is not None:
+                return None
+        return target
+
+    def _eval_block(self, func, block, caught: Dict[str, tuple]) -> Dict[str, tuple]:
+        """token -> witness hop ``(lineno, callee|None)`` for everything
+        escaping ``block``.  Witnesses travel WITH their tokens through
+        the handler filtering, so a raise that is subsequently caught
+        can never end up as the evidence for a token that escaped some
+        other way (the JSON/SARIF chains operators are told to trust)."""
+        out: Dict[str, tuple] = {}
+        for node in block:
+            kind = node[0]
+            if kind == "raise":
+                for token in node[1]:
+                    out.setdefault(token, (node[2], None))
+            elif kind == "reraise":
+                for token in caught:
+                    out.setdefault(token, (node[1], None))
+            elif kind == "call":
+                site = node[1]
+                escapes, callee = self.call_escapes(site)
+                for token in escapes:
+                    out.setdefault(token, (site.lineno, callee))
+            else:  # try
+                _, body, handlers, orelse, final = node
+                remaining = self._eval_block(func, body, caught)
+                for handler_tokens, handler_block in handlers:
+                    caught_here = {
+                        t: hop
+                        for t, hop in remaining.items()
+                        if self.caught_by(t, handler_tokens)
+                    }
+                    for t in caught_here:
+                        del remaining[t]
+                    for t, hop in self._eval_block(
+                        func, handler_block, caught_here
+                    ).items():
+                        out.setdefault(t, hop)
+                for t, hop in remaining.items():
+                    out.setdefault(t, hop)
+                for sub in (orelse, final):
+                    for t, hop in self._eval_block(func, sub, caught).items():
+                        out.setdefault(t, hop)
+        return out
+
+    def _fixpoint(self) -> int:
+        iterations = 0
+        changed = True
+        while changed and iterations < 50:
+            changed = False
+            iterations += 1
+            for func in self._functions:
+                new = self._eval_block(func, self._ir[func], {})
+                fresh = set(new) - self._escapes[func]
+                if fresh:
+                    self._escapes[func] |= set(new)
+                    changed = True
+                for token, hop in new.items():
+                    self._witness.setdefault((func, token), hop)
+        return iterations
+
+    # -- public query surface ---------------------------------------------
+
+    def escapes(self, func: FunctionInfo) -> frozenset:
+        """Every token that can escape ``func`` (UNKNOWN included)."""
+        return frozenset(self._escapes.get(func, ()))
+
+    def named_escapes(self, func: FunctionInfo) -> frozenset:
+        return frozenset(
+            t for t in self._escapes.get(func, ()) if t != UNKNOWN
+        )
+
+    def raised_tokens(self) -> frozenset:
+        """Every class with a literal, resolvable raise site anywhere in
+        the program — caught or not (the fault-matrix rule's 'is this
+        class still real' test must not condemn a class whose raises are
+        all handled)."""
+        return frozenset(self._raised)
+
+    def constructed_tokens(self) -> frozenset:
+        """Every in-model/builtin class with a resolvable *construction*
+        site — ``HealthCheckError(...)`` passed as a value is as alive
+        as a raise (the reference's err-object callback style)."""
+        cached = getattr(self, "_constructed", None)
+        if cached is not None:
+            return cached
+        out: Set[str] = set()
+        for site in self.model.all_call_sites():
+            token = self.class_token(site.func, site.node.func)
+            if token != UNKNOWN:
+                out.add(token)
+        self._constructed = frozenset(out)
+        return self._constructed
+
+    def block_escapes(self, func: FunctionInfo, stmts) -> Set[str]:
+        """Escape set of an arbitrary statement block inside ``func``
+        under the converged fixpoint state (the overbroad-handler rule
+        evaluates try bodies in isolation with it)."""
+        sites = {id(s.node): s for s in func.calls}
+        ir = self._compile_block(func, stmts, sites)
+        return set(self._eval_block(func, ir, {}))
+
+    def escape_chain(self, func: FunctionInfo, token: str) -> List[Tuple[str, str, int]]:
+        """Witness chain ``[(symbol, rel_path, line), ...]`` from ``func``
+        down to a raise site of ``token`` (or to the last resolvable hop)."""
+        chain: List[Tuple[str, str, int]] = []
+        seen: Set[FunctionInfo] = set()
+        current: Optional[FunctionInfo] = func
+        while current is not None and current not in seen:
+            seen.add(current)
+            hop = self._witness.get((current, token))
+            if hop is None:
+                chain.append(
+                    (current.ref, current.module.rel_path, current.lineno)
+                )
+                break
+            lineno, callee = hop
+            chain.append((current.ref, current.module.rel_path, lineno))
+            if callee is None or not isinstance(callee, FunctionInfo):
+                chain.append(
+                    (
+                        f"raise {display_name(token)}",
+                        current.module.rel_path,
+                        lineno,
+                    )
+                )
+                break
+            current = callee
+        return chain
+
+    def thunk_escapes(self, site: CallSite, expr) -> Tuple[Set[str], Dict[str, FunctionInfo]]:
+        """Escape set of a *callable-valued argument* (the ``fn`` handed
+        to ``call_with_backoff``): a name/attribute resolving to a model
+        function, a ``lambda: f(...)`` body, or ``functools.partial(f,
+        ...)``.  Returns ``(tokens, origins)`` where ``origins`` maps
+        each token to the resolved callee it escaped FROM — the chain
+        anchor.  A lambda combining several calls attributes every token
+        to its own contributor, so evidence never names an innocent
+        function.  Tokens are UNKNOWN-only when nothing resolves."""
+        if isinstance(expr, ast.Lambda):
+            out: Set[str] = set()
+            origins: Dict[str, FunctionInfo] = {}
+            for sub in ast.walk(expr.body):
+                if isinstance(sub, ast.Call):
+                    fake = self._pin(_synthetic_site(sub, site.func))
+                    if fake is None:
+                        out.add(UNKNOWN)
+                        continue
+                    # synthetic sites are built awaited=True: the retry
+                    # boundary awaits the thunk's awaitable, so an async
+                    # callee's escapes count here
+                    escapes, callee = self.call_escapes(fake)
+                    out |= escapes
+                    if isinstance(callee, FunctionInfo):
+                        for token in escapes:
+                            origins.setdefault(token, callee)
+            return (out or {UNKNOWN}), origins
+        if isinstance(expr, ast.Call):
+            d = _dotted(expr.func)
+            if d is not None and d[1][-1:] == ("partial",) or (
+                d is not None and not d[1] and d[0] == "partial"
+            ):
+                if expr.args:
+                    return self.thunk_escapes(site, expr.args[0])
+            return {UNKNOWN}, {}
+        callee = self.resolve_callable_ref(site, expr)
+        if callee is None:
+            return {UNKNOWN}, {}
+        tokens = set(self._escapes.get(callee, ()))
+        return tokens, {t: callee for t in tokens}
+
+    def resolve_callable_ref(self, site: CallSite, expr) -> Optional[FunctionInfo]:
+        """The model function a bare callable REFERENCE names — the
+        ``on_data`` in ``check.on("data", on_data)``, the
+        ``self._connect_once`` handed to a retry boundary — or None."""
+        fake = self._pin(_synthetic_site(expr, site.func, is_ref=True))
+        if fake is None:
+            return None
+        res = self.graph.resolve(fake)
+        callee = res[1] if (res is not None and res[0] == "func") else None
+        if callee is None:
+            callee = self._duck_resolve(fake)
+        return callee
+
+    def _pin(self, site: Optional[CallSite]) -> Optional[CallSite]:
+        if site is not None:
+            self._pinned.append(site)
+        return site
+
+    def stats(self) -> dict:
+        return {
+            "escape_functions": len(self._functions),
+            "escape_iterations": self.iterations,
+            "escape_build_s": self.build_seconds,
+        }
+
+
+class _FakeCall:
+    __slots__ = ("lineno",)
+
+    def __init__(self, lineno):
+        self.lineno = lineno
+
+
+def _synthetic_site(expr, func: FunctionInfo, is_ref: bool = False) -> Optional[CallSite]:
+    """A CallSite for an expression that is not one of the function's
+    collected sites: a call inside a lambda body, or a bare callable
+    reference (``self._connect_once``) handed to a retry boundary."""
+    target = expr if is_ref else expr.func
+    d = _dotted(target)
+    if d is None:
+        return None
+    if not d[1]:
+        shape = ("name", d[0])
+    else:
+        shape = ("dotted", d[0], d[1])
+    fake = _FakeCall(getattr(expr, "lineno", func.lineno))
+    return CallSite(fake, shape, awaited=True, bare_stmt=False,
+                    under_lock=False, func=func)
+
+
+def flow_for(model: ProgramModel):
+    """One ExceptionFlow per program model, shared by every errors rule
+    (and surfaced into ``--stats`` by the engine)."""
+    flow = getattr(model, "_excflow", None)
+    if flow is None:
+        from checklib.rules_flow import graph_for
+
+        flow = ExceptionFlow(model, graph_for(model))
+        model._excflow = flow
+    return flow

@@ -133,7 +133,7 @@ class FunctionInfo:
 
     __slots__ = (
         "module", "qualname", "name", "is_async", "lineno", "cls",
-        "params", "parent", "children", "calls",
+        "params", "parent", "children", "calls", "node",
     )
 
     def __init__(self, module, qualname, name, is_async, lineno, cls, parent):
@@ -147,6 +147,10 @@ class FunctionInfo:
         self.parent: Optional["FunctionInfo"] = None if parent is None else parent
         self.children: Dict[str, "FunctionInfo"] = {}
         self.calls: List[CallSite] = []
+        #: the ast.FunctionDef/AsyncFunctionDef (None for the module
+        #: pseudo-function) — the exception-escape analysis re-walks the
+        #: body for raise sites and try/except structure (generation 3)
+        self.node = None
 
     @property
     def ref(self) -> str:
@@ -420,6 +424,7 @@ def _collect_functions(mod: ModuleInfo, tree: ast.Module) -> None:
             cls.name if (cls is not None and in_class_body) else None,
             func if func is not mod.module_func else None,
         )
+        info.node = child
         args = child.args
         for a in (
             list(args.posonlyargs) + list(args.args)

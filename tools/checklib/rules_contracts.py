@@ -10,8 +10,10 @@ diff the surfaces program-wide instead.
 
 from __future__ import annotations
 
+import ast
 import os
-from typing import Iterator
+import re
+from typing import Iterator, Set
 
 from checklib.model import Finding
 from checklib.program import (
@@ -179,4 +181,99 @@ def config_key_drift(model: ProgramModel) -> Iterator[Finding]:
                     0,
                     f"config key '{key}' is present in the example "
                     f"config but never documented in {CONFIG_DOC}",
+                )
+
+
+# -- doc scanning shared by the drift rules -----------------------------------
+
+
+def read_doc_lines(path: str):
+    """Lines of a documentation file, or None when it is absent or
+    unreadable — the ONE copy of the read-or-skip pattern every
+    doc-drift rule (config keys, metric names, the fault matrix in
+    rules_errors.py) shares, so a rule skips a missing doc's leg
+    instead of condemning everything against an empty mention set."""
+    try:
+        with open(path, "r", encoding="utf-8") as fh:
+            return fh.read().split("\n")
+    except OSError:
+        return None
+
+
+# -- metric-name-drift ---------------------------------------------------------
+
+METRICS_PATH = "registrar_tpu/metrics.py"
+OPERATIONS_DOC = "docs/OPERATIONS.md"
+
+#: ``registrar_*`` tokens in doc prose/alert expressions.  Greedy over
+#: the name alphabet; a token ending in ``_`` is a prefix/wildcard
+#: mention (``registrar_cache_*``, ``grep registrar_``) and is skipped.
+_METRIC_REF = re.compile(r"registrar_[a-z0-9_]*")
+
+
+def _defined_metric_names(tree) -> Set[str]:
+    """String literals passed as CALL arguments in metrics.py — the
+    ``Counter("registrar_x_total", ...)`` constructor surface.  The
+    module docstring also lists every name, but a docstring can go
+    stale exactly like the runbook; only real constructor args count."""
+    out: Set[str] = set()
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        for arg in list(node.args) + [kw.value for kw in node.keywords]:
+            if (
+                isinstance(arg, ast.Constant)
+                and isinstance(arg.value, str)
+                and _METRIC_REF.fullmatch(arg.value)
+                and not arg.value.endswith("_")
+            ):
+                out.add(arg.value)
+    return out
+
+
+@rule(
+    "metric-name-drift",
+    "docs/OPERATIONS.md references a metric metrics.py no longer "
+    "pre-seeds",
+    scope="program",
+)
+def metric_name_drift(model: ProgramModel) -> Iterator[Finding]:
+    # Every registrar_* series is pre-seeded at instrument() time so
+    # alerts never silently match an absent series — which makes the
+    # runbook's metric NAMES part of the contract: renaming a counter
+    # in metrics.py kills every alert built on the old name without a
+    # single test failing.  Diff direction: a name the alerts/runbooks
+    # reference must exist in metrics.py.  (The reverse — a metric the
+    # runbook doesn't mention — is fine: docs highlight, they don't
+    # enumerate.)
+    mod = model.by_path.get(METRICS_PATH)
+    if mod is None:
+        return
+    root = model.package_root()
+    if root is None:
+        return
+    defined = _defined_metric_names(mod.ctx.tree)
+    if not defined:
+        return
+    lines = read_doc_lines(os.path.join(root, *OPERATIONS_DOC.split("/")))
+    if lines is None:
+        return
+    seen: Set[str] = set()
+    for i, line in enumerate(lines, start=1):
+        for m in _METRIC_REF.finditer(line):
+            name = m.group(0)
+            if name.endswith("_") or name in seen:
+                continue  # prefix/wildcard mention, or already reported
+            if name.startswith("registrar_tpu"):
+                continue  # the package import path, not a metric name
+            seen.add(name)
+            if name not in defined:
+                yield Finding(
+                    "metric-name-drift",
+                    OPERATIONS_DOC,
+                    i,
+                    f"metric '{name}' is referenced by the alerts/"
+                    f"runbooks but {METRICS_PATH} pre-seeds no such "
+                    "series (a renamed counter silently kills this "
+                    "alert)",
                 )

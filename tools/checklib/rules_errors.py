@@ -1,0 +1,722 @@
+"""Generation-3 rules: the error contracts (see exceptions.py).
+
+Every robustness feature since PR 2 rests on an exception-class
+contract no test fully exercises: ``retry.is_transient`` decides what
+gets retried vs what kills the process, the rebirth/resume/reload paths
+each promise a specific fallback per exception shape, and
+docs/FAULTS.md + docs/OPERATIONS.md publish a fault matrix operators
+are told to trust.  These rules diff those contracts against what the
+interprocedural escape analysis *proves* can flow where — the same
+contract-drift move config-key-drift made for config keys, applied to
+the failure domain.
+
+All four consume the shared :class:`~checklib.exceptions.ExceptionFlow`
+(built once per run, fixpoint over the PR-6 call graph) and act only on
+**named** classes; the UNKNOWN widening marker never produces a finding.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from typing import Dict, Iterator, List, Optional, Set, Tuple
+
+from checklib.callgraph import chain_evidence, chain_names
+from checklib.context import PACKAGE_PREFIX
+from checklib.exceptions import (
+    BUILTIN_PARENTS,
+    EXT_ALIASES,
+    UNKNOWN,
+    display_name,
+    flow_for,
+)
+from checklib.model import Finding
+from checklib.program import ProgramModel
+from checklib.registry import rule
+from checklib.rules_contracts import read_doc_lines
+from checklib.rules_flow import graph_for
+
+#: The contract classes the robustness story names per-shape fallbacks
+#: for (ISSUE 7): swallowing one in a catch-all handler destroys a
+#: signal some caller was built to act on.
+CONTRACT_CLASS_NAMES = frozenset(
+    {
+        "SessionExpiredError",
+        "OwnershipError",
+        "OperationTimeoutError",
+        "StateFileError",
+    }
+)
+
+FAULTS_DOC = "docs/FAULTS.md"
+OPS_DOC = "docs/OPERATIONS.md"
+
+_RETRY_PATH = PACKAGE_PREFIX + "retry.py"
+
+
+def _contract_tokens(flow) -> Set[str]:
+    out: Set[str] = set()
+    for name in CONTRACT_CLASS_NAMES:
+        out.update(flow.classes_by_name.get(name, ()))
+    return out
+
+
+def _sorted_named(tokens) -> List[str]:
+    return sorted(t for t in tokens if t != UNKNOWN)
+
+
+# -- retry-contract-drift ------------------------------------------------------
+
+
+def _classified_tokens(flow, fn) -> Set[str]:
+    """Every exception class ``retry.is_transient``'s body names —
+    transient or fatal, an ``isinstance`` arm either way counts as
+    'classified': the predicate made a deliberate call about it."""
+    out: Set[str] = set()
+    if fn.node is None:
+        return out
+    for stmt in fn.node.body:  # BODY only: the signature's
+        # `err: BaseException` annotation must not classify everything
+        for node in ast.walk(stmt):
+            if isinstance(node, (ast.Name, ast.Attribute)):
+                token = flow.class_token(fn, node)
+                if token != UNKNOWN:
+                    out.add(token)
+    return out
+
+
+def _mentions_is_transient(expr) -> bool:
+    for node in ast.walk(expr):
+        if isinstance(node, ast.Name) and node.id == "is_transient":
+            return True
+        if isinstance(node, ast.Attribute) and node.attr == "is_transient":
+            return True
+    return False
+
+
+def _root_tokens(flow, tokens: List[str]) -> List[str]:
+    """Drop tokens whose ancestor is also in the list (reporting
+    StateFileError subsumes StateFileMissing — one finding per family)."""
+    return [
+        t
+        for t in tokens
+        if not any(o != t and flow.is_subclass(t, o) for o in tokens)
+    ]
+
+
+@rule(
+    "retry-contract-drift",
+    "exception class reaches a retry boundary that is_transient never "
+    "classified",
+    scope="program",
+)
+def retry_contract_drift(model: ProgramModel) -> Iterator[Finding]:
+    # A call_with_backoff boundary whose retryable predicate rides on
+    # retry.is_transient retries what the predicate blesses and treats
+    # EVERYTHING else as fatal-by-default.  An exception class that can
+    # provably reach the boundary but that is_transient's body never
+    # names (neither in a transient arm nor a fatal one) is a silent
+    # non-retry: nobody ever decided it should kill the attempt chain.
+    flow = flow_for(model)
+    graph = graph_for(model)
+    retry_mod = model.by_path.get(_RETRY_PATH)
+    if retry_mod is None:
+        return
+    cwb = retry_mod.functions.get("call_with_backoff")
+    is_transient = retry_mod.functions.get("is_transient")
+    if cwb is None or is_transient is None:
+        return
+    classified = _classified_tokens(flow, is_transient)
+    if not classified:
+        return
+    for site in model.all_call_sites():
+        res = graph.resolve(site)
+        if res is None or res[0] != "func" or res[1] is not cwb:
+            continue
+        node = site.node
+        retryable = next(
+            (kw.value for kw in node.keywords if kw.arg == "retryable"),
+            None,
+        )
+        if retryable is None or not _mentions_is_transient(retryable):
+            continue  # no predicate, or a custom one: no is_transient
+            # contract to hold the boundary against
+        thunk_expr = node.args[0] if node.args else next(
+            (kw.value for kw in node.keywords if kw.arg == "fn"), None
+        )
+        if thunk_expr is None:
+            continue
+        tokens, origins = flow.thunk_escapes(site, thunk_expr)
+        unclassified = [
+            t
+            for t in _sorted_named(tokens)
+            if not any(flow.is_subclass(t, c) for c in classified)
+        ]
+        for token in _root_tokens(flow, unclassified):
+            origin = origins.get(token)
+            chain = (
+                flow.escape_chain(origin, token)
+                if origin is not None
+                else []
+            )
+            full = [
+                (site.func.ref, site.func.module.rel_path, site.lineno)
+            ] + chain
+            yield Finding(
+                "retry-contract-drift",
+                site.func.module.rel_path,
+                site.lineno,
+                f"exception class '{display_name(token)}' can reach the "
+                f"retry boundary in '{site.func.qualname}' but "
+                "retry.is_transient neither classifies it transient nor "
+                "names it fatal — today that is a silent non-retry "
+                f"(chain: {chain_names(full)})",
+                chain=chain_evidence(full),
+            )
+
+
+# -- task-exception-blackhole --------------------------------------------------
+
+
+def _call_arg_parents(tree) -> Dict[int, ast.Call]:
+    """id(call node) -> the call expression it sits inside as an
+    ARGUMENT (transitively: through genexps, list comps, starred args)."""
+    parents: Dict[int, ast.Call] = {}
+
+    def walk(node, current: Optional[ast.Call]) -> None:
+        if isinstance(node, ast.Call):
+            if current is not None:
+                parents[id(node)] = current
+            walk(node.func, current)
+            for a in list(node.args) + [kw.value for kw in node.keywords]:
+                walk(a, node)
+            return
+        for child in ast.iter_child_nodes(node):
+            walk(child, current)
+
+    walk(tree, None)
+    return parents
+
+
+def _consumed_refs(tree) -> Tuple[Set[str], Set[str]]:
+    """(names, attribute names) that appear anywhere under an ``await``
+    expression or inside a ``gather``/``wait``/``wait_for``/``shield``
+    call — a task handle reaching one of those has a consumer."""
+    names: Set[str] = set()
+    attrs: Set[str] = set()
+
+    def collect(node) -> None:
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Name):
+                names.add(sub.id)
+            elif isinstance(sub, ast.Attribute):
+                attrs.add(sub.attr)
+
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Await):
+            collect(node.value)
+        elif isinstance(node, ast.Call) and isinstance(
+            node.func, (ast.Name, ast.Attribute)
+        ):
+            callee = (
+                node.func.id
+                if isinstance(node.func, ast.Name)
+                else node.func.attr
+            )
+            if callee in ("gather", "wait", "wait_for", "shield", "result",
+                          "exception"):
+                collect(node)
+    return names, attrs
+
+
+def _assign_target_refs(tree) -> Dict[int, Tuple[Set[str], Set[str]]]:
+    """id(value node) -> (target names, target attribute names) for
+    every assignment — plain, annotated (``self._task: asyncio.Task =
+    ...``), and walrus — so a spawn whose handle is stored can be
+    checked against the module's consumed refs."""
+    out: Dict[int, Tuple[Set[str], Set[str]]] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign):
+            targets, value = node.targets, node.value
+        elif isinstance(node, ast.AnnAssign) and node.value is not None:
+            targets, value = [node.target], node.value
+        elif isinstance(node, ast.NamedExpr):
+            targets, value = [node.target], node.value
+        else:
+            continue
+        names: Set[str] = set()
+        attrs: Set[str] = set()
+        for t in targets:
+            for sub in ast.walk(t):
+                if isinstance(sub, ast.Name):
+                    names.add(sub.id)
+                elif isinstance(sub, ast.Attribute):
+                    attrs.add(sub.attr)
+        out[id(value)] = (names, attrs)
+    return out
+
+
+@rule(
+    "task-exception-blackhole",
+    "exception escapes a fire-and-forget task root or event handler "
+    "with no consumer",
+    scope="program",
+)
+def task_exception_blackhole(model: ProgramModel) -> Iterator[Finding]:
+    # A tracked fire-and-forget task (`spawn_owned`, `create_task` into
+    # a registry set) has an owner but no CONSUMER: nothing ever awaits
+    # it, so an exception ending the coroutine is retrieved by nobody
+    # and vanishes into the loop's default 'Task exception was never
+    # retrieved' handler — the error contract equivalent of a dropped
+    # task.  Long-lived roots must catch-and-report inside the loop.
+    # Event-handler entry points get the narrower check: a CONTRACT
+    # class escaping a listener dies in the emitter's generic
+    # log.exception instead of the recovery path built for it.
+    flow = flow_for(model)
+    graph = graph_for(model)
+    contract = _contract_tokens(flow)
+    by_module = _functions_by_module(model)
+    for mod in model.modules.values():
+        if not mod.rel_path.startswith(PACKAGE_PREFIX):
+            continue
+        parents = _call_arg_parents(mod.ctx.tree)
+        consumed_names, consumed_attrs = _consumed_refs(mod.ctx.tree)
+        assigns = _assign_target_refs(mod.ctx.tree)
+        for func in by_module.get(mod, ()):
+            for site in func.calls:
+                res = graph.resolve(site)
+                if res is None or res[0] != "func" or not res[1].is_async:
+                    continue
+                if site.awaited:
+                    continue
+                outer = parents.get(id(site.node))
+                if outer is None:
+                    continue  # bare/assigned coroutine: dropped-task /
+                    # unawaited-coroutine territory
+                if getattr(outer, "_chk_awaited", False):
+                    continue  # gather()-style: consumed
+                if not _is_spawner(outer, mod.ctx.cm_bound_names):
+                    continue  # only a real spawn makes a task root: a
+                    # coroutine handed to append()/run()/anything else
+                    # is consumed (or flagged) elsewhere, and a
+                    # TaskGroup-style cm-bound receiver re-raises at
+                    # block exit
+                targets = assigns.get(id(outer))
+                if targets is not None and (
+                    targets[0] & consumed_names or targets[1] & consumed_attrs
+                ):
+                    continue  # the stored handle is awaited somewhere
+                escaping = _sorted_named(flow.escapes(res[1]))
+                if not escaping:
+                    continue
+                roots = _root_tokens(flow, escaping)
+                classes = ", ".join(
+                    f"'{display_name(t)}'" for t in roots
+                )
+                chain = flow.escape_chain(res[1], roots[0])
+                full = [(func.ref, mod.rel_path, site.lineno)] + chain
+                yield Finding(
+                    "task-exception-blackhole",
+                    mod.rel_path,
+                    site.lineno,
+                    f"exception class(es) {classes} escape fire-and-forget "
+                    f"task '{res[1].qualname}' and no consumer ever awaits "
+                    "it — the error vanishes into the event loop's default "
+                    f"handler (chain: {chain_names(full)})",
+                    chain=chain_evidence(full),
+                )
+        # event-handler entry points: .on/.once registrations
+        for func in by_module.get(mod, ()):
+            for site in func.calls:
+                if site.shape[0] != "dotted" or site.shape[2][-1] not in (
+                    "on", "once",
+                ):
+                    continue
+                node = site.node
+                if len(node.args) < 2:
+                    continue
+                first_arg = node.args[0]
+                if not (
+                    isinstance(first_arg, ast.Constant)
+                    and isinstance(first_arg.value, str)
+                ):
+                    continue  # dynamic event: not modeled
+                handler = flow.resolve_callable_ref(site, node.args[1])
+                if handler is None:
+                    continue  # lambda/unresolvable listener: unmodeled
+                leaked = [
+                    t
+                    for t in _sorted_named(flow.escapes(handler))
+                    if any(flow.is_subclass(t, c) for c in contract)
+                ]
+                if not leaked:
+                    continue
+                chain = flow.escape_chain(handler, leaked[0])
+                full = [(func.ref, mod.rel_path, site.lineno)] + chain
+                classes = ", ".join(
+                    f"'{display_name(t)}'" for t in leaked
+                )
+                yield Finding(
+                    "task-exception-blackhole",
+                    mod.rel_path,
+                    site.lineno,
+                    f"contract class(es) {classes} escape the "
+                    f"'{first_arg.value}' event handler "
+                    f"'{handler.qualname}' — the structured recovery "
+                    "signal dies in the emitter's generic exception log "
+                    f"(chain: {chain_names(full)})",
+                    chain=chain_evidence(full),
+                )
+
+
+#: outer calls that SPAWN a fire-and-forget task (docs/CHECKS.md: the
+#: rule's scope is create_task/spawn_owned handles — a coroutine handed
+#: to anything else is consumed by it or flagged by other rules).
+#: ``_track`` is the agent's spawn_owned wrapper.
+_SPAWNERS = frozenset(
+    {"create_task", "ensure_future", "spawn_owned", "_track"}
+)
+
+
+def _is_spawner(call: ast.Call, cm_bound_names) -> bool:
+    node = call.func
+    if isinstance(node, ast.Attribute):
+        if node.attr not in _SPAWNERS:
+            return False
+        # `async with TaskGroup() as tg: tg.create_task(...)` — the
+        # context manager awaits (and re-raises) its tasks at block
+        # exit; a cm-bound receiver is not a blackhole
+        base = node.value
+        if isinstance(base, ast.Name) and base.id in cm_bound_names:
+            return False
+        return True
+    if isinstance(node, ast.Name):
+        return node.id in _SPAWNERS
+    return False
+
+
+def _functions_by_module(model: ProgramModel) -> Dict[object, List]:
+    """ModuleInfo -> its functions, grouped from the ONE model walk
+    (``ProgramModel.functions()``) so the rules can never analyze a
+    different function set than the escape fixpoint ran over."""
+    out: Dict[object, List] = {}
+    for f in model.functions():
+        out.setdefault(f.module, []).append(f)
+    return out
+
+
+# -- overbroad-handler ---------------------------------------------------------
+
+
+@rule(
+    "overbroad-handler",
+    "except Exception swallows a contract class a caller handles "
+    "explicitly",
+    scope="program",
+)
+def overbroad_handler(model: ProgramModel) -> Iterator[Finding]:
+    # `except Exception` around a body that provably raises
+    # SessionExpiredError / OwnershipError / OperationTimeoutError /
+    # StateFileError swallows a class with documented per-shape
+    # handling.  It is only a bug when somebody upstream CARES: the
+    # finding fires when a caller on an incoming chain handles that
+    # class explicitly — evidence that the broad handler starves a
+    # narrow one that was built for the signal.  The incoming chain
+    # rides as structured evidence, like transitive-blocking-call.
+    flow = flow_for(model)
+    graph = graph_for(model)
+    contract = _contract_tokens(flow)
+    if not contract:
+        return
+    by_module = _functions_by_module(model)
+    for mod in model.modules.values():
+        if not mod.rel_path.startswith(PACKAGE_PREFIX):
+            continue
+        for func in by_module.get(mod, ()):
+            if func.node is None:
+                continue
+            for stmt in _function_statements(func.node):
+                if not isinstance(stmt, ast.Try):
+                    continue
+                if not any(
+                    _is_broad(flow.handler_tokens(func, h.type))
+                    for h in stmt.handlers
+                ):
+                    continue
+                # Clause ORDER matters: a narrow clause ahead of the
+                # broad one receives the class first — the canonical
+                # narrow-then-broad defensive pattern is not a swallow.
+                remaining = set(flow.block_escapes(func, stmt.body))
+                for handler in stmt.handlers:
+                    tokens = flow.handler_tokens(func, handler.type)
+                    caught_here = {
+                        t
+                        for t in remaining
+                        if flow.caught_by(t, tokens)
+                    }
+                    remaining -= caught_here
+                    if not _is_broad(tokens):
+                        continue  # bare except is swallowed-cancel's beat
+                    if any(
+                        isinstance(n, ast.Raise)
+                        for n in ast.walk(handler)
+                    ):
+                        continue  # may re-throw: not a swallow
+                    caught = [
+                        t
+                        for t in _sorted_named(caught_here)
+                        if any(flow.is_subclass(t, c) for c in contract)
+                    ]
+                    for token in _root_tokens(flow, caught):
+                        upstream = _explicit_upstream_handler(
+                            flow, graph, func, token
+                        )
+                        if upstream is None:
+                            continue
+                        chain_funcs, catcher, handler_line = upstream
+                        hops = [
+                            (g.ref, g.module.rel_path, g.lineno)
+                            for g in chain_funcs
+                        ] + [
+                            (
+                                f"except {display_name(token)}",
+                                catcher.module.rel_path,
+                                handler_line,
+                            )
+                        ]
+                        yield Finding(
+                            "overbroad-handler",
+                            mod.rel_path,
+                            handler.lineno,
+                            f"'except {'/'.join(sorted(tokens))}' in "
+                            f"'{func.qualname}' swallows contract class "
+                            f"'{display_name(token)}', which caller "
+                            f"'{catcher.qualname}' handles explicitly "
+                            f"(chain: {chain_names(hops)})",
+                            chain=chain_evidence(hops),
+                        )
+
+
+def _is_broad(tokens) -> bool:
+    """A literal ``except Exception`` / ``except BaseException`` clause
+    (bare ``except:`` is None — swallowed-cancel's beat, not ours)."""
+    return tokens is not None and tokens <= {"Exception", "BaseException"}
+
+
+def _function_statements(fn_node):
+    """Every statement lexically inside ``fn_node``'s own body (nested
+    defs excluded — their handlers are their own)."""
+    stack = list(fn_node.body)
+    while stack:
+        stmt = stack.pop()
+        yield stmt
+        for field in ("body", "orelse", "finalbody"):
+            sub = getattr(stmt, field, None)
+            if isinstance(sub, list):
+                stack.extend(
+                    s
+                    for s in sub
+                    if isinstance(s, ast.stmt)
+                    and not isinstance(
+                        s, (ast.FunctionDef, ast.AsyncFunctionDef)
+                    )
+                )
+        for h in getattr(stmt, "handlers", []):
+            stack.extend(
+                s
+                for s in h.body
+                if not isinstance(s, (ast.FunctionDef, ast.AsyncFunctionDef))
+            )
+
+
+def _explicit_upstream_handler(flow, graph, func, token):
+    """BFS up the caller graph from ``func``: the nearest ancestor with
+    an ``except`` clause explicitly naming ``token`` (or a non-catch-all
+    ancestor class of it).  Returns ``([chain top..func], catcher)``."""
+    seen = {func}
+    queue = [(func, [func])]
+    depth = 0
+    while queue and depth < 8:
+        next_queue = []
+        for current, path in queue:
+            for site in graph.callers.get(current, ()):
+                caller = site.func
+                if caller in seen or caller.node is None:
+                    continue
+                if not caller.module.rel_path.startswith(PACKAGE_PREFIX):
+                    continue  # a TEST catching the class is test
+                    # plumbing, not evidence the daemon design wants it
+                seen.add(caller)
+                handler_line = _handles_explicitly(
+                    flow, caller, token, site.lineno
+                )
+                if handler_line is not None:
+                    return (
+                        list(reversed(path + [caller])),
+                        caller,
+                        handler_line,
+                    )
+                next_queue.append((caller, path + [caller]))
+        queue = next_queue
+        depth += 1
+    return None
+
+
+def _handles_explicitly(flow, func, token, call_lineno: int) -> Optional[int]:
+    """The line of an ``except`` clause in ``func`` naming ``token``
+    whose try BODY encloses the call site at ``call_lineno`` — or None.
+    A narrow handler elsewhere in the function could never receive the
+    exception flowing through this call, so it does not count (and the
+    returned line anchors the evidence hop at the clause itself)."""
+    for stmt in _function_statements(func.node):
+        if not isinstance(stmt, ast.Try) or not stmt.body:
+            continue
+        body_start = stmt.body[0].lineno
+        body_end = getattr(
+            stmt.body[-1], "end_lineno", None
+        ) or stmt.body[-1].lineno
+        if not (body_start <= call_lineno <= body_end):
+            continue
+        for handler in stmt.handlers:
+            tokens = flow.handler_tokens(func, handler.type)
+            if tokens is None:
+                continue
+            named = {
+                t
+                for t in tokens
+                if t not in (UNKNOWN, "Exception", "BaseException")
+            }
+            if any(flow.is_subclass(token, t) for t in named):
+                return handler.lineno
+    return None
+
+
+# -- fault-matrix-drift --------------------------------------------------------
+
+_ERROR_NAME = re.compile(r"\b([A-Z][A-Za-z0-9]*Error)\b")
+
+
+def _doc_error_names(path: str) -> Optional[Dict[str, int]]:
+    """Exception-class names a doc mentions -> first line; None when the
+    doc is absent (the rule then skips that leg)."""
+    lines = read_doc_lines(path)
+    if lines is None:
+        return None
+    out: Dict[str, int] = {}
+    for i, line in enumerate(lines, start=1):
+        for m in _ERROR_NAME.finditer(line):
+            out.setdefault(m.group(1), i)
+    return out
+
+
+@rule(
+    "fault-matrix-drift",
+    "docs/FAULTS.md + docs/OPERATIONS.md fault matrix drifts from the "
+    "provable escape surface",
+    scope="program",
+)
+def fault_matrix_drift(model: ProgramModel) -> Iterator[Finding]:
+    # The operator-facing fault matrix names exception classes; the
+    # escape analysis knows which classes actually exist and provably
+    # flow.  One finding per drift direction:
+    #   * doc -> code: a documented class that no longer exists, or that
+    #     nothing in the program raises anymore (a rename leaves the old
+    #     name in the runbook — operators grep for ghosts);
+    #   * code -> doc: a package-defined *Error class that provably
+    #     escapes ACROSS a module boundary (it is part of the
+    #     inter-module error contract) but that neither doc names.
+    flow = flow_for(model)
+    root = model.package_root()
+    if root is None:
+        return
+    docs = {
+        rel: _doc_error_names(os.path.join(root, *rel.split("/")))
+        for rel in (FAULTS_DOC, OPS_DOC)
+    }
+    if all(names is None for names in docs.values()):
+        return  # tree ships no fault docs: nothing to hold it against
+
+    raised = flow.raised_tokens() | flow.constructed_tokens()
+    escaping: Set[str] = set()
+    cross_module: Dict[str, Tuple[str, str]] = {}
+    for func in model.functions():
+        for token in flow.named_escapes(func):
+            escaping.add(token)
+            if ":" not in token:
+                continue
+            if not func.module.rel_path.startswith(PACKAGE_PREFIX):
+                continue  # escaping a TEST helper is not a shipped
+                # contract surface
+            def_module = token.rsplit(":", 1)[0]
+            if def_module != func.module.name and token not in cross_module:
+                cross_module[token] = (func.ref, func.module.rel_path)
+
+    known_names = set(flow.classes_by_name)
+    mentioned: Set[str] = set()
+    for names in docs.values():
+        if names:
+            mentioned.update(names)
+
+    # doc -> code
+    for rel, names in sorted(docs.items()):
+        if names is None:
+            continue
+        for name, lineno in sorted(names.items()):
+            tokens = flow.classes_by_name.get(name, [])
+            if name not in known_names and name not in BUILTIN_DOC_EXEMPT:
+                yield Finding(
+                    "fault-matrix-drift",
+                    rel,
+                    lineno,
+                    f"fault matrix names exception class '{name}' but no "
+                    "such class exists in the program (renamed or "
+                    "removed?)",
+                )
+                continue
+            if tokens and not any(
+                t in raised or t in escaping
+                or any(flow.is_subclass(r, t) for r in raised)
+                for t in tokens
+            ):
+                yield Finding(
+                    "fault-matrix-drift",
+                    rel,
+                    lineno,
+                    f"fault matrix names exception class '{name}' but "
+                    "nothing in the program raises or constructs it "
+                    "anymore (stale matrix row?)",
+                )
+
+    # code -> doc
+    for token in sorted(cross_module):
+        name = display_name(token)
+        if not name.endswith("Error") or name in mentioned:
+            continue
+        def_module = token.rsplit(":", 1)[0]
+        mod = model.modules.get(def_module)
+        if mod is None or not mod.rel_path.startswith(PACKAGE_PREFIX):
+            continue
+        boundary_ref, _ = cross_module[token]
+        yield Finding(
+            "fault-matrix-drift",
+            mod.rel_path,
+            0,
+            f"exception class '{name}' escapes across module boundaries "
+            f"(e.g. out of '{boundary_ref}') but neither {FAULTS_DOC} nor "
+            f"{OPS_DOC} names it in the fault matrix",
+        )
+
+
+#: Classes docs legitimately mention without the program defining them
+#: (the doc->code existence leg exempts them; prose about ValueError /
+#: BrokenPipeError is not matrix drift).  Derived from the analysis's
+#: own builtin-hierarchy and ext-alias tables so a runbook may name ANY
+#: builtin the analysis itself knows — a second hand-curated list would
+#: drift behind the first.
+BUILTIN_DOC_EXEMPT = frozenset(
+    set(BUILTIN_PARENTS)
+    | set(BUILTIN_PARENTS.values())
+    | {"BaseException", "CancelledError"}
+    | {k.rsplit(".", 1)[-1] for k in EXT_ALIASES}
+)
