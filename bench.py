@@ -37,6 +37,8 @@ import time
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
 from registrar_tpu import binderview  # noqa: E402
+from registrar_tpu import metrics as metrics_mod  # noqa: E402
+from registrar_tpu import trace as trace_mod  # noqa: E402
 from registrar_tpu.records import (  # noqa: E402
     domain_to_path,
     host_record,
@@ -136,6 +138,64 @@ async def _cached_metrics(
                 f"SRV {cached_srv_ms:.4f} vs {live_srv_ms:.4f} ms)"
             )
 
+        # ---- ISSUE 8: the same hot path under 100% tracing ------------
+        # Acceptance bound: with spans on at sample_rate=1.0 feeding the
+        # registrar_resolve_seconds histogram, a warm cached resolve may
+        # cost at most 10% over the untraced path (BENCH_TRACE_OVERHEAD_PCT
+        # to widen on noisy boxes).  Sub-100µs medians are noise-prone, so
+        # each attempt re-measures the untraced base back-to-back with the
+        # traced pass and the verdict is the best of 3 attempts — a real
+        # per-resolve tracing cost shows up in every attempt, a scheduler
+        # blip does not.
+        tracer = trace_mod.Tracer(sample_rate=1.0)
+        treg = metrics_mod.instrument_tracing(tracer)
+        limit_pct = float(os.environ.get("BENCH_TRACE_OVERHEAD_PCT", "10"))
+        overhead_pct = traced_a_ms = traced_srv_ms = None
+        for _attempt in range(3):
+            base_a = await med_burst(FLEET_DOMAIN, "A")
+            base_srv = await med_burst(srv_name, "SRV")
+            cache.tracer = tracer
+            try:
+                t_a = await med_burst(FLEET_DOMAIN, "A")
+                t_srv = await med_burst(srv_name, "SRV")
+            finally:
+                cache.tracer = None
+            attempt_pct = (
+                max(t_a / base_a, t_srv / base_srv) - 1.0
+            ) * 100.0
+            if overhead_pct is None or attempt_pct < overhead_pct:
+                overhead_pct = attempt_pct
+                traced_a_ms, traced_srv_ms = t_a, t_srv
+            if overhead_pct <= limit_pct:
+                break
+        if overhead_pct > limit_pct:
+            raise RuntimeError(
+                "tracing overhead on the warm cached resolve exceeds "
+                f"{limit_pct}%: best attempt {overhead_pct:.1f}% "
+                f"(traced A {traced_a_ms:.4f} ms, SRV {traced_srv_ms:.4f} ms)"
+            )
+        hist = treg.get("registrar_resolve_seconds")
+        if not hist.count({"source": "cached"}):
+            raise RuntimeError(
+                "traced bench recorded no cached resolve spans — the "
+                "timed path was not the instrumented hot path"
+            )
+        if hist.count({"source": "live"}):
+            raise RuntimeError(
+                "traced bench recorded live-labeled resolves — the cache "
+                "degraded mid-measurement"
+            )
+        # The p50/p95/p99 a production scrape would compute from the new
+        # histogram (bucket-interpolated, like histogram_quantile()) —
+        # recorded into the bench round so the distribution, not just the
+        # burst median, is regression-gated.
+        hist_quantiles = {
+            f"resolve_cached_hist_p{int(q * 100)}_ms": round(
+                hist.quantile(q, {"source": "cached"}) * 1000.0, 4
+            )
+            for q in (0.50, 0.95, 0.99)
+        }
+
         # Sustained throughput, mixed A+SRV (the cached-QPS headline);
         # median of bursts for the same noise-rejection reason.
         qps_rounds = []
@@ -181,6 +241,10 @@ async def _cached_metrics(
             "resolve_srv_cached_ms_50_instances": round(cached_srv_ms, 4),
             "cached_resolve_qps_50_instances": round(qps, 1),
             "cache_coherence_lag_ms": round(coherence_ms, 3),
+            "resolve_a_cached_traced_ms": round(traced_a_ms, 4),
+            "resolve_srv_cached_traced_ms": round(traced_srv_ms, 4),
+            "trace_overhead_pct": round(overhead_pct, 2),
+            **hist_quantiles,
         }
     finally:
         cache.close()

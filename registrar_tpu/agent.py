@@ -65,6 +65,7 @@ import logging
 from typing import Any, List, Mapping, Optional, Sequence
 
 from registrar_tpu import registration as register_mod
+from registrar_tpu import trace
 from registrar_tpu.events import EventEmitter, spawn_owned
 from registrar_tpu.health import HealthCheck, create_health_check
 from registrar_tpu.registration import SETTLE_DELAY_S
@@ -621,30 +622,39 @@ async def _reregister_guarded(
         expect_epoch = ee.epoch
     if ee.down or ee.stopped:
         return False
-    async with lock:
-        if ee.down or ee.stopped:
-            return False
-        if ee.epoch != expect_epoch:
-            log.debug(
-                "re-registration skipped: epoch moved %d -> %d while "
-                "waiting (another recovery path already repaired)",
-                expect_epoch, ee.epoch,
-            )
+    # The span covers the lock wait AND the pipeline run: its children
+    # (register.pipeline, the zk.op spans) show where the time went, and
+    # the lock-wait gap is the span's own duration minus theirs.
+    with trace.tracer_for(zk).span(
+        "agent.repair", expect_epoch=expect_epoch
+    ) as sp:
+        async with lock:
+            if ee.down or ee.stopped:
+                return False
+            if ee.epoch != expect_epoch:
+                log.debug(
+                    "re-registration skipped: epoch moved %d -> %d while "
+                    "waiting (another recovery path already repaired)",
+                    expect_epoch, ee.epoch,
+                )
+                sp.set_attr("outcome", "stale-epoch")
+                return True
+            new_znodes = await do_register()
+            if ee.down or ee.stopped:
+                log.debug("re-registration rolled back (health down/stopped)")
+                sp.set_attr("outcome", "rolled-back")
+                try:
+                    await register_mod.unregister(zk, new_znodes)
+                except Exception as u_err:  # noqa: BLE001
+                    ee.emit("error", u_err)
+                return False
+            ee.znodes = new_znodes
+            ee.epoch += 1
+            ee._applied_desired = None  # pipeline wrote the params' records
+            log.debug("re-registered %s (epoch %d)", ee.znodes, ee.epoch)
+            sp.set_attr("outcome", "registered")
+            ee.emit("register", new_znodes)
             return True
-        new_znodes = await do_register()
-        if ee.down or ee.stopped:
-            log.debug("re-registration rolled back (health down/stopped)")
-            try:
-                await register_mod.unregister(zk, new_znodes)
-            except Exception as u_err:  # noqa: BLE001
-                ee.emit("error", u_err)
-            return False
-        ee.znodes = new_znodes
-        ee.epoch += 1
-        ee._applied_desired = None  # pipeline wrote the params' records
-        log.debug("re-registered %s (epoch %d)", ee.znodes, ee.epoch)
-        ee.emit("register", new_znodes)
-        return True
 
 
 async def _heartbeat_loop(

@@ -37,6 +37,7 @@ from dataclasses import dataclass, field
 from functools import lru_cache
 from typing import Any, Dict, List, Optional
 
+from registrar_tpu import trace
 from registrar_tpu.records import (
     HOST_RECORD_TYPES,
     domain_to_path,
@@ -254,8 +255,19 @@ async def resolve(src, name: str, qtype: str = "A") -> Resolution:
     :class:`~registrar_tpu.zkcache.ZKCache` for the in-memory hot path.
     """
     qtype = qtype.upper()
-    if qtype == "A":
-        return await resolve_a(src, name)
-    if qtype == "SRV":
+    if qtype not in ("A", "SRV"):
+        raise ValueError(f"unsupported query type: {qtype}")
+    # source: "cached" only while a ZKCache is actually serving from
+    # memory (a degraded cache falls through to live reads and is
+    # honestly labeled "live"); a plain ZKClient has no `authoritative`
+    # and always reads live.
+    with trace.tracer_for(src).span(
+        "resolve.query",
+        qtype=qtype,
+        source=(
+            "cached" if getattr(src, "authoritative", False) else "live"
+        ),
+    ):
+        if qtype == "A":
+            return await resolve_a(src, name)
         return await resolve_srv(src, name)
-    raise ValueError(f"unsupported query type: {qtype}")

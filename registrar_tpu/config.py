@@ -136,6 +136,25 @@ class RestartConfig:
 
 
 @dataclass
+class ObservabilityConfig:
+    """The ``observability`` block (ISSUE 8): operation tracing.
+
+    Presence of the block turns tracing ON (spans, the flight recorder,
+    latency histograms, trace-correlated log records, the SIGUSR2 dump).
+    Absent block = tracing off, byte-identical log/metric output to the
+    untraced daemon — reference parity exactly preserved."""
+
+    sample_rate: float = 1.0
+    #: slow-span warn threshold, ms (None = never warn).  The default
+    #: sits above the registration pipeline's mandated 1 s settle floor
+    #: so a healthy registration does not warn on every run.
+    slow_span_ms: Optional[float] = 1500.0
+    flight_recorder_spans: int = 1024
+    #: SIGUSR2 dump target (None = pid-suffixed file in the temp dir)
+    dump_path: Optional[str] = None
+
+
+@dataclass
 class ReconcileConfig:
     """The ``reconcile`` block: the level-triggered registration
     reconciler (ISSUE 3, :mod:`registrar_tpu.reconcile`).  NOTE the unit
@@ -155,7 +174,7 @@ KNOWN_TOP_LEVEL_KEYS = frozenset(
         "adminIp", "zookeeper", "registration", "healthCheck", "logLevel",
         "maxAttempts", "repairHeartbeatMiss", "metrics",
         "surviveSessionExpiry", "maxSessionRebirths", "reconcile", "cache",
-        "restart",
+        "restart", "observability",
     }
 )
 
@@ -183,6 +202,9 @@ class Config:
     #: opt-in zero-downtime restart behavior (ISSUE 5; None = today's
     #: graceful stop: close the session, ephemerals deleted at once)
     restart: Optional[RestartConfig] = None
+    #: opt-in operation tracing (ISSUE 8; None = no spans, no flight
+    #: recorder, no trace-correlated log fields — reference parity)
+    observability: Optional[ObservabilityConfig] = None
     #: unrecognized top-level keys (ignored, like the reference — but
     #: surfaced so the daemon can warn about probable typos)
     unknown_keys: Tuple[str, ...] = ()
@@ -422,6 +444,56 @@ def parse_config(raw: Mapping[str, Any]) -> Config:
             state_file=state_file, mode=mode, drain_grace_s=float(grace)
         )
 
+    observability = None
+    obs_raw = raw.get("observability")
+    if obs_raw is not None:
+        if not isinstance(obs_raw, Mapping):
+            raise ConfigError("config.observability must be an object")
+        sample_rate = obs_raw.get("sampleRate", 1.0)
+        if (
+            not isinstance(sample_rate, (int, float))
+            or isinstance(sample_rate, bool)
+            or not math.isfinite(sample_rate)
+            or not 0.0 <= sample_rate <= 1.0
+        ):
+            raise ConfigError(
+                "config.observability.sampleRate must be a number in [0, 1]"
+            )
+        slow_span = obs_raw.get("slowSpanMs", 1500)
+        if slow_span is not None and (
+            not isinstance(slow_span, (int, float))
+            or isinstance(slow_span, bool)
+            or not math.isfinite(slow_span)
+            or slow_span <= 0
+        ):
+            raise ConfigError(
+                "config.observability.slowSpanMs must be a positive number "
+                "(ms) or null to disable slow-span warnings"
+            )
+        recorder_spans = obs_raw.get("flightRecorderSpans", 1024)
+        if (
+            not isinstance(recorder_spans, int)
+            or isinstance(recorder_spans, bool)
+            or recorder_spans < 1
+        ):
+            raise ConfigError(
+                "config.observability.flightRecorderSpans must be a "
+                "positive integer"
+            )
+        dump_path = obs_raw.get("dumpPath")
+        if dump_path is not None and (
+            not isinstance(dump_path, str) or not dump_path
+        ):
+            raise ConfigError(
+                "config.observability.dumpPath must be a non-empty path"
+            )
+        observability = ObservabilityConfig(
+            sample_rate=float(sample_rate),
+            slow_span_ms=float(slow_span) if slow_span is not None else None,
+            flight_recorder_spans=recorder_spans,
+            dump_path=dump_path,
+        )
+
     metrics = None
     metrics_raw = raw.get("metrics")
     if metrics_raw is not None:
@@ -454,6 +526,7 @@ def parse_config(raw: Mapping[str, Any]) -> Config:
         reconcile=reconcile,
         cache=cache,
         restart=restart,
+        observability=observability,
         unknown_keys=tuple(
             sorted(set(raw) - KNOWN_TOP_LEVEL_KEYS)
         ),

@@ -41,6 +41,7 @@ import signal
 import time
 from typing import Any, Dict, List, Mapping, Optional
 
+from registrar_tpu import trace
 from registrar_tpu.events import EventEmitter
 
 log = logging.getLogger("registrar_tpu.health")
@@ -130,6 +131,8 @@ class HealthCheck(EventEmitter):
         self._down = False
         self._task: Optional[asyncio.Task] = None
         self._running = False
+        #: per-instance tracer override (ISSUE 8); None = process default
+        self.tracer = None
 
     @property
     def is_down(self) -> bool:
@@ -189,7 +192,12 @@ class HealthCheck(EventEmitter):
 
     async def check_once(self) -> Dict[str, Any]:
         """Run one check and emit its ``data`` record (also returned)."""
-        err = await self._run_command()
+        with trace.tracer_for(self).span(
+            "health.exec", command=self.command
+        ) as sp:
+            err = await self._run_command()
+            if err is not None:
+                sp.set_attr("failed", str(err))
         if err is None:
             record = self._mark_ok()
         else:

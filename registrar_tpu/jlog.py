@@ -89,6 +89,14 @@ class BunyanFormatter(logging.Formatter):
                 "line": record.lineno,
                 "func": record.funcName,
             }
+        # Trace correlation (ISSUE 8): the TraceContextFilter (installed
+        # only when the `observability` block enables tracing) stamps
+        # these attributes; without it nothing is set and the output is
+        # byte-identical to untraced builds.
+        trace_id = getattr(record, "trace_id", None)
+        if trace_id is not None:
+            rec["trace_id"] = trace_id
+            rec["span_id"] = getattr(record, "span_id", None)
         zdata = getattr(record, "zdata", None)
         if isinstance(zdata, Mapping):
             for key, value in zdata.items():

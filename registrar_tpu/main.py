@@ -65,6 +65,7 @@ import time
 from registrar_tpu import __version__
 from registrar_tpu import jlog
 from registrar_tpu import statefile
+from registrar_tpu import trace as trace_mod
 from registrar_tpu.events import spawn_owned
 from registrar_tpu.agent import register_plus
 from registrar_tpu.config import (
@@ -295,6 +296,41 @@ async def run(cfg: Config, *, _exit=sys.exit) -> None:
     """Connect, register, and serve events until stopped or expired."""
     log = logging.getLogger("registrar")
 
+    # -- operation tracing (ISSUE 8, opt-in `observability` block) ----------
+    # Installed FIRST so the initial connect/registration is traced too.
+    # Absent block: the module default stays trace.DISABLED and not a
+    # single span, log field, or metric series is added (parity pinned
+    # by tests/test_trace.py).
+    tracer = None
+    trace_filter = None
+    obs = cfg.observability
+    if obs is not None:
+        tracer = trace_mod.Tracer(
+            sample_rate=obs.sample_rate,
+            slow_span_ms=obs.slow_span_ms,
+            max_spans=obs.flight_recorder_spans,
+        )
+        trace_mod.set_tracer(tracer)
+        trace_filter = trace_mod.TraceContextFilter()
+        for handler in logging.getLogger().handlers:
+            handler.addFilter(trace_filter)
+        log.info(
+            "observability: tracing enabled",
+            extra={"zdata": {"sampleRate": obs.sample_rate,
+                             "slowSpanMs": obs.slow_span_ms,
+                             "flightRecorderSpans":
+                                 obs.flight_recorder_spans}},
+        )
+    try:
+        await _run_traced(cfg, log, tracer, _exit=_exit)
+    finally:
+        if obs is not None:
+            trace_mod.set_tracer(None)
+            for handler in logging.getLogger().handlers:
+                handler.removeFilter(trace_filter)
+
+
+async def _run_traced(cfg: Config, log, tracer, *, _exit=sys.exit) -> None:
     restart_cfg = cfg.restart
     fingerprint = (
         statefile.config_fingerprint(
@@ -563,15 +599,46 @@ async def run(cfg: Config, *, _exit=sys.exit) -> None:
                         write_statefile_bg()
             ee.emit("configReload", result)
 
+    # -- /status snapshot state (ISSUE 8) -----------------------------------
+    # The introspection endpoint's last-known view of the slow-moving
+    # bits: the client's state string and the reconciler's last summary
+    # are events, so a snapshot must remember them.
+    status_note = {"zk_state": "connected" if zk.connected else "disconnected",
+                   "last_reconcile": None, "started": time.time()}
+    zk.on("state", lambda s: status_note.__setitem__("zk_state", s))
+    ee.on(
+        "reconcile",
+        lambda summary: status_note.__setitem__(
+            "last_reconcile",
+            {"at": time.time(), **{k: summary.get(k)
+                                   for k in ("duration", "drift", "repaired")}},
+        ),
+    )
+
     metrics_server = None
     if cfg.metrics is not None:
-        from registrar_tpu.metrics import MetricsServer, instrument
+        from registrar_tpu.metrics import (
+            MetricsRegistry,
+            MetricsServer,
+            instrument,
+            instrument_tracing,
+        )
 
+        registry = MetricsRegistry()
+        if tracer is not None:
+            # BEFORE instrument(): the tracing histograms own the
+            # registrar_reconcile_sweep_seconds family when enabled.
+            instrument_tracing(tracer, registry)
+        instrument(ee, zk, registry)
         try:
             metrics_server = await MetricsServer(
-                instrument(ee, zk),
+                registry,
                 host=cfg.metrics.host,
                 port=cfg.metrics.port,
+                status_provider=lambda: _status_snapshot(
+                    cfg, zk, ee, status_note
+                ),
+                trace_provider=lambda n: trace_mod.get_tracer().dump(n),
             ).start()
         except OSError as err:
             # A busy/forbidden port must not take down registration —
@@ -628,6 +695,54 @@ async def run(cfg: Config, *, _exit=sys.exit) -> None:
     except (NotImplementedError, AttributeError):  # non-unix
         pass
 
+    # -- SIGUSR2: dump the flight recorder (ISSUE 8) ------------------------
+    dump_tasks: set = set()
+
+    def dump_flight_recorder() -> None:
+        tr = trace_mod.get_tracer()
+        if not tr.enabled:
+            log.warning(
+                "SIGUSR2: tracing is disabled (no `observability` config "
+                "block); nothing to dump"
+            )
+            return
+        # Snapshot on-loop (a bounded list copy + render, ms-scale);
+        # write in a worker thread.  SIGUSR2 arrives mid-incident, when
+        # a wedged filesystem at dumpPath is most likely — blocking the
+        # loop on that write could stall heartbeats past the session
+        # timeout and let the diagnostic itself take the host out of
+        # DNS (the statefile writer learned this in PR 5).
+        payload = tr.dump()
+        spans, events = tr.spans_recorded, tr.events_recorded
+        dump_path = (
+            cfg.observability.dump_path
+            if cfg.observability is not None
+            else None
+        )
+
+        async def _write() -> None:
+            try:
+                path = await asyncio.to_thread(
+                    trace_mod.write_dump, payload, dump_path
+                )
+            except OSError as err:
+                log.error("SIGUSR2: cannot write flight-recorder dump",
+                          extra={"zdata": {"err": repr(err)}})
+            else:
+                log.info(
+                    "SIGUSR2: flight recorder dumped",
+                    extra={"zdata": {"file": path,
+                                     "spans": spans,
+                                     "events": events}},
+                )
+
+        spawn_owned(_write(), dump_tasks)
+
+    try:
+        loop.add_signal_handler(signal.SIGUSR2, dump_flight_recorder)
+    except (NotImplementedError, AttributeError):  # non-unix
+        pass
+
     await stopping.wait()
     mode = restart_cfg.mode if restart_cfg is not None else None
     log.info(
@@ -680,8 +795,100 @@ async def run(cfg: Config, *, _exit=sys.exit) -> None:
         # Stopped LAST so the handoff/drain counters increment while the
         # endpoint still answers (a drain's grace period is scrapeable).
         await metrics_server.stop()
+    if dump_tasks:
+        # An in-flight SIGUSR2 dump finishes writing (it already holds
+        # its snapshot; losing it at exit is losing the evidence).
+        await asyncio.gather(*dump_tasks, return_exceptions=True)
     if exit_code:
         _exit(exit_code)
+
+
+async def _status_snapshot(cfg: Config, zk, ee, note: dict) -> dict:
+    """One ``GET /status`` introspection snapshot (ISSUE 8).
+
+    The runbook's first stop (docs/OPERATIONS.md "The first 5 minutes
+    of an incident"): session identity and state, registration epoch,
+    the owned znodes with their live mzxids, health/drift posture, and
+    the config fingerprint — enough to answer "is THIS instance the
+    problem" without reading a single log line.
+
+    The mzxid read-back is best-effort with a short deadline: /status
+    must keep answering while the ensemble is down (that is precisely
+    when operators hit it), so a failed sweep reports ``readError``
+    instead of hanging or erroring the endpoint.
+    """
+    znodes = list(ee.znodes)
+    mzxids: dict = {p: None for p in znodes}
+    read_error = None
+    if znodes and zk.connected:
+        try:
+            results = await asyncio.wait_for(zk.get_many(znodes), timeout=2.0)
+            for path, res in zip(znodes, results):
+                mzxids[path] = res[1].mzxid if res is not None else None
+        except asyncio.CancelledError:
+            raise
+        except Exception as err:  # noqa: BLE001 - status must still answer
+            read_error = repr(err)
+    elif znodes:
+        read_error = "session not connected"
+    tr = trace_mod.get_tracer()
+    health = getattr(ee, "_health", None)
+    return {
+        "name": "registrar",
+        "pid": os.getpid(),
+        "version": __version__,
+        "uptimeSeconds": round(time.time() - note["started"], 1),
+        "session": {
+            "id": f"0x{zk.session_id:x}",
+            "state": note["zk_state"],
+            "connected": zk.connected,
+            "closed": zk.closed,
+            "server": (
+                f"{zk.connected_server[0]}:{zk.connected_server[1]}"
+                if zk.connected_server
+                else None
+            ),
+            "negotiatedTimeoutMs": zk.negotiated_timeout_ms,
+            "rebirths": zk.rebirths,
+        },
+        "registration": {
+            "epoch": ee.epoch,
+            "registered": bool(znodes),
+            "znodes": [
+                {"path": p, "mzxid": mzxids[p]} for p in znodes
+            ],
+            "readError": read_error,
+        },
+        "health": {
+            "configured": health is not None,
+            "down": ee.down,
+            "checkerDown": bool(health.is_down) if health else False,
+        },
+        "reconcile": {
+            "configured": ee.reconciler is not None,
+            "lastSweep": note["last_reconcile"],
+            "driftSeen": (
+                ee.reconciler.drift_seen if ee.reconciler else None
+            ),
+            "ownerConflicts": (
+                ee.reconciler.owner_conflicts if ee.reconciler else None
+            ),
+        },
+        # The daemon never resolves; the cache block is for embedders
+        # (zkcli serve-view exposes the same shape via its status line).
+        "cache": None,
+        "config": {
+            "source": cfg.source_path,
+            "fingerprint": statefile.config_fingerprint(
+                cfg.registration, cfg.admin_ip, cfg.zookeeper.chroot
+            ),
+        },
+        "observability": {
+            "enabled": tr.enabled,
+            "spansRecorded": getattr(tr, "spans_recorded", 0),
+            "eventsRecorded": getattr(tr, "events_recorded", 0),
+        },
+    }
 
 
 def _cold_reload_changes(old: Config, new: Config) -> list:
@@ -715,6 +922,8 @@ def _cold_reload_changes(old: Config, new: Config) -> list:
         cold.append("maxAttempts")
     if old.cache != new.cache:
         cold.append("cache")
+    if old.observability != new.observability:
+        cold.append("observability")
     return cold
 
 

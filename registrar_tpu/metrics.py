@@ -53,11 +53,38 @@ watch-coherent resolve cache (:mod:`registrar_tpu.zkcache`):
     registrar_cache_authoritative       1 = coherence-guaranteed (gauge)
     registrar_cache_coherence_lag_seconds[_total|_count]
                                         write→cache-visible lag
+
+:func:`instrument_tracing` (ISSUE 8) feeds real latency **histograms**
+(`_bucket`/`_sum`/`_count` series) from the span layer
+(:mod:`registrar_tpu.trace`) — only wired when the ``observability``
+config block enables tracing, so metric output stays byte-identical
+without it:
+
+    registrar_zk_op_seconds{op}         one observation per ZooKeeper
+                                        request (queue + wire)
+    registrar_resolve_seconds{source}   Binder-view resolves,
+                                        source="cached"|"live"
+    registrar_health_exec_seconds       health-check command executions
+    registrar_reconcile_sweep_seconds   reconcile sweeps (replaces the
+                                        last-value gauge of the same
+                                        name while tracing is on)
+
+The MetricsServer additionally serves (ISSUE 8):
+
+    GET /status        one JSON snapshot: session id/state, registration
+                       epoch + owned znodes with mzxids, health state,
+                       cache stats, last drift summary, config
+                       fingerprint — the runbook's first stop
+                       (docs/OPERATIONS.md "first 5 minutes")
+    GET /debug/trace?n=  the flight recorder's most recent n entries
+    non-GET on a known path -> 405 with ``Allow: GET``
 """
 
 from __future__ import annotations
 
 import asyncio
+import bisect
+import json
 import logging
 import time
 from typing import Callable, Dict, List, Optional, Tuple
@@ -165,6 +192,118 @@ class Gauge(_Metric):
         return super().render()
 
 
+#: default histogram buckets (seconds): spans range from tens of µs
+#: (a warm cached resolve) to whole seconds (the settle-delayed
+#: registration pipeline), so the ladder covers 100 µs – 10 s.
+DEFAULT_BUCKETS = (
+    0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05,
+    0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0,
+)
+
+
+class Histogram(_Metric):
+    """Prometheus histogram: cumulative ``_bucket{le=}``, ``_sum``,
+    ``_count`` per label set.  The family *name* is the bare metric
+    name; only the suffixed series are rendered (standard exposition),
+    which is why a histogram can replace a same-named gauge without the
+    two ever colliding on a rendered series."""
+
+    kind = "histogram"
+
+    def __init__(
+        self,
+        name: str,
+        help_text: str,
+        buckets: Tuple[float, ...] = DEFAULT_BUCKETS,
+    ):
+        super().__init__(name, help_text)
+        if not buckets:
+            raise ValueError("histogram needs at least one bucket bound")
+        self.buckets: Tuple[float, ...] = tuple(sorted(buckets))
+        #: per-label-set per-bucket counts (non-cumulative internally;
+        #: rendered cumulative), plus the +Inf overflow slot at the end
+        self._counts: Dict[_LabelKey, List[int]] = {}
+        self._sums: Dict[_LabelKey, float] = {}
+
+    def _slot(self, key: _LabelKey) -> List[int]:
+        counts = self._counts.get(key)
+        if counts is None:
+            counts = self._counts[key] = [0] * (len(self.buckets) + 1)
+            self._sums.setdefault(key, 0.0)
+        return counts
+
+    def preseed(self, labels: Optional[Dict[str, str]] = None) -> None:
+        """Create the label set's zero series so alerts built on
+        ``rate(..._count)`` see it from the first scrape (the registry's
+        pre-seeding convention, same as Counter.inc(0))."""
+        self._slot(self._key(labels))
+
+    def observe(
+        self, value: float, labels: Optional[Dict[str, str]] = None
+    ) -> None:
+        key = self._key(labels)
+        counts = self._slot(key)
+        counts[bisect.bisect_left(self.buckets, value)] += 1
+        self._sums[key] = self._sums.get(key, 0.0) + value
+
+    def count(self, labels: Optional[Dict[str, str]] = None) -> int:
+        return sum(self._counts.get(self._key(labels), ()))
+
+    def quantile(
+        self, q: float, labels: Optional[Dict[str, str]] = None
+    ) -> Optional[float]:
+        """Bucket-interpolated quantile, the histogram_quantile()
+        estimate (bench.py records p50/p95/p99 from exactly this).
+        None when the label set has no observations."""
+        if not 0.0 <= q <= 1.0:
+            raise ValueError("q must be within [0, 1]")
+        counts = self._counts.get(self._key(labels))
+        total = sum(counts) if counts else 0
+        if not total:
+            return None
+        rank = q * total
+        seen = 0
+        for i, bucket_count in enumerate(counts):
+            seen += bucket_count
+            if seen >= rank:
+                if i >= len(self.buckets):
+                    return self.buckets[-1]  # +Inf bucket: clamp
+                lo = self.buckets[i - 1] if i > 0 else 0.0
+                hi = self.buckets[i]
+                within = rank - (seen - bucket_count)
+                return lo + (hi - lo) * (
+                    within / bucket_count if bucket_count else 0.0
+                )
+        return self.buckets[-1]
+
+    def render(self) -> List[str]:
+        lines = [
+            f"# HELP {self.name} {_escape_help(self.help)}",
+            f"# TYPE {self.name} {self.kind}",
+        ]
+        for key in sorted(self._counts):
+            counts = self._counts[key]
+            base = ",".join(f'{k}="{_escape_label(v)}"' for k, v in key)
+            sep = "," if base else ""
+            cumulative = 0
+            for bound, bucket_count in zip(self.buckets, counts):
+                cumulative += bucket_count
+                lines.append(
+                    f'{self.name}_bucket{{{base}{sep}le="{_format(bound)}"}}'
+                    f" {cumulative}"
+                )
+            cumulative += counts[-1]
+            lines.append(
+                f'{self.name}_bucket{{{base}{sep}le="+Inf"}} {cumulative}'
+            )
+            suffix = f"{{{base}}}" if base else ""
+            lines.append(
+                f"{self.name}_sum{suffix} {_format(self._sums.get(key, 0.0))}"
+            )
+            lines.append(f"{self.name}_count{suffix} {cumulative}")
+        return lines
+
+
 class MetricsRegistry:
     """Ordered collection of metric families; renders the exposition."""
 
@@ -177,6 +316,14 @@ class MetricsRegistry:
 
     def gauge(self, name: str, help_text: str) -> Gauge:
         return self._add(Gauge(name, help_text))
+
+    def histogram(
+        self,
+        name: str,
+        help_text: str,
+        buckets: Tuple[float, ...] = DEFAULT_BUCKETS,
+    ) -> Histogram:
+        return self._add(Histogram(name, help_text, buckets))
 
     def _add(self, metric):
         if metric.name in self._by_name:
@@ -195,12 +342,26 @@ class MetricsRegistry:
         return "\n".join(out) + "\n"
 
 
+#: total header bytes drained per request before the connection is
+#: dropped: 100 lines of up-to-64KiB each (the StreamReader limit) would
+#: otherwise let one hostile request make the daemon read ~6 MiB of
+#: garbage per connection (ISSUE 8 hardening).
+MAX_HEADER_BYTES = 16 * 1024
+
+
 class MetricsServer:
-    """Minimal asyncio HTTP/1.0 server exposing ``GET /metrics``.
+    """Minimal asyncio HTTP/1.0 server exposing ``GET /metrics`` — plus,
+    when providers are wired, ``GET /status`` (one introspection JSON
+    snapshot) and ``GET /debug/trace?n=`` (the flight recorder).
 
     Deliberately tiny: one request per connection, no keep-alive, no TLS —
     the same operational footprint as an artedi/kang listener, meant for a
     loopback or management network (bind 127.0.0.1 by default).
+
+    ``status_provider`` is an async callable returning the /status dict;
+    ``trace_provider`` a sync callable ``(n: Optional[int]) -> dict``
+    returning the /debug/trace payload.  An unwired endpoint answers
+    404, exactly like any unknown path.
     """
 
     def __init__(
@@ -208,9 +369,13 @@ class MetricsServer:
         registry: MetricsRegistry,
         host: str = "127.0.0.1",
         port: int = 0,
+        status_provider=None,
+        trace_provider=None,
     ):
         self.registry = registry
         self.host = host
+        self.status_provider = status_provider
+        self.trace_provider = trace_provider
         self._requested_port = port
         self.port: Optional[int] = None
         self._server: Optional[asyncio.AbstractServer] = None
@@ -240,8 +405,11 @@ class MetricsServer:
                 # hostile/garbage request) — drop it, no response owed.
                 return
             parts = request.decode("latin-1", "replace").split()
-            # Drain headers (bounded) so well-behaved clients see a clean
-            # close instead of a reset.
+            # Drain headers (bounded in LINES and total BYTES) so
+            # well-behaved clients see a clean close instead of a reset,
+            # while a hostile request cannot make us read megabytes of
+            # headers one near-limit line at a time.
+            drained = len(request)
             for _ in range(100):
                 try:
                     line = await asyncio.wait_for(
@@ -251,21 +419,16 @@ class MetricsServer:
                     return
                 if line in (b"\r\n", b"\n", b""):
                     break
-            if len(parts) >= 2 and parts[0] == "GET" and (
-                parts[1] == "/metrics" or parts[1].startswith("/metrics?")
-            ):
-                body = self.registry.render().encode()
-                status = "200 OK"
-                ctype = "text/plain; version=0.0.4; charset=utf-8"
-            else:
-                body = b"try GET /metrics\n"
-                status = "404 Not Found"
-                ctype = "text/plain; charset=utf-8"
+                drained += len(line)
+                if drained > MAX_HEADER_BYTES:
+                    return  # hostile header volume: drop, no response owed
+            status, ctype, body, extra = await self._respond(parts)
             writer.write(
                 (
                     f"HTTP/1.0 {status}\r\n"
                     f"Content-Type: {ctype}\r\n"
                     f"Content-Length: {len(body)}\r\n"
+                    f"{extra}"
                     "Connection: close\r\n\r\n"
                 ).encode()
                 + body
@@ -278,6 +441,60 @@ class MetricsServer:
                 writer.close()
             except Exception:  # noqa: BLE001
                 pass
+
+    async def _respond(self, parts: List[str]):
+        """Route one request: ``(status, content_type, body, extra_headers)``."""
+        method = parts[0] if parts else ""
+        target = parts[1] if len(parts) >= 2 else ""
+        path, _, query = target.partition("?")
+        known = path == "/metrics" or (
+            path == "/status" and self.status_provider is not None
+        ) or (path == "/debug/trace" and self.trace_provider is not None)
+        if known and method != "GET":
+            # The path exists; the method is wrong.  405 with Allow is
+            # the contract clients (and security scanners) expect —
+            # a 404 here would claim the endpoint doesn't exist.
+            return (
+                "405 Method Not Allowed",
+                "text/plain; charset=utf-8",
+                b"method not allowed; try GET\n",
+                "Allow: GET\r\n",
+            )
+        if known and path == "/metrics":
+            return (
+                "200 OK",
+                "text/plain; version=0.0.4; charset=utf-8",
+                self.registry.render().encode(),
+                "",
+            )
+        if known and path == "/status":
+            try:
+                snapshot = await self.status_provider()
+                body = json.dumps(snapshot, indent=2, default=str).encode()
+                body += b"\n"
+            except Exception as err:  # noqa: BLE001 - introspection must answer
+                log.exception("status provider raised")
+                body = json.dumps({"error": repr(err)}).encode() + b"\n"
+            return ("200 OK", "application/json; charset=utf-8", body, "")
+        if known and path == "/debug/trace":
+            n = None
+            for kv in query.split("&"):
+                key, _, value = kv.partition("=")
+                if key == "n":
+                    try:
+                        n = int(value)
+                    except ValueError:
+                        pass
+            body = json.dumps(
+                self.trace_provider(n), indent=2, default=str
+            ).encode() + b"\n"
+            return ("200 OK", "application/json; charset=utf-8", body, "")
+        return (
+            "404 Not Found",
+            "text/plain; charset=utf-8",
+            b"try GET /metrics\n",
+            "",
+        )
 
 
 def instrument(ee, zk, registry: Optional[MetricsRegistry] = None) -> MetricsRegistry:
@@ -343,10 +560,19 @@ def instrument(ee, zk, registry: Optional[MetricsRegistry] = None) -> MetricsReg
     sweeps = reg.counter(
         "registrar_reconcile_sweeps_total", "Reconcile sweeps completed"
     )
-    sweep_seconds = reg.gauge(
-        "registrar_reconcile_sweep_seconds",
-        "Duration of the last reconcile sweep (seconds)",
-    )
+    # With tracing on, instrument_tracing (wired FIRST) already owns this
+    # family as a real histogram fed from reconcile.sweep spans; the
+    # last-value gauge then stands down — including its event handler
+    # (a Histogram has no set(), and the span sink is already the data
+    # path).  Without it (the default), the gauge renders and updates
+    # exactly as before — parity.
+    sweep_seconds = reg.get("registrar_reconcile_sweep_seconds")
+    if sweep_seconds is None:
+        sweep_seconds = reg.gauge(
+            "registrar_reconcile_sweep_seconds",
+            "Duration of the last reconcile sweep (seconds)",
+        )
+    sweep_gauge = sweep_seconds if isinstance(sweep_seconds, Gauge) else None
     handoffs = reg.counter(
         "registrar_handoffs_total",
         "Handoff shutdowns: session state persisted, connection "
@@ -399,7 +625,8 @@ def instrument(ee, zk, registry: Optional[MetricsRegistry] = None) -> MetricsReg
 
     def on_sweep(summary) -> None:
         sweeps.inc()
-        sweep_seconds.set(float(summary.get("duration", 0.0)))
+        if sweep_gauge is not None:
+            sweep_gauge.set(float(summary.get("duration", 0.0)))
 
     zk.on("session_reborn", lambda *_a: rebirths.inc())
     zk.on("rebirth_breaker_tripped", lambda *_a: breaker_trips.inc())
@@ -495,4 +722,85 @@ def instrument_cache(cache, registry: Optional[MetricsRegistry] = None) -> Metri
         "Last observed write-to-invalidation-processed lag (seconds)",
     )
     lag_last.set_function(lambda: stats["coherence_lag_ms_last"] / 1000.0)
+    return reg
+
+
+#: ZooKeeper op label values pre-seeded for registrar_zk_op_seconds —
+#: the requests the daemon's own loops issue, so each series exists from
+#: the first scrape (the registry's pre-seeding convention).
+ZK_OPS_PRESEEDED = (
+    "create", "delete", "exists", "getData", "setData", "getChildren2",
+    "sync", "multi",
+)
+
+
+def instrument_tracing(
+    tracer, registry: Optional[MetricsRegistry] = None
+) -> MetricsRegistry:
+    """Feed latency histograms from the span layer (ISSUE 8).
+
+    Subscribes to ``tracer``'s span sink and routes the cataloged span
+    names (docs/OBSERVABILITY.md) into Prometheus histograms.  Call
+    BEFORE :func:`instrument` on a shared registry: this owns the
+    ``registrar_reconcile_sweep_seconds`` family (as a histogram), and
+    instrument() then skips its last-value gauge of the same name.
+
+    Only wired when tracing is enabled (the ``observability`` config
+    block) — without it, none of these families exist and the metric
+    output is byte-identical to pre-tracing builds.
+    """
+    reg = registry if registry is not None else MetricsRegistry()
+
+    zk_op = reg.histogram(
+        "registrar_zk_op_seconds",
+        "ZooKeeper request latency (submit to reply dispatched), by op",
+    )
+    for op in ZK_OPS_PRESEEDED:
+        zk_op.preseed({"op": op})
+    resolve = reg.histogram(
+        "registrar_resolve_seconds",
+        "Binder-view resolve latency by source (cached|live)",
+    )
+    for source in ("cached", "live"):
+        resolve.preseed({"source": source})
+    health_exec = reg.histogram(
+        "registrar_health_exec_seconds",
+        "Health-check command execution time",
+    )
+    health_exec.preseed()
+    sweep = reg.histogram(
+        "registrar_reconcile_sweep_seconds",
+        "Reconcile sweep duration distribution",
+    )
+    sweep.preseed()
+
+    # Label dicts are interned per distinct value: the sink runs once
+    # per finished span on traced hot paths (a cached resolve is ~100µs
+    # end to end), and a fresh one-key dict per observation is
+    # measurable there.
+    op_labels: Dict[str, Dict[str, str]] = {}
+    source_labels = {s: {"source": s} for s in ("cached", "live")}
+
+    def on_span(span) -> None:
+        if span.duration_s is None:
+            return
+        name = span.name
+        if name == "zk.op":
+            op = str(span.attrs.get("op"))
+            labels = op_labels.get(op)
+            if labels is None:
+                labels = op_labels[op] = {"op": op}
+            zk_op.observe(span.duration_s, labels=labels)
+        elif name == "resolve.query":
+            source = str(span.attrs.get("source"))
+            labels = source_labels.get(source)
+            if labels is None:
+                labels = source_labels[source] = {"source": source}
+            resolve.observe(span.duration_s, labels=labels)
+        elif name == "health.exec":
+            health_exec.observe(span.duration_s)
+        elif name == "reconcile.sweep":
+            sweep.observe(span.duration_s)
+
+    tracer.on_span(on_span)
     return reg

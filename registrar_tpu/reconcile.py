@@ -42,6 +42,7 @@ from dataclasses import dataclass
 from typing import Any, Dict, List, Mapping, Optional
 
 from registrar_tpu import registration as register_mod
+from registrar_tpu import trace
 from registrar_tpu.registration import (
     _validate_registration,
     registration_payloads,
@@ -287,6 +288,12 @@ class Reconciler:
 
     async def sweep_once(self) -> List[Drift]:
         """One sweep: diff, emit drift, repair (when configured)."""
+        with trace.tracer_for(self.zk).span("reconcile.sweep") as sp:
+            drifts = await self._sweep_traced()
+            sp.set_attr("drift", len(drifts))
+            return drifts
+
+    async def _sweep_traced(self) -> List[Drift]:
         start = time.monotonic()
         # Epoch BEFORE the read-back: the sweep's observations are only
         # actionable if no other recovery path refreshes the

@@ -34,6 +34,7 @@ import logging
 import socket
 from typing import Any, List, Mapping, Optional, Sequence
 
+from registrar_tpu import trace
 from registrar_tpu.records import (
     default_address,
     domain_to_path,
@@ -201,6 +202,21 @@ async def _register_once(
     settle_delay: float,
 ) -> List[str]:
     """One pass of the five-stage pipeline (validated input)."""
+    with trace.tracer_for(zk).span(
+        "register.pipeline", domain=registration["domain"]
+    ):
+        return await _register_stages(
+            zk, registration, admin_ip, hostname, settle_delay
+        )
+
+
+async def _register_stages(
+    zk: ZKClient,
+    registration: Mapping[str, Any],
+    admin_ip: Optional[str],
+    hostname: Optional[str],
+    settle_delay: float,
+) -> List[str]:
     nodes, record_payload, path, service_payload = registration_payloads(
         registration, admin_ip, hostname
     )

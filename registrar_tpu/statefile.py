@@ -149,6 +149,16 @@ def save(path: str, state: SessionState) -> None:
     broken statefile degrades the next restart to a fresh registration,
     it must never take down the running daemon).
     """
+    # Imported here, not at module top: statefile is also consumed by
+    # zkcli (a cold-start CLI path where pulling the tracing layer in
+    # for a file inspection would be pure import weight).
+    from registrar_tpu import trace
+
+    with trace.get_tracer().span("statefile.save", path=path):
+        _save_atomic(path, state)
+
+
+def _save_atomic(path: str, state: SessionState) -> None:
     payload = json.dumps(
         {
             "format": FORMAT,

@@ -690,6 +690,161 @@ async def _cmd_drain(args) -> int:
     return 0
 
 
+async def _metrics_get_json(host: str, port: int, path: str, timeout: float):
+    """GET a JSON payload off the daemon's metrics listener (stdlib
+    asyncio only, matching the listener's HTTP/1.0 one-shot shape).
+    Returns the decoded object; raises OSError/ValueError on failure."""
+    reader, writer = await asyncio.wait_for(
+        asyncio.open_connection(host, port), timeout=timeout
+    )
+    try:
+        writer.write(
+            f"GET {path} HTTP/1.0\r\nHost: {host}\r\n\r\n".encode()
+        )
+        await writer.drain()
+        raw = await asyncio.wait_for(reader.read(), timeout=timeout)
+    finally:
+        writer.close()
+    head, _, body = raw.partition(b"\r\n\r\n")
+    parts = head.split()
+    if len(parts) < 2 or parts[1] != b"200":
+        raise OSError(
+            f"GET {path}: HTTP "
+            f"{head.splitlines()[0].decode('latin-1', 'replace') if head else 'no response'}"
+        )
+    return json.loads(body)
+
+
+def _metrics_endpoint(args, what: str):
+    """Resolve ``-f CONFIG``'s metrics listener address, or None (after
+    printing why) when the config is unreadable or has no ``metrics``
+    block — the shared scaffolding of ``status`` and ``trace``."""
+    from registrar_tpu.config import ConfigError, load_config
+
+    try:
+        cfg = load_config(args.file)
+    except ConfigError as e:
+        print(f"zkcli: {what}: {e}", file=sys.stderr)
+        return None
+    if cfg.metrics is None:
+        print(
+            f"zkcli: {what}: {args.file} has no `metrics` block — the "
+            "daemon serves /status and /debug/trace on the metrics "
+            "listener", file=sys.stderr,
+        )
+        return None
+    return cfg.metrics.host, cfg.metrics.port
+
+
+async def _cmd_status(args) -> int:
+    """One-shot daemon introspection: ``GET /status`` off the metrics
+    listener, pretty-printed (ISSUE 8 — the runbook's first stop).
+
+    Exit status follows the ``verify`` contract: 0 = healthy (session
+    connected, registered, not health-down), 1 = degraded (any of those
+    false, or reconciler drift standing), 2 = unreachable (no metrics
+    block, daemon not answering, or the config unreadable).
+    """
+    endpoint = _metrics_endpoint(args, "status")
+    if endpoint is None:
+        return 2
+    host, port = endpoint
+    try:
+        snapshot = await _metrics_get_json(
+            host, port, "/status", args.timeout
+        )
+    except (OSError, ValueError, asyncio.TimeoutError) as e:
+        print(f"zkcli: status: {host}:{port}: {e}", file=sys.stderr)
+        return 2
+    print(json.dumps(snapshot, indent=2, default=str))
+    session = snapshot.get("session") or {}
+    registration = snapshot.get("registration") or {}
+    health = snapshot.get("health") or {}
+    reconcile_info = snapshot.get("reconcile") or {}
+    problems = []
+    if not session.get("connected"):
+        problems.append(f"session {session.get('state', 'unknown')}")
+    if not registration.get("registered"):
+        problems.append("not registered")
+    if health.get("down"):
+        problems.append("health-down")
+    last_sweep = reconcile_info.get("lastSweep") or {}
+    if last_sweep.get("drift"):
+        problems.append(f"drift={last_sweep['drift']} at last sweep")
+    if problems:
+        print(f"zkcli: status: DEGRADED: {'; '.join(problems)}",
+              file=sys.stderr)
+        return 1
+    print("zkcli: status: healthy", file=sys.stderr)
+    return 0
+
+
+def _span_line(entry) -> str:
+    """One flight-recorder entry as one grep-friendly line."""
+    import datetime
+
+    stamp = datetime.datetime.fromtimestamp(
+        entry.get("time", 0), tz=datetime.timezone.utc
+    ).strftime("%H:%M:%S.%f")[:-3]
+    attrs = " ".join(
+        f"{k}={v}" for k, v in sorted((entry.get("attrs") or {}).items())
+    )
+    if entry.get("kind") == "event":
+        return f"{stamp}  event  {entry.get('name')}  {attrs}".rstrip()
+    dur = entry.get("duration_ms")
+    marks = entry.get("marks") or {}
+    split = ""
+    if "flushed" in marks and dur is not None:
+        queue = marks["flushed"]
+        split = f" queue={queue}ms wire={round(dur - queue, 3)}ms"
+    ids = f"{entry.get('trace_id')}/{entry.get('span_id')}"
+    status = entry.get("status", "?")
+    return (
+        f"{stamp}  {dur if dur is not None else '?':>9}ms  "
+        f"{entry.get('name')}  [{status}]  {ids}  {attrs}{split}"
+    ).rstrip()
+
+
+async def _cmd_trace(args) -> int:
+    """Dump the daemon's flight recorder: ``GET /debug/trace?n=`` off
+    the metrics listener, one line per span/event (ISSUE 8).
+
+    Exit 0 = entries printed, 1 = tracing disabled (no `observability`
+    block) or the recorder is empty, 2 = unreachable.  ``--json`` prints
+    the raw payload instead of the line rendering.
+    """
+    endpoint = _metrics_endpoint(args, "trace")
+    if endpoint is None:
+        return 2
+    host, port = endpoint
+    try:
+        payload = await _metrics_get_json(
+            host, port, f"/debug/trace?n={args.n}", args.timeout
+        )
+    except (OSError, ValueError, asyncio.TimeoutError) as e:
+        print(f"zkcli: trace: {host}:{port}: {e}", file=sys.stderr)
+        return 2
+    if not payload.get("enabled"):
+        print(
+            "zkcli: trace: tracing is disabled (no `observability` "
+            "config block on the daemon)", file=sys.stderr,
+        )
+        return 1
+    if args.json:
+        print(json.dumps(payload, indent=2, default=str))
+        return 0 if payload.get("entries") else 1
+    entries = payload.get("entries") or []
+    for entry in entries:
+        print(_span_line(entry))
+    print(
+        f"zkcli: trace: {len(entries)} entries "
+        f"({payload.get('spans_recorded', 0)} spans, "
+        f"{payload.get('events_recorded', 0)} events recorded; "
+        f"sampleRate={payload.get('sample_rate')})", file=sys.stderr,
+    )
+    return 0 if entries else 1
+
+
 def _resolution_lines(res) -> List[str]:
     """Render a Resolution the way `resolve` prints it (shared with the
     serve-view loop so the two command outputs can never drift)."""
@@ -1080,6 +1235,48 @@ def _register_commands(sub) -> None:
         help="connect budget before reporting unreachable (default 10)",
     )
     p.set_defaults(fn=_cmd_drain, raw=True)
+
+    p = sub.add_parser(
+        "status",
+        help="one-shot daemon introspection: GET /status off the "
+        "config's metrics listener, pretty-printed (exit 0 healthy / "
+        "1 degraded / 2 unreachable) — the incident runbook's first stop",
+    )
+    p.add_argument(
+        "-f", "--file", required=True, metavar="CONFIG",
+        help="registrar config file (its `metrics` block names the "
+        "listener)",
+    )
+    p.add_argument(
+        "--timeout", type=float, default=5.0, metavar="SECONDS",
+        help="HTTP budget before reporting unreachable (default 5)",
+    )
+    p.set_defaults(fn=_cmd_status, raw=True)
+
+    p = sub.add_parser(
+        "trace",
+        help="dump the daemon's flight recorder: GET /debug/trace off "
+        "the config's metrics listener, one line per span/event (exit "
+        "0 entries / 1 tracing disabled or empty / 2 unreachable)",
+    )
+    p.add_argument(
+        "-f", "--file", required=True, metavar="CONFIG",
+        help="registrar config file (its `metrics` block names the "
+        "listener)",
+    )
+    p.add_argument(
+        "-n", type=int, default=200,
+        help="most recent N entries to fetch (default 200)",
+    )
+    p.add_argument(
+        "--json", action="store_true",
+        help="print the raw JSON payload instead of one line per entry",
+    )
+    p.add_argument(
+        "--timeout", type=float, default=5.0, metavar="SECONDS",
+        help="HTTP budget before reporting unreachable (default 5)",
+    )
+    p.set_defaults(fn=_cmd_trace, raw=True)
 
     p = sub.add_parser(
         "resolve", help="answer a DNS query the way Binder would"

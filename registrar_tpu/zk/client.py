@@ -59,6 +59,7 @@ import time
 from collections import deque
 from typing import Deque, Iterable, List, Optional, Sequence, Tuple
 
+from registrar_tpu import trace
 from registrar_tpu.events import EventEmitter
 from registrar_tpu.retry import (
     CONNECT_RETRY,
@@ -91,6 +92,25 @@ REBIRTH_WINDOW_S = 300.0
 
 #: default ``max_session_rebirths`` (per :data:`REBIRTH_WINDOW_S`)
 DEFAULT_MAX_SESSION_REBIRTHS = 5
+
+#: op code -> span label for the ``zk.op`` spans (ISSUE 8); an op not
+#: listed is traced under its numeric code, so a new op can never
+#: silently vanish from the histograms
+_OP_NAMES = {
+    OpCode.CREATE: "create",
+    OpCode.DELETE: "delete",
+    OpCode.EXISTS: "exists",
+    OpCode.GET_DATA: "getData",
+    OpCode.SET_DATA: "setData",
+    OpCode.GET_ACL: "getAcl",
+    OpCode.SET_ACL: "setAcl",
+    OpCode.GET_CHILDREN: "getChildren",
+    OpCode.GET_CHILDREN2: "getChildren2",
+    OpCode.SYNC: "sync",
+    OpCode.CHECK: "check",
+    OpCode.MULTI: "multi",
+    OpCode.CLOSE_SESSION: "closeSession",
+}
 
 
 class ZKClient(EventEmitter):
@@ -216,6 +236,16 @@ class ZKClient(EventEmitter):
         # credentials added via add_auth, replayed on every (re)connect the
         # way the Apache client replays its authInfo list
         self._auths: List[Tuple[str, bytes]] = []
+        #: per-instance tracer override (ISSUE 8); None = the process
+        #: default via trace.tracer_for — a disabled default makes every
+        #: tracing branch below a no-op
+        self.tracer = None
+        #: in-flight ``zk.op`` spans by xid (only populated while a
+        #: tracer is enabled; emptied by reply dispatch and teardown)
+        self._op_spans: dict = {}
+        #: xids posted since the last drain — their spans get the
+        #: ``flushed`` mark (the queue/wire split) when the drain lands
+        self._unflushed: List[int] = []
 
     # -- state --------------------------------------------------------------
 
@@ -419,6 +449,9 @@ class ZKClient(EventEmitter):
                 "session 0x%x resumed across a process boundary "
                 "(handoff state file)", self.session_id,
             )
+            trace.tracer_for(self).event(
+                "zk.session_resumed", session=f"0x{self.session_id:x}"
+            )
             self.emit("session_resumed", self.session_id)
         if reborn:
             self._rebirth_pending = False  # consumed only on full success
@@ -426,6 +459,10 @@ class ZKClient(EventEmitter):
             log.warning(
                 "session reborn: fresh session 0x%x established in-process "
                 "(rebirth %d)", self.session_id, self.rebirths,
+            )
+            trace.tracer_for(self).event(
+                "zk.session_reborn",
+                session=f"0x{self.session_id:x}", rebirth=self.rebirths,
             )
             self.emit("session_reborn", self.session_id)
 
@@ -532,6 +569,13 @@ class ZKClient(EventEmitter):
             _, fut = self._pending.popleft()
             if not fut.done():
                 fut.set_exception(err)
+        if self._op_spans:
+            # Replies that will never come: close their spans with the
+            # same verdict their futures just got.
+            for sp in self._op_spans.values():
+                sp.finish("error", err=Err.CONNECTION_LOSS)
+            self._op_spans.clear()
+        self._unflushed.clear()
         if was_connected:
             self.emit("state", "disconnected")
             self.emit("close")
@@ -611,6 +655,9 @@ class ZKClient(EventEmitter):
                     "session 0x%x expired; rebuilding a fresh session "
                     "in-process (surviveSessionExpiry)", old,
                 )
+                trace.tracer_for(self).event(
+                    "zk.session_lost", session=f"0x{old:x}"
+                )
                 self.emit("state", "session_lost")
                 return
             log.error(
@@ -620,6 +667,9 @@ class ZKClient(EventEmitter):
             )
             self.emit("rebirth_breaker_tripped", len(self._rebirth_times))
         self._closed = True
+        trace.tracer_for(self).event(
+            "zk.session_expired", session=f"0x{self.session_id:x}"
+        )
         self.emit("state", "session_expired")
         self.emit("session_expired")
 
@@ -689,6 +739,12 @@ class ZKClient(EventEmitter):
             # Pings are fire-and-forget (no _pending entry); their replies
             # matter only as liveness, recorded in _last_response above.
             return
+        sp = self._op_spans.pop(reply.xid, None)
+        if sp is not None:
+            if reply.err != Err.OK:
+                sp.finish("error", err=reply.err)
+            else:
+                sp.finish()
         if not self._pending:
             log.warning("unmatched reply xid=%d", reply.xid)
             return
@@ -747,12 +803,33 @@ class ZKClient(EventEmitter):
             raise ZKError(Err.CONNECTION_LOSS)
         fut: asyncio.Future = asyncio.get_running_loop().create_future()
         self._pending.append((xid, fut))
+        tr = trace.tracer_for(self)
+        if tr.enabled and xid > 0:
+            # One span per request, split submit -> flushed -> reply
+            # (queue time vs wire time).  Reserved xids (auth replay,
+            # SetWatches, pings) stay untraced: they are connection
+            # plumbing, not operations the caller issued.
+            self._op_spans[xid] = tr.start_span(
+                "zk.op", op=_OP_NAMES.get(op, str(op)), xid=xid
+            )
+            self._unflushed.append(xid)
         encoded = proto.encode_request(xid, op, body)
         if self._corked is not None:
             self._corked.append(encoded)
         else:
             self._writer.write(encoded)
         return fut
+
+    def _mark_flushed(self) -> None:
+        """Stamp the queue->wire boundary on every span posted since the
+        last drain (called right after a drain() completes: the bytes
+        are out of our buffer, the remaining wait is the server+wire)."""
+        if self._unflushed:
+            for xid in self._unflushed:
+                sp = self._op_spans.get(xid)
+                if sp is not None:
+                    sp.mark("flushed")
+            self._unflushed.clear()
 
     def _cork(self) -> None:
         """Hold posted frames in a local list instead of writing each one.
@@ -794,6 +871,7 @@ class ZKClient(EventEmitter):
                 self._uncork()
             if futs and self._writer is not None:
                 await self._writer.drain()
+                self._mark_flushed()
         except (ConnectionError, OSError):
             await self._teardown(expected=False)
         except ZKError as e:  # not connected: fail after draining futs
@@ -804,6 +882,7 @@ class ZKClient(EventEmitter):
         fut = self._post(xid, op, body)
         try:
             await self._writer.drain()
+            self._mark_flushed()
         except (ConnectionError, OSError):
             await self._teardown(expected=False)
         return await self._await_reply(fut)

@@ -54,6 +54,7 @@ import logging
 import time
 from typing import Dict, Iterable, List, Optional, Tuple
 
+from registrar_tpu import trace
 from registrar_tpu.events import EventEmitter
 from registrar_tpu.zk.client import ZKClient
 from registrar_tpu.zk.protocol import Err, EventType, Stat, ZKError
@@ -134,6 +135,8 @@ class ZKCache(EventEmitter):
         self._session_up = zk.connected
         self._rearm_failed = False
         self._terminal = False
+        #: per-instance tracer override (ISSUE 8); None = process default
+        self.tracer = None
         self.stats: Dict[str, float] = {
             "hits": 0,
             "misses": 0,
@@ -244,6 +247,9 @@ class ZKCache(EventEmitter):
         dropped = self._entries.pop(path, None)
         if dropped is not None:
             self.stats["invalidations"] += 1
+            trace.tracer_for(self).event(
+                "cache.invalidated", path=path, type=event.type
+            )
         if event.type in _DATA_EVENTS:
             self._lag_candidates[path] = time.time()
             # bound the candidate map: a path churned away before any
@@ -362,9 +368,12 @@ class ZKCache(EventEmitter):
             gens.append(self._gen(path))
             self._bulk[path] = self._bulk.get(path, 0) + 1
         try:
-            results = await self._zk.get_many(
-                (path for _i, path in misses), watch=True
-            )
+            with trace.tracer_for(self).span(
+                "cache.fill", kind="bulk", count=len(misses)
+            ):
+                results = await self._zk.get_many(
+                    (path for _i, path in misses), watch=True
+                )
             for (i, path), gen, res in zip(misses, gens, results):
                 out[i] = res
                 if res is not None:
@@ -422,6 +431,10 @@ class ZKCache(EventEmitter):
             self._prune(path)
 
     async def _load_node(self, path: str):
+        with trace.tracer_for(self).span("cache.fill", path=path):
+            return await self._load_node_inner(path)
+
+    async def _load_node_inner(self, path: str):
         gen = self._gen(path)
         self._ensure_listener(path)
         node = await self._zk.read_node(path, watch=True)

@@ -396,13 +396,20 @@ class _RebornWorker:
     closes, and the successor registers fresh.  A handoff whose session
     the storm expired in the gap is refused by the server and must
     degrade to a fresh registration — never to a terminal expiry.
+
+    The ISSUE 8 rider gives each worker its own flight recorder
+    (``tracer``): a failing storm dumps every worker's recent spans and
+    events (the CI chaos job uploads the dumps as artifacts), so a
+    seed that fails in CI arrives with the span chain across session
+    loss → rebirth → re-registration already in hand.
     """
 
-    def __init__(self, i: int, addresses):
+    def __init__(self, i: int, addresses, tracer=None):
         self.i = i
         self.hostname = f"reborn{i}"
         self.admin_ip = f"10.9.1.{i + 1}"
         self.addresses = addresses
+        self.tracer = tracer
         self.client: ZKClient = None
         self.ee = None
         #: terminal session_expired events — the "process exit" analog
@@ -428,6 +435,9 @@ class _RebornWorker:
             max_session_rebirths=10_000,
             reconnect_policy=FAST_RECONNECT,
         )
+        # the recorder survives restarts: the successor client reports
+        # into the same per-worker ring as its predecessor
+        self.client.tracer = self.tracer
         manifest = None
         if resume is not None:
             sid, passwd, timeout_ms, zxid, znodes = resume
@@ -525,6 +535,26 @@ class _RebornWorker:
             await self.client.close()
 
 
+def _dump_flight_recorders(workers) -> None:
+    """A failing storm leaves each worker's flight recorder on disk
+    (CHAOS_DUMP_DIR, default cwd) — the CI chaos job uploads the dumps
+    next to the job summary, so the failure arrives with the span chain
+    already in hand (ISSUE 8 satellite)."""
+    out_dir = os.environ.get("CHAOS_DUMP_DIR", ".")
+    for w in workers:
+        if w.tracer is None:
+            continue
+        try:
+            path = w.tracer.dump_to_file(
+                os.path.join(out_dir, f"chaos-flight-worker{w.i}.json")
+            )
+        except OSError as err:
+            print(f"flight-recorder dump for worker {w.i} failed: {err!r}",
+                  file=sys.stderr)
+        else:
+            print(f"flight recorder dumped: {path}", file=sys.stderr)
+
+
 async def test_chaos_storm_forced_expiry_survived_in_process():
     """ISSUE 3 acceptance: force-expire sessions mid-storm; the fleet
     (surviveSessionExpiry + reconcile.repair + the rebirth consumer)
@@ -539,10 +569,98 @@ async def test_chaos_storm_forced_expiry_survived_in_process():
           file=sys.stderr)
     rng = random.Random(seed)
 
+    from registrar_tpu.trace import Tracer
+
     async with ZKEnsemble(ENSEMBLE, tick_ms=20) as ens:
-        workers = [_RebornWorker(i, ens.addresses) for i in range(N_WORKERS)]
+        # ISSUE 8 rider: a per-worker flight recorder at 100% sampling —
+        # dumped on failure, and asserted post-storm to carry the span
+        # chain across session loss → rebirth → re-registration.
+        workers = [
+            _RebornWorker(
+                i, ens.addresses,
+                tracer=Tracer(sample_rate=1.0, max_spans=4096),
+            )
+            for i in range(N_WORKERS)
+        ]
         for w in workers:
             await w.start()
+
+        # ISSUE 8 acceptance: the introspection surface must ANSWER
+        # throughout the storm.  One MetricsServer fronts worker 0 with
+        # the daemon's own /status snapshot + its flight recorder; a
+        # poller hits both endpoints all storm and every poll must
+        # succeed (the endpoints are deliberately storm-proof: a dead
+        # ensemble degrades the mzxid read-back to `readError`, never
+        # to a hung or erroring endpoint).
+        import time as time_mod
+
+        from registrar_tpu.config import parse_config
+        from registrar_tpu.main import _status_snapshot
+        from registrar_tpu.metrics import (
+            MetricsRegistry,
+            MetricsServer,
+            instrument_tracing,
+        )
+
+        w0 = workers[0]
+        status_cfg = parse_config({
+            "registration": _reg(),
+            "zookeeper": {"servers": [
+                {"host": ens.addresses[0][0], "port": ens.addresses[0][1]}
+            ]},
+        })
+        status_note = {"zk_state": "connected", "last_reconcile": None,
+                       "started": time_mod.time()}
+        status_registry = MetricsRegistry()
+        instrument_tracing(w0.tracer, status_registry)
+        mserver = await MetricsServer(
+            status_registry,
+            status_provider=lambda: _status_snapshot(
+                status_cfg, w0.client, w0.ee, status_note
+            ),
+            trace_provider=lambda n: w0.tracer.dump(n),
+        ).start()
+        probe_stats = {"status_ok": 0, "trace_ok": 0, "failures": []}
+
+        async def _probe_get(path: str):
+            reader, writer = await asyncio.open_connection(
+                mserver.host, mserver.port
+            )
+            try:
+                writer.write(
+                    f"GET {path} HTTP/1.0\r\n\r\n".encode()
+                )
+                await writer.drain()
+                raw = await asyncio.wait_for(reader.read(), timeout=10)
+            finally:
+                writer.close()
+            head, _, body = raw.partition(b"\r\n\r\n")
+            assert head.split()[1] == b"200", head
+            import json as json_mod
+
+            return json_mod.loads(body)
+
+        async def introspection_probe(stop: asyncio.Event) -> None:
+            while not stop.is_set():
+                try:
+                    snapshot = await _probe_get("/status")
+                    assert snapshot["session"]["id"]
+                    probe_stats["status_ok"] += 1
+                    dump = await _probe_get("/debug/trace?n=50")
+                    assert dump["enabled"] is True
+                    probe_stats["trace_ok"] += 1
+                except (
+                    AssertionError,
+                    OSError,
+                    ValueError,
+                    # Not redundant on 3.9: asyncio.TimeoutError only
+                    # became an OSError alias (TimeoutError) in 3.10 —
+                    # a timed-out poll must be a recorded failure, not
+                    # a probe-task crash that stops the polling.
+                    asyncio.TimeoutError,
+                ) as err:
+                    probe_stats["failures"].append(repr(err))
+                await asyncio.sleep(0.05)
         # Binder's-eye cache rider (ISSUE 4): a watch-coherent resolve
         # cache on its own surviveSessionExpiry client rides the same
         # storm.  During the storm it resolves continuously (exercising
@@ -632,10 +750,12 @@ async def test_chaos_storm_forced_expiry_survived_in_process():
 
             storm = asyncio.create_task(expiry_storm())
             cache_task = asyncio.create_task(cache_churn(stop))
+            probe_task = asyncio.create_task(introspection_probe(stop))
             await asyncio.sleep(churn_s)
             stop.set()
             await storm
             await cache_task
+            await probe_task
             # every mid-storm restart must complete (its "supervisor"
             # loop keeps relaunching until the successor registers)
             if restart_tasks:
@@ -643,6 +763,12 @@ async def test_chaos_storm_forced_expiry_survived_in_process():
             assert any(ev[0] == "expire" for ev in events), events
             assert any(ev[0] == "agent-restart" for ev in events), events
             assert cache_resolves["ok"] > 0, "cache never answered in-storm"
+
+            # ISSUE 8: /status + /debug/trace answered EVERY poll of the
+            # storm — not one hang, not one 500, not one refused read.
+            assert not probe_stats["failures"], probe_stats["failures"]
+            assert probe_stats["status_ok"] > 0, "no /status poll landed"
+            assert probe_stats["trace_ok"] > 0, "no /debug/trace poll landed"
 
             # -- convergence: exact §2.6 contract, in-process ------------
             deadline = asyncio.get_running_loop().time() + 30
@@ -720,7 +846,47 @@ async def test_chaos_storm_forced_expiry_survived_in_process():
             cres2 = await binderview.resolve(cache, DOMAIN, "A")
             assert sorted(a.data for a in cres2.answers) == expected
             assert cache.authoritative
+
+            # ISSUE 8 acceptance: the flight recorder carries the whole
+            # recovery arc — session loss event, rebirth event, and the
+            # agent.repair span with its register.pipeline child on ONE
+            # trace (the dump a failing storm leaves behind shows the
+            # same chain; asserted here on a worker that was reborn).
+            if total_rebirths > 0:
+                reborn_workers = [
+                    w for w in workers if w.client.rebirths > 0
+                ]
+                chained = False
+                for w in reborn_workers:
+                    entries = w.tracer.dump()["entries"]
+                    names = {e["name"] for e in entries}
+                    if not {"zk.session_lost", "zk.session_reborn"} <= names:
+                        continue
+                    repairs = {
+                        e["span_id"]: e["trace_id"]
+                        for e in entries
+                        if e["kind"] == "span" and e["name"] == "agent.repair"
+                    }
+                    chained = any(
+                        e["kind"] == "span"
+                        and e["name"] == "register.pipeline"
+                        and e.get("parent_id") in repairs
+                        and e["trace_id"] == repairs[e["parent_id"]]
+                        for e in entries
+                    )
+                    if chained:
+                        break
+                assert chained, (
+                    "no worker's flight recorder shows the session-loss → "
+                    "rebirth → re-registration span chain"
+                )
+        except BaseException:
+            # THE debuggability payoff: a failing storm leaves every
+            # worker's flight recorder on disk for the CI artifact.
+            _dump_flight_recorders(workers)
+            raise
         finally:
+            await mserver.stop()
             cache.close()
             if not cache_client.closed:
                 await cache_client.close()

@@ -328,6 +328,24 @@ PROGRAM_SEEDED_VIOLATIONS = {
             and reconnects.
             """,
     },
+    "span-name-drift": {
+        "registrar_tpu/seeded.py": """\
+            class _Recorder:
+                def event(self, name, **attrs):
+                    self.last = name
+
+
+            def note(rec):
+                rec.event("agent.ghost_step", detail=1)
+            """,
+        "docs/OBSERVABILITY.md": """\
+            # Observability
+
+            | span | meaning |
+            |------|---------|
+            | `agent.real_step` | the documented one |
+            """,
+    },
     "metric-name-drift": {
         "registrar_tpu/metrics.py": """\
             class Counter:

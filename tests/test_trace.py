@@ -1,0 +1,1039 @@
+"""ISSUE 8: the span layer, flight recorder, and introspection surface.
+
+Pins the tentpole's contracts:
+
+  * span identity + contextvar propagation across tasks, head sampling,
+    the recorder ring bound, slow-span warnings with the parent chain;
+  * the ZK client's per-op spans: queue-vs-wire split (submit →
+    flushed → reply), op/xid tagging, no leaked in-flight spans;
+  * Histogram rendering/quantiles and instrument_tracing's routing;
+  * GET /status and GET /debug/trace shapes, the 405 + header-bytes
+    hardening, and the daemon-wired end-to-end (in-process run());
+  * SIGUSR2 dump + jlog trace-correlation against the real daemon
+    binary (subprocess);
+  * **tracing-disabled parity**: with no `observability` block, zero
+    new log fields, zero new metric series, zero new wire operations —
+    byte-identical to the untraced daemon;
+  * the session-loss → rebirth → re-registration span chain the chaos
+    storm's flight-recorder dump must carry (deterministic single-server
+    variant here; the seeded storm rider lives in tests/test_chaos.py).
+"""
+
+import asyncio
+import json
+import logging
+import os
+import signal
+import socket
+import subprocess
+import sys
+
+import pytest
+
+from registrar_tpu import binderview, jlog, trace
+from registrar_tpu.agent import register_plus
+from registrar_tpu.config import ConfigError, parse_config
+from registrar_tpu.metrics import (
+    MAX_HEADER_BYTES,
+    Histogram,
+    MetricsRegistry,
+    MetricsServer,
+    instrument,
+    instrument_tracing,
+)
+from registrar_tpu.registration import register
+from registrar_tpu.retry import RetryPolicy
+from registrar_tpu.testing.server import ZKServer
+from registrar_tpu.trace import DISABLED, NOOP_SPAN, TraceContextFilter, Tracer
+from registrar_tpu.zk.client import ZKClient
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+HOSTNAME = socket.gethostname()
+
+
+async def _http_get(host, port, path, method="GET", extra_headers=b""):
+    reader, writer = await asyncio.open_connection(host, port)
+    writer.write(
+        f"{method} {path} HTTP/1.0\r\nHost: {host}\r\n".encode()
+        + extra_headers
+        + b"\r\n"
+    )
+    await writer.drain()
+    try:
+        raw = await asyncio.wait_for(reader.read(), timeout=5)
+    except ConnectionResetError:
+        raw = b""
+    writer.close()
+    head, _, body = raw.partition(b"\r\n\r\n")
+    status = int(head.split()[1]) if head else 0
+    return status, head.decode("latin-1", "replace"), body
+
+
+def _spans(tracer, name=None):
+    entries = tracer.dump()["entries"]
+    return [
+        e for e in entries
+        if e["kind"] == "span" and (name is None or e["name"] == name)
+    ]
+
+
+def _events(tracer, name=None):
+    entries = tracer.dump()["entries"]
+    return [
+        e for e in entries
+        if e["kind"] == "event" and (name is None or e["name"] == name)
+    ]
+
+
+class TestSpans:
+    async def test_identity_and_nesting(self):
+        tracer = Tracer()
+        with tracer.span("outer.op", who="x") as outer:
+            with tracer.span("inner.op") as inner:
+                assert inner.trace_id == outer.trace_id
+                assert inner.parent_id == outer.span_id
+                assert inner.span_id != outer.span_id
+        entries = tracer.dump()["entries"]
+        assert [e["name"] for e in entries] == ["inner.op", "outer.op"]
+        assert entries[1]["attrs"] == {"who": "x"}
+        assert entries[0]["parent_id"] == entries[1]["span_id"]
+        assert entries[0]["duration_ms"] is not None
+        assert entries[0]["status"] == "ok"
+
+    async def test_context_propagates_across_tasks(self):
+        # asyncio.create_task copies the context, so spans opened inside
+        # a spawned task chain to the span active at spawn time — the
+        # agent's repair task parenting, in miniature.
+        tracer = Tracer()
+
+        async def child() -> None:
+            with tracer.span("child.op"):
+                await asyncio.sleep(0)
+
+        with tracer.span("parent.op") as parent:
+            await asyncio.gather(
+                asyncio.create_task(child()), asyncio.create_task(child())
+            )
+        children = _spans(tracer, "child.op")
+        assert len(children) == 2
+        for c in children:
+            assert c["trace_id"] == parent.trace_id
+            assert c["parent_id"] == parent.span_id
+
+    async def test_exception_marks_error_and_propagates(self):
+        tracer = Tracer()
+        with pytest.raises(RuntimeError):
+            with tracer.span("will.fail"):
+                raise RuntimeError("boom")
+        (span,) = _spans(tracer, "will.fail")
+        assert span["status"] == "error"
+        assert "boom" in span["attrs"]["err"]
+
+    async def test_finish_is_idempotent(self):
+        tracer = Tracer()
+        span = tracer.start_span("manual.op")
+        span.finish("error", err=-4)
+        span.finish("ok")  # late duplicate: first verdict stands
+        (entry,) = _spans(tracer, "manual.op")
+        assert entry["status"] == "error"
+        assert len(_spans(tracer)) == 1
+
+    async def test_sampling_zero_records_nothing(self):
+        tracer = Tracer(sample_rate=0.0)
+        sink_calls = []
+        tracer.on_span(sink_calls.append)
+        with tracer.span("root.op") as root:
+            assert not root.sampled
+            with tracer.span("child.op") as child:
+                # the verdict is inherited, not re-rolled per child
+                assert not child.sampled
+                # ids still exist: log correlation works unsampled
+                assert child.trace_id == root.trace_id
+        assert tracer.dump()["entries"] == []
+        assert sink_calls == []
+
+    async def test_event_in_unsampled_trace_is_dropped(self):
+        # The head-based verdict covers the whole trace, events
+        # included — otherwise a low sampleRate still lets a churning
+        # path's events evict the rare sampled spans from the ring.
+        tracer = Tracer(sample_rate=0.0)
+        with tracer.span("root.op"):
+            tracer.event("inside.event")
+        tracer.event("outside.event")  # no trace: no verdict to inherit
+        entries = tracer.dump()["entries"]
+        assert [e["name"] for e in entries] == ["outside.event"]
+        assert tracer.events_recorded == 1
+
+    async def test_ring_bound_and_counters(self):
+        tracer = Tracer(max_spans=10)
+        for i in range(50):
+            with tracer.span("ring.op", i=i):
+                pass
+        dump = tracer.dump()
+        assert len(dump["entries"]) == 10
+        assert dump["spans_recorded"] == 50
+        assert [e["attrs"]["i"] for e in dump["entries"]] == list(
+            range(40, 50)
+        )
+        assert len(tracer.dump(3)["entries"]) == 3
+
+    async def test_slow_span_warns_with_parent_chain(self, caplog):
+        tracer = Tracer(slow_span_ms=0.0)  # every span is "slow"
+        with caplog.at_level(logging.WARNING, "registrar_tpu.trace"):
+            with tracer.span("slow.outer"):
+                with tracer.span("slow.inner"):
+                    pass
+        records = [r for r in caplog.records if "slow span" in r.message]
+        assert records, caplog.text
+        chains = [r.zdata["chain"] for r in records]
+        assert ["slow.outer", "slow.inner"] in chains
+
+    async def test_cross_tracer_spans_do_not_chain(self):
+        # A privately-traced cache under a globally-traced caller must
+        # not write parent ids another recorder owns.
+        a, b = Tracer(), Tracer()
+        with a.span("a.root"):
+            with b.span("b.root") as inner:
+                assert inner.parent_id is None
+
+    async def test_event_carries_active_trace_id(self):
+        tracer = Tracer()
+        tracer.event("lonely.event", detail=1)
+        with tracer.span("evt.parent") as span:
+            tracer.event("attached.event")
+        lonely = _events(tracer, "lonely.event")[0]
+        attached = _events(tracer, "attached.event")[0]
+        assert lonely["trace_id"] is None
+        assert attached["trace_id"] == span.trace_id
+
+    async def test_dump_to_file(self, tmp_path):
+        tracer = Tracer()
+        with tracer.span("dumped.op"):
+            pass
+        path = tracer.dump_to_file(str(tmp_path / "dump.json"))
+        payload = json.loads(open(path, encoding="utf-8").read())
+        assert payload["enabled"] is True
+        assert payload["pid"] == os.getpid()
+        assert [e["name"] for e in payload["entries"]] == ["dumped.op"]
+
+
+class TestQueueWireSplit:
+    """The ZK client's per-request spans against the testing server."""
+
+    async def test_op_spans_tag_op_xid_and_split(self):
+        server = await ZKServer().start()
+        client = await ZKClient([server.address]).connect()
+        client.tracer = Tracer()
+        try:
+            await client.create("/qw", b"x")
+            await client.get("/qw")
+            await client.exists("/missing-qw")
+        finally:
+            await client.close()
+            await server.stop()
+        by_op = {e["attrs"]["op"]: e for e in _spans(client.tracer, "zk.op")}
+        assert set(by_op) >= {"create", "getData", "exists"}
+        for entry in by_op.values():
+            assert isinstance(entry["attrs"]["xid"], int)
+            assert entry["duration_ms"] is not None
+            # the queue/wire split: flushed is stamped between submit
+            # and reply, so 0 <= queue <= total
+            assert 0 <= entry["marks"]["flushed"] <= entry["duration_ms"]
+        assert by_op["create"]["status"] == "ok"
+        # NO_NODE is an error verdict carrying the code
+        assert by_op["exists"]["status"] == "error"
+        assert by_op["exists"]["attrs"]["err"] == -101
+
+    async def test_pipelined_burst_spans_every_request(self):
+        server = await ZKServer().start()
+        client = await ZKClient([server.address]).connect()
+        client.tracer = Tracer(max_spans=4096)
+        try:
+            await client.mkdirp("/burst")
+            paths = [f"/burst/e{i}" for i in range(20)]
+            for p in paths:
+                await client.create(p, b"")
+            client.tracer = Tracer(max_spans=4096)  # reset the recorder
+            await client.heartbeat(paths)
+        finally:
+            await client.close()
+            await server.stop()
+        exists_spans = [
+            e for e in _spans(client.tracer, "zk.op")
+            if e["attrs"]["op"] == "exists"
+        ]
+        assert len(exists_spans) == 20
+        assert all("flushed" in e["marks"] for e in exists_spans)
+        # one drain for the burst: every span carries the mark (the
+        # split is per-request even when the flush is shared)
+        assert client._op_spans == {}  # nothing leaked in flight
+
+    async def test_teardown_fails_inflight_spans(self):
+        server = await ZKServer().start()
+        client = await ZKClient([server.address], reconnect=False).connect()
+        client.tracer = Tracer()
+        try:
+            # Stall the server's reply path by posting to a server we
+            # stop before it can answer: the teardown must close the
+            # span with the CONNECTION_LOSS verdict, not leak it.
+            await server.stop()
+            with pytest.raises(Exception):
+                await asyncio.wait_for(client.get("/x"), timeout=5)
+        finally:
+            await client.close()
+        spans = _spans(client.tracer, "zk.op")
+        if spans:  # the post may fail before a span is minted — either
+            # way nothing stays in flight
+            assert all(e["status"] == "error" for e in spans)
+        assert client._op_spans == {}
+
+
+class TestDisabledParity:
+    """Default OFF = reference parity: zero new ops, fields, series."""
+
+    async def test_module_default_is_disabled(self):
+        assert trace.get_tracer() is DISABLED
+        assert trace.get_tracer().span("any.name") is NOOP_SPAN
+        assert trace.get_tracer().dump() == {"enabled": False, "entries": []}
+        # the no-op span is reusable and inert
+        with NOOP_SPAN as sp:
+            sp.mark("flushed")
+            sp.set_attr("k", "v")
+            sp.finish()
+
+    async def test_zero_new_wire_ops_and_no_span_state(self):
+        # Identical workloads traced and untraced must issue identical
+        # request streams (xid counters equal) — tracing observes ops,
+        # it must never add them.
+        async def workload(tracer):
+            server = await ZKServer().start()
+            client = await ZKClient([server.address]).connect()
+            if tracer is not None:
+                client.tracer = tracer
+            try:
+                await register(
+                    client, {"domain": "parity.test.us", "type": "host"},
+                    admin_ip="10.0.0.9", hostname="pbox", settle_delay=0,
+                )
+                await binderview.resolve(client, "pbox.parity.test.us", "A")
+                return client._xid
+            finally:
+                await client.close()
+                await server.stop()
+
+        untraced_xid = await workload(None)
+        traced_xid = await workload(Tracer())
+        assert untraced_xid == traced_xid
+
+    async def test_jlog_has_no_trace_fields_without_filter(self):
+        logger = logging.getLogger("parity.jlog.test")
+        formatter = jlog.BunyanFormatter("registrar")
+        tracer = Tracer()
+        with tracer.span("active.span"):
+            record = logger.makeRecord(
+                logger.name, logging.INFO, "f.py", 1, "hello", (), None
+            )
+            line = json.loads(formatter.format(record))
+        assert "trace_id" not in line and "span_id" not in line
+
+    async def test_jlog_correlates_with_filter_inside_span(self):
+        logger = logging.getLogger("correlated.jlog.test")
+        formatter = jlog.BunyanFormatter("registrar")
+        filt = TraceContextFilter()
+        tracer = Tracer()
+        trace.set_tracer(tracer)
+        try:
+            with tracer.span("active.span") as span:
+                record = logger.makeRecord(
+                    logger.name, logging.INFO, "f.py", 1, "hello", (), None
+                )
+                filt.filter(record)
+                line = json.loads(formatter.format(record))
+            assert line["trace_id"] == span.trace_id
+            assert line["span_id"] == span.span_id
+            # outside any span: the filter stamps nothing
+            record = logger.makeRecord(
+                logger.name, logging.INFO, "f.py", 1, "bye", (), None
+            )
+            filt.filter(record)
+            line = json.loads(formatter.format(record))
+            assert "trace_id" not in line
+        finally:
+            trace.set_tracer(None)
+
+
+class TestHistogram:
+    def test_buckets_render_cumulative_with_sum_and_count(self):
+        h = Histogram("t_seconds", "help", buckets=(0.1, 1.0))
+        h.observe(0.05)
+        h.observe(0.5)
+        h.observe(5.0)
+        text = "\n".join(h.render())
+        assert 't_seconds_bucket{le="0.1"} 1' in text
+        assert 't_seconds_bucket{le="1"} 2' in text
+        assert 't_seconds_bucket{le="+Inf"} 3' in text
+        assert "t_seconds_count 3" in text
+        assert "t_seconds_sum 5.55" in text
+        # the bare family name never renders as a series
+        assert "\nt_seconds " not in f"\n{text}"
+
+    def test_labels_render_independent_series(self):
+        h = Histogram("l_seconds", "help", buckets=(1.0,))
+        h.observe(0.5, labels={"op": "a"})
+        h.observe(2.0, labels={"op": "b"})
+        text = "\n".join(h.render())
+        assert 'l_seconds_bucket{op="a",le="1"} 1' in text
+        assert 'l_seconds_bucket{op="b",le="1"} 0' in text
+        assert 'l_seconds_count{op="b"} 1' in text
+
+    def test_preseed_creates_zero_series(self):
+        reg = MetricsRegistry()
+        h = reg.histogram("p_seconds", "help", buckets=(1.0,))
+        h.preseed({"op": "create"})
+        text = reg.render()
+        assert 'p_seconds_bucket{op="create",le="+Inf"} 0' in text
+        assert 'p_seconds_count{op="create"} 0' in text
+
+    def test_quantile_interpolates(self):
+        h = Histogram("q_seconds", "h", buckets=(1.0, 2.0, 4.0))
+        for v in (0.5, 1.5, 1.5, 3.0):
+            h.observe(v)
+        assert h.quantile(0.0) == 0.0
+        # p50: rank 2 falls in the (1, 2] bucket
+        assert 1.0 <= h.quantile(0.5) <= 2.0
+        assert 2.0 <= h.quantile(1.0) <= 4.0
+        assert h.quantile(0.5, labels={"op": "x"}) is None
+        with pytest.raises(ValueError):
+            h.quantile(1.5)
+
+    def test_exactly_bucket_boundary_counts_inclusive(self):
+        h = Histogram("b_seconds", "h", buckets=(0.1, 1.0))
+        h.observe(0.1)
+        text = "\n".join(h.render())
+        assert 'b_seconds_bucket{le="0.1"} 1' in text
+
+    async def test_instrument_tracing_routes_span_names(self):
+        tracer = Tracer()
+        reg = instrument_tracing(tracer, MetricsRegistry())
+        with tracer.span("zk.op", op="create", xid=1):
+            pass
+        with tracer.span("resolve.query", qtype="A", source="cached"):
+            pass
+        with tracer.span("health.exec", command="true"):
+            pass
+        with tracer.span("reconcile.sweep"):
+            pass
+        with tracer.span("unrouted.name"):
+            pass
+        zk_op = reg.get("registrar_zk_op_seconds")
+        assert zk_op.count({"op": "create"}) == 1
+        assert reg.get("registrar_resolve_seconds").count(
+            {"source": "cached"}
+        ) == 1
+        assert reg.get("registrar_health_exec_seconds").count() == 1
+        assert reg.get("registrar_reconcile_sweep_seconds").count() == 1
+        # pre-seeded series exist before traffic
+        text = reg.render()
+        assert 'registrar_zk_op_seconds_count{op="delete"} 0' in text
+        assert 'registrar_resolve_seconds_count{source="live"} 0' in text
+
+    async def test_instrument_stands_down_its_sweep_gauge(self, caplog):
+        # With the histogram registered first (tracing on), instrument()
+        # must not collide on the family name — and without it the
+        # last-value gauge renders exactly as before (parity).
+        server = await ZKServer().start()
+        client = await ZKClient([server.address]).connect()
+        try:
+            ee = register_plus(
+                client, {"domain": "h.test.us", "type": "host"},
+                admin_ip="10.0.0.1", hostname="hbox", settle_delay=0.01,
+            )
+            tracer = Tracer()
+            reg = instrument_tracing(tracer, MetricsRegistry())
+            instrument(ee, client, reg)  # must not raise duplicate
+            await ee.wait_for("register", timeout=10)
+            with caplog.at_level(logging.ERROR, "registrar_tpu.events"):
+                ee.emit(
+                    "reconcile", {"duration": 0.5, "drift": 0, "repaired": 0}
+                )
+            # The sweep handler must not blow up against the Histogram
+            # (emit swallows listener exceptions into this log — a
+            # regression here is invisible without the assertion) and
+            # the sweeps counter still counts.
+            assert not [
+                r for r in caplog.records if "listener" in r.message
+            ], caplog.text
+            text = reg.render()
+            assert "registrar_reconcile_sweeps_total 1" in text
+            # histogram series, not the bare gauge sample
+            assert "registrar_reconcile_sweep_seconds_bucket" in text
+            assert "\nregistrar_reconcile_sweep_seconds 0.5" not in text
+            ee.stop()
+        finally:
+            await client.close()
+            await server.stop()
+
+
+class TestEndpoints:
+    async def test_status_endpoint_shape(self):
+        async def provider():
+            return {"session": {"id": "0x1"}, "ok": True}
+
+        server = await MetricsServer(
+            MetricsRegistry(), status_provider=provider
+        ).start()
+        try:
+            status, head, body = await _http_get(
+                server.host, server.port, "/status"
+            )
+            assert status == 200
+            assert "application/json" in head
+            assert json.loads(body) == {"session": {"id": "0x1"}, "ok": True}
+        finally:
+            await server.stop()
+
+    async def test_status_provider_error_still_answers(self):
+        async def provider():
+            raise RuntimeError("introspection broke")
+
+        server = await MetricsServer(
+            MetricsRegistry(), status_provider=provider
+        ).start()
+        try:
+            status, _, body = await _http_get(
+                server.host, server.port, "/status"
+            )
+            assert status == 200
+            assert "introspection broke" in json.loads(body)["error"]
+        finally:
+            await server.stop()
+
+    async def test_debug_trace_endpoint_passes_n(self):
+        seen = []
+
+        def provider(n):
+            seen.append(n)
+            return {"enabled": True, "entries": []}
+
+        server = await MetricsServer(
+            MetricsRegistry(), trace_provider=provider
+        ).start()
+        try:
+            status, _, body = await _http_get(
+                server.host, server.port, "/debug/trace?n=7"
+            )
+            assert status == 200
+            assert json.loads(body)["enabled"] is True
+            await _http_get(server.host, server.port, "/debug/trace")
+            await _http_get(server.host, server.port, "/debug/trace?n=bogus")
+            assert seen == [7, None, None]
+        finally:
+            await server.stop()
+
+    async def test_unwired_endpoints_404(self):
+        server = await MetricsServer(MetricsRegistry()).start()
+        try:
+            for path in ("/status", "/debug/trace"):
+                status, _, _ = await _http_get(server.host, server.port, path)
+                assert status == 404, path
+        finally:
+            await server.stop()
+
+    async def test_non_get_on_known_paths_is_405_with_allow(self):
+        async def provider():
+            return {}
+
+        server = await MetricsServer(
+            MetricsRegistry(),
+            status_provider=provider,
+            trace_provider=lambda n: {"enabled": False, "entries": []},
+        ).start()
+        try:
+            for path in ("/metrics", "/status", "/debug/trace"):
+                for method in ("POST", "PUT", "DELETE", "HEAD"):
+                    status, head, _ = await _http_get(
+                        server.host, server.port, path, method=method
+                    )
+                    assert status == 405, (method, path)
+                    assert "Allow: GET" in head
+            # unknown path keeps its 404, whatever the method
+            status, _, _ = await _http_get(
+                server.host, server.port, "/nope", method="POST"
+            )
+            assert status == 404
+        finally:
+            await server.stop()
+
+    async def test_header_byte_flood_dropped(self):
+        reg = MetricsRegistry()
+        reg.counter("alive_total", "h").inc(1)
+        server = await MetricsServer(reg).start()
+        try:
+            # Many modest header lines, together far past the bound:
+            # the per-line limit never trips, the total-bytes bound must.
+            flood = b"".join(
+                b"X-Pad-%d: " % i + b"A" * 1024 + b"\r\n" for i in range(64)
+            )
+            assert len(flood) > MAX_HEADER_BYTES
+            status, _, body = await _http_get(
+                server.host, server.port, "/metrics", extra_headers=flood
+            )
+            assert status == 0 and body == b""  # dropped, no response owed
+            # ...and the server is still alive for honest clients
+            status, _, body = await _http_get(
+                server.host, server.port, "/metrics"
+            )
+            assert status == 200 and b"alive_total 1" in body
+        finally:
+            await server.stop()
+
+    async def test_modest_headers_still_fine(self):
+        server = await MetricsServer(MetricsRegistry()).start()
+        try:
+            headers = b"User-Agent: prom/2.0\r\nAccept: text/plain\r\n"
+            status, _, _ = await _http_get(
+                server.host, server.port, "/metrics", extra_headers=headers
+            )
+            assert status == 200
+        finally:
+            await server.stop()
+
+
+def _daemon_cfg(server, port, observability=None, **over):
+    cfg = {
+        "registration": {
+            "domain": "traced.test.us",
+            "type": "host",
+            "heartbeatInterval": 100,
+        },
+        "adminIp": "10.7.7.7",
+        "zookeeper": {
+            "servers": [{"host": server.host, "port": server.port}],
+            "timeout": 8000,
+        },
+        "metrics": {"port": port},
+    }
+    if observability is not None:
+        cfg["observability"] = observability
+    cfg.update(over)
+    return cfg
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+async def _poll_http(port, path, pred, timeout=20):
+    deadline = asyncio.get_running_loop().time() + timeout
+    last = None
+    while asyncio.get_running_loop().time() < deadline:
+        try:
+            status, _, body = await _http_get("127.0.0.1", port, path)
+            last = (status, body)
+            if status == 200 and pred(body):
+                return body
+        except OSError:
+            pass
+        await asyncio.sleep(0.1)
+    raise AssertionError(f"{path} never satisfied predicate (last={last})")
+
+
+class TestDaemonEndToEnd:
+    async def test_traced_daemon_serves_status_trace_and_histograms(self):
+        from registrar_tpu.main import run
+
+        port = _free_port()
+        server = await ZKServer().start()
+        cfg = parse_config(_daemon_cfg(
+            server, port,
+            observability={"sampleRate": 1.0, "flightRecorderSpans": 256},
+            reconcile={"intervalSeconds": 0.2, "repair": False},
+        ))
+        task = asyncio.create_task(run(cfg, _exit=lambda code: None))
+        try:
+            body = await _poll_http(
+                port, "/metrics",
+                lambda b: b"registrar_registrations_total 1" in b,
+            )
+            # the tracing histograms exist and saw the pipeline's ops
+            assert b'registrar_zk_op_seconds_bucket{op="create"' in body
+            assert b"registrar_reconcile_sweep_seconds_bucket" in body
+
+            status_body = await _poll_http(
+                port, "/status",
+                lambda b: json.loads(b)["registration"]["registered"],
+            )
+            snapshot = json.loads(status_body)
+            assert snapshot["session"]["connected"] is True
+            assert snapshot["session"]["id"].startswith("0x")
+            (znode,) = snapshot["registration"]["znodes"]
+            assert znode["path"].endswith(HOSTNAME)
+            assert isinstance(znode["mzxid"], int)
+            assert snapshot["config"]["fingerprint"]
+            assert snapshot["observability"]["enabled"] is True
+            assert snapshot["health"] == {
+                "configured": False, "down": False, "checkerDown": False,
+            }
+
+            trace_body = await _poll_http(
+                port, "/debug/trace?n=500",
+                lambda b: json.loads(b)["enabled"],
+            )
+            dump = json.loads(trace_body)
+            names = {e["name"] for e in dump["entries"]}
+            assert "register.pipeline" in names
+            assert "zk.op" in names
+        finally:
+            task.cancel()
+            try:
+                await task
+            except asyncio.CancelledError:
+                pass
+            await server.stop()
+        # the daemon restored the module default on the way out
+        assert trace.get_tracer() is DISABLED
+
+    async def test_untraced_daemon_metric_output_is_parity(self):
+        from registrar_tpu.main import run
+
+        port = _free_port()
+        server = await ZKServer().start()
+        cfg = parse_config(_daemon_cfg(server, port))  # no observability
+        task = asyncio.create_task(run(cfg, _exit=lambda code: None))
+        try:
+            body = await _poll_http(
+                port, "/metrics",
+                lambda b: b"registrar_registrations_total 1" in b,
+            )
+            # zero new series without the block
+            assert b"registrar_zk_op_seconds" not in body
+            assert b"registrar_resolve_seconds" not in body
+            assert b"registrar_health_exec_seconds" not in body
+            # the sweep gauge is still the plain gauge
+            assert b"# TYPE registrar_reconcile_sweep_seconds gauge" in body
+            # /debug/trace answers honestly: tracing is off
+            status, _, tbody = await _http_get(
+                "127.0.0.1", port, "/debug/trace"
+            )
+            assert status == 200
+            assert json.loads(tbody)["enabled"] is False
+            assert trace.get_tracer() is DISABLED
+        finally:
+            task.cancel()
+            try:
+                await task
+            except asyncio.CancelledError:
+                pass
+            await server.stop()
+
+
+class TestRebirthChain:
+    async def test_recorder_carries_loss_rebirth_reregistration_chain(self):
+        # The deterministic single-server version of the chaos storm's
+        # flight-recorder acceptance: force one expiry, watch the whole
+        # recovery arc land in the ring as a connected span chain.
+        server = await ZKServer().start()
+        client = await ZKClient(
+            [server.address],
+            survive_session_expiry=True,
+            reconnect_policy=RetryPolicy(
+                max_attempts=float("inf"), initial_delay=0.02, max_delay=0.1
+            ),
+        ).connect()
+        client.tracer = Tracer(max_spans=4096)
+        try:
+            ee = register_plus(
+                client, {"domain": "chain.test.us", "type": "host"},
+                admin_ip="10.0.0.5", hostname="cbox",
+                heartbeat_interval=60, settle_delay=0.01,
+            )
+            await ee.wait_for("register", timeout=10)
+            rereg = asyncio.ensure_future(ee.wait_for("register", timeout=10))
+            await server.expire_session(client.session_id)
+            await rereg
+            entries = client.tracer.dump()["entries"]
+            names = {e["name"] for e in entries}
+            assert {"zk.session_lost", "zk.session_reborn"} <= names
+            repairs = {
+                e["span_id"]: e["trace_id"]
+                for e in entries
+                if e["kind"] == "span" and e["name"] == "agent.repair"
+            }
+            assert any(
+                e["kind"] == "span"
+                and e["name"] == "register.pipeline"
+                and e.get("parent_id") in repairs
+                for e in entries
+            ), names
+            ee.stop()
+        finally:
+            await client.close()
+            await server.stop()
+
+
+def _spawn_daemon(cfg_path, env_extra=None):
+    return subprocess.Popen(
+        [sys.executable, "-m", "registrar_tpu", "-f", str(cfg_path)],
+        cwd=REPO, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        env={**os.environ, "PYTHONPATH": REPO,
+             "LOG_LEVEL": "debug", **(env_extra or {})},
+    )
+
+
+class TestSigusr2Subprocess:
+    async def test_sigusr2_dumps_flight_recorder_and_logs_correlate(
+        self, tmp_path
+    ):
+        server = await ZKServer().start()
+        observer = await ZKClient([server.address]).connect()
+        dump_path = tmp_path / "flight.json"
+        cfg_path = tmp_path / "config.json"
+        cfg_path.write_text(json.dumps(_daemon_cfg(
+            server, _free_port(),
+            observability={"sampleRate": 1.0,
+                           "dumpPath": str(dump_path)},
+        )))
+        proc = None
+        try:
+            proc = _spawn_daemon(cfg_path)
+            deadline = asyncio.get_running_loop().time() + 20
+            while (await observer.exists("/us/test/traced")) is None:
+                assert asyncio.get_running_loop().time() < deadline
+                await asyncio.sleep(0.1)
+            proc.send_signal(signal.SIGUSR2)
+            deadline = asyncio.get_running_loop().time() + 10
+            while not dump_path.exists():
+                assert asyncio.get_running_loop().time() < deadline, (
+                    "SIGUSR2 produced no dump file"
+                )
+                await asyncio.sleep(0.1)
+            # the dump may still be mid-write on slow disks: poll for
+            # parseable JSON within the same deadline
+            payload = None
+            while payload is None:
+                try:
+                    payload = json.loads(dump_path.read_text())
+                except ValueError:
+                    assert asyncio.get_running_loop().time() < deadline
+                    await asyncio.sleep(0.1)
+            assert payload["enabled"] is True
+            assert "register.pipeline" in {
+                e["name"] for e in payload["entries"]
+            }
+        finally:
+            if proc is not None:
+                proc.terminate()
+                out = proc.stdout.read().decode()
+                proc.wait(15)
+            await observer.close()
+            await server.stop()
+        # jlog correlation, end to end: debug lines logged inside spans
+        # carry trace_id/span_id; the dump confirmation line is plain.
+        traced_lines = []
+        for line in out.splitlines():
+            try:
+                record = json.loads(line)
+            except ValueError:
+                continue
+            if "trace_id" in record:
+                traced_lines.append(record)
+        assert traced_lines, "no log line carried trace correlation"
+        assert all(
+            record.get("span_id") for record in traced_lines
+        )
+        assert any("flight recorder dumped" in line for line in out.splitlines())
+
+
+class TestZkcliStatusTrace:
+    async def _run_cli(self, argv, capsys):
+        from registrar_tpu.tools.zkcli import _amain
+
+        code = await _amain(argv)
+        captured = capsys.readouterr()
+        return code, captured.out, captured.err
+
+    async def test_status_and_trace_against_live_daemon(
+        self, tmp_path, capsys
+    ):
+        from registrar_tpu.main import run
+
+        port = _free_port()
+        server = await ZKServer().start()
+        raw = _daemon_cfg(
+            server, port, observability={"sampleRate": 1.0}
+        )
+        cfg_path = tmp_path / "config.json"
+        cfg_path.write_text(json.dumps(raw))
+        cfg = parse_config(raw)
+        cfg.source_path = str(cfg_path)
+        task = asyncio.create_task(run(cfg, _exit=lambda code: None))
+        try:
+            await _poll_http(
+                port, "/status",
+                lambda b: json.loads(b)["registration"]["registered"],
+            )
+            code, out, err = await self._run_cli(
+                ["status", "-f", str(cfg_path)], capsys
+            )
+            assert code == 0, err
+            assert "healthy" in err
+            snapshot = json.loads(out)
+            assert snapshot["session"]["connected"] is True
+
+            code, out, err = await self._run_cli(
+                ["trace", "-f", str(cfg_path), "-n", "50"], capsys
+            )
+            assert code == 0, err
+            assert "register.pipeline" in out
+            assert "entries" in err
+        finally:
+            task.cancel()
+            try:
+                await task
+            except asyncio.CancelledError:
+                pass
+            await server.stop()
+
+    async def test_trace_reports_disabled_as_one(self, tmp_path, capsys):
+        from registrar_tpu.main import run
+
+        port = _free_port()
+        server = await ZKServer().start()
+        raw = _daemon_cfg(server, port)  # observability absent
+        cfg_path = tmp_path / "config.json"
+        cfg_path.write_text(json.dumps(raw))
+        cfg = parse_config(raw)
+        task = asyncio.create_task(run(cfg, _exit=lambda code: None))
+        try:
+            await _poll_http(
+                port, "/metrics",
+                lambda b: b"registrar_registrations_total 1" in b,
+            )
+            code, _out, err = await self._run_cli(
+                ["trace", "-f", str(cfg_path)], capsys
+            )
+            assert code == 1
+            assert "disabled" in err
+        finally:
+            task.cancel()
+            try:
+                await task
+            except asyncio.CancelledError:
+                pass
+            await server.stop()
+
+    async def test_unreachable_and_missing_metrics_block_exit_2(
+        self, tmp_path, capsys
+    ):
+        # no metrics block at all
+        cfg_path = tmp_path / "nometrics.json"
+        cfg_path.write_text(json.dumps({
+            "registration": {"domain": "x.test.us", "type": "host"},
+            "zookeeper": {"servers": [{"host": "127.0.0.1", "port": 1}]},
+        }))
+        for cmd in ("status", "trace"):
+            code, _out, err = await self._run_cli(
+                [cmd, "-f", str(cfg_path)], capsys
+            )
+            assert code == 2
+            assert "metrics" in err
+        # metrics block present but nothing listening
+        cfg_path2 = tmp_path / "dead.json"
+        cfg_path2.write_text(json.dumps({
+            "registration": {"domain": "x.test.us", "type": "host"},
+            "zookeeper": {"servers": [{"host": "127.0.0.1", "port": 1}]},
+            "metrics": {"port": _free_port()},
+        }))
+        for cmd in ("status", "trace"):
+            code, _out, _err = await self._run_cli(
+                [cmd, "-f", str(cfg_path2), "--timeout", "1"], capsys
+            )
+            assert code == 2
+
+    async def test_status_degraded_exits_one(self, capsys, tmp_path):
+        # A snapshot reporting a disconnected, unregistered instance
+        # must exit 1 with the reasons named.
+        async def provider():
+            return {
+                "session": {"connected": False, "state": "disconnected"},
+                "registration": {"registered": False, "znodes": []},
+                "health": {"down": True},
+                "reconcile": {"lastSweep": {"drift": 3}},
+            }
+
+        mserver = await MetricsServer(
+            MetricsRegistry(), status_provider=provider
+        ).start()
+        try:
+            cfg_path = tmp_path / "degraded.json"
+            cfg_path.write_text(json.dumps({
+                "registration": {"domain": "x.test.us", "type": "host"},
+                "zookeeper": {"servers": [{"host": "127.0.0.1", "port": 1}]},
+                "metrics": {"port": mserver.port},
+            }))
+            code, _out, err = await self._run_cli(
+                ["status", "-f", str(cfg_path)], capsys
+            )
+            assert code == 1
+            assert "DEGRADED" in err
+            for reason in ("disconnected", "not registered", "health-down",
+                           "drift=3"):
+                assert reason in err
+        finally:
+            await mserver.stop()
+
+
+class TestObservabilityConfig:
+    def _base(self, observability):
+        return {
+            "registration": {"domain": "c.test.us", "type": "host"},
+            "zookeeper": {"servers": [{"host": "h", "port": 1}]},
+            "observability": observability,
+        }
+
+    def test_defaults(self):
+        cfg = parse_config(self._base({}))
+        obs = cfg.observability
+        assert obs.sample_rate == 1.0
+        assert obs.slow_span_ms == 1500.0
+        assert obs.flight_recorder_spans == 1024
+        assert obs.dump_path is None
+
+    def test_absent_block_is_none(self):
+        raw = self._base({})
+        del raw["observability"]
+        assert parse_config(raw).observability is None
+
+    def test_explicit_values(self):
+        cfg = parse_config(self._base({
+            "sampleRate": 0.25, "slowSpanMs": 50,
+            "flightRecorderSpans": 16, "dumpPath": "/tmp/t.json",
+        }))
+        obs = cfg.observability
+        assert obs.sample_rate == 0.25
+        assert obs.slow_span_ms == 50.0
+        assert obs.flight_recorder_spans == 16
+        assert obs.dump_path == "/tmp/t.json"
+
+    def test_slow_span_null_disables(self):
+        cfg = parse_config(self._base({"slowSpanMs": None}))
+        assert cfg.observability.slow_span_ms is None
+
+    @pytest.mark.parametrize("bad", [
+        {"sampleRate": -0.1}, {"sampleRate": 1.1}, {"sampleRate": "1"},
+        {"sampleRate": True}, {"slowSpanMs": 0}, {"slowSpanMs": "fast"},
+        {"flightRecorderSpans": 0}, {"flightRecorderSpans": 1.5},
+        {"flightRecorderSpans": True}, {"dumpPath": ""}, {"dumpPath": 7},
+        "not-an-object",
+    ])
+    def test_invalid_blocks_rejected(self, bad):
+        with pytest.raises(ConfigError):
+            parse_config(self._base(bad))
+
+    def test_tracer_rejects_bad_args(self):
+        with pytest.raises(ValueError):
+            Tracer(sample_rate=1.5)
+        with pytest.raises(ValueError):
+            Tracer(max_spans=0)

@@ -211,15 +211,33 @@ OPERATIONS_DOC = "docs/OPERATIONS.md"
 _METRIC_REF = re.compile(r"registrar_[a-z0-9_]*")
 
 
+#: rendered-series suffixes a histogram FAMILY name implies: the bare
+#: family never renders, so a runbook legitimately references only
+#: these (`rate(registrar_zk_op_seconds_count[5m])`,
+#: `histogram_quantile(0.99, ...registrar_zk_op_seconds_bucket...)`)
+HISTOGRAM_SUFFIXES = ("_bucket", "_sum", "_count")
+
+
 def _defined_metric_names(tree) -> Set[str]:
     """String literals passed as CALL arguments in metrics.py — the
     ``Counter("registrar_x_total", ...)`` constructor surface.  The
     module docstring also lists every name, but a docstring can go
-    stale exactly like the runbook; only real constructor args count."""
+    stale exactly like the runbook; only real constructor args count.
+
+    A ``Histogram`` (``reg.histogram(...)`` / ``Histogram(...)``)
+    constructor additionally defines its rendered ``_bucket``/``_sum``/
+    ``_count`` series — the bare family name never appears in the
+    exposition, so those suffixed forms are what runbooks reference."""
     out: Set[str] = set()
     for node in ast.walk(tree):
         if not isinstance(node, ast.Call):
             continue
+        func = node.func
+        func_name = (
+            func.attr
+            if isinstance(func, ast.Attribute)
+            else getattr(func, "id", "")
+        )
         for arg in list(node.args) + [kw.value for kw in node.keywords]:
             if (
                 isinstance(arg, ast.Constant)
@@ -228,6 +246,9 @@ def _defined_metric_names(tree) -> Set[str]:
                 and not arg.value.endswith("_")
             ):
                 out.add(arg.value)
+                if func_name in ("histogram", "Histogram"):
+                    for suffix in HISTOGRAM_SUFFIXES:
+                        out.add(arg.value + suffix)
     return out
 
 
@@ -277,3 +298,116 @@ def metric_name_drift(model: ProgramModel) -> Iterator[Finding]:
                     "series (a renamed counter silently kills this "
                     "alert)",
                 )
+
+
+# -- span-name-drift -----------------------------------------------------------
+
+OBSERVABILITY_DOC = "docs/OBSERVABILITY.md"
+
+#: the tracer call surface whose first string argument is a span/event
+#: name (registrar_tpu/trace.py: Tracer.span/start_span/event)
+_TRACE_CALL_NAMES = frozenset({"span", "start_span", "event"})
+
+#: span/event names are dotted lowercase tokens (``zk.op``,
+#: ``cache.invalidated``) — the dot requirement keeps unrelated
+#: single-word string call-args out of the diff entirely
+_SPAN_NAME = re.compile(r"^[a-z][a-z0-9_]*(\.[a-z0-9_]+)+$")
+
+
+def _code_span_names(model: ProgramModel):
+    """Constant span/event names at tracer call sites in the package:
+    ``{name: (rel_path, lineno)}`` (first site wins)."""
+    out: dict = {}
+    for mod in model.modules.values():
+        if not mod.rel_path.startswith("registrar_tpu/"):
+            continue
+        for node in ast.walk(mod.ctx.tree):
+            if not isinstance(node, ast.Call) or not node.args:
+                continue
+            func = node.func
+            func_name = (
+                func.attr
+                if isinstance(func, ast.Attribute)
+                else getattr(func, "id", "")
+            )
+            if func_name not in _TRACE_CALL_NAMES:
+                continue
+            arg = node.args[0]
+            if (
+                isinstance(arg, ast.Constant)
+                and isinstance(arg.value, str)
+                and _SPAN_NAME.match(arg.value)
+            ):
+                out.setdefault(arg.value, (mod.rel_path, node.lineno))
+    return out
+
+
+@rule(
+    "span-name-drift",
+    "span/event names drift between tracer call sites and the "
+    "docs/OBSERVABILITY.md catalog",
+    scope="program",
+)
+def span_name_drift(model: ProgramModel) -> Iterator[Finding]:
+    # Span names are a contract exactly like metric names: dashboards
+    # filter the flight recorder by them, the slow-span runbook greps
+    # for them, and instrument_tracing routes them into histograms by
+    # string equality — a renamed span silently empties a histogram
+    # without failing a single test.  Both directions are diffed: a
+    # code name the catalog misses is undocumented surface; a cataloged
+    # name no code emits is a dead runbook entry.
+    root = model.package_root()
+    if root is None:
+        return
+    code = _code_span_names(model)
+    if not code:
+        return  # no tracing layer in this program: nothing to diff
+    doc_path = os.path.join(root, *OBSERVABILITY_DOC.split("/"))
+    lines = read_doc_lines(doc_path)
+    if lines is None:
+        # The catalog doc is missing entirely but the code traces:
+        # anchor ONE finding per name at its call site.
+        for name, (rel, lineno) in sorted(code.items()):
+            yield Finding(
+                "span-name-drift",
+                rel,
+                lineno,
+                f"span/event name '{name}' is used in code but "
+                f"{OBSERVABILITY_DOC} (the span catalog) does not exist",
+            )
+        return
+    mentions: Set[str] = set()
+    table_names: dict = {}
+    for i, line in enumerate(lines, start=1):
+        for m in re.finditer(r"`([^`]+)`", line):
+            token = m.group(1)
+            if _SPAN_NAME.match(token):
+                mentions.add(token)
+        stripped = line.strip()
+        if not stripped.startswith("|"):
+            continue
+        cells = [c.strip() for c in stripped.strip("|").split("|")]
+        if not cells:
+            continue
+        m = re.fullmatch(r"`([^`]+)`", cells[0])
+        if m and _SPAN_NAME.match(m.group(1)):
+            table_names.setdefault(m.group(1), i)
+    for name, (rel, lineno) in sorted(code.items()):
+        if name not in mentions:
+            yield Finding(
+                "span-name-drift",
+                rel,
+                lineno,
+                f"span/event name '{name}' is used in code but never "
+                f"cataloged in {OBSERVABILITY_DOC}",
+            )
+    for name, lineno in sorted(table_names.items()):
+        if name not in code:
+            yield Finding(
+                "span-name-drift",
+                OBSERVABILITY_DOC,
+                lineno,
+                f"span/event name '{name}' is cataloged but no tracer "
+                "call site in the package uses it (renamed or removed "
+                "span?)",
+            )
