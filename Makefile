@@ -7,7 +7,7 @@ NAME = registrar
 RELEASE_TARBALL = $(NAME)-release.tar.gz
 RELSTAGEDIR = /tmp/$(NAME)-release
 
-.PHONY: all check check-core test test-jax chaos restart-e2e bench bench-cached release publish clean
+.PHONY: all check check-core test test-jax chaos restart-e2e bench bench-cached slo slo-quick release publish clean
 
 all: check test
 
@@ -29,6 +29,7 @@ check: check-core
 check-core:
 	$(PYTHON) -m compileall -q registrar_tpu tests tools bench.py __graft_entry__.py
 	$(PYTHON) bench.py --check-baseline
+	$(PYTHON) tools/slo.py --check-baseline
 	$(PYTHON) -X dev -W error -c "import registrar_tpu, registrar_tpu.main, \
 	    registrar_tpu.testing.server, registrar_tpu.testing.netem, \
 	    registrar_tpu.config, \
@@ -68,6 +69,21 @@ restart-e2e:
 
 bench:
 	$(PYTHON) bench.py
+
+# Availability-SLO simulator (ISSUE 9): a seeded fleet of in-process
+# registrars under named churn traces (every docs/FAULTS.md fault
+# class) while a resolver polls continuously; emits slo-report.json
+# (nines, per-fault MTTD/MTTR, worst outage + trace ids) and gates the
+# quick trace against SLO_BASELINE.json like the perf benches.
+# slo-quick additionally reruns the same seed with repair disabled and
+# fails unless the nines measurably drop (the detection proof).
+# SLO_SEED=<n> pins a schedule; SLO_TOLERANCE_PCT widens the gate on
+# slow hardware; SLO_GATE=0 disables it.
+slo:
+	$(PYTHON) tools/slo.py --trace full --report slo-report.json
+
+slo-quick:
+	$(PYTHON) tools/slo.py --trace quick --report slo-report.json --prove-detection
 
 # Cached-resolve slice (ISSUE 4): the zkcache coherence suite, then the
 # cached-latency/QPS/coherence-lag measurement with its in-process >=10x

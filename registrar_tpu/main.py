@@ -604,8 +604,30 @@ async def _run_traced(cfg: Config, log, tracer, *, _exit=sys.exit) -> None:
     # bits: the client's state string and the reconciler's last summary
     # are events, so a snapshot must remember them.
     status_note = {"zk_state": "connected" if zk.connected else "disconnected",
-                   "last_reconcile": None, "started": time.time()}
-    zk.on("state", lambda s: status_note.__setitem__("zk_state", s))
+                   "last_reconcile": None, "started": time.time(),
+                   "transitions": {}}
+
+    # Last-transition stamps (ISSUE 9 satellite): the wall-clock moment
+    # each slow-moving state last CHANGED — session, health, and
+    # registration — so an operator (or the SLO harness's live-daemon
+    # mode) can compute MTTR from /status alone: recovery stamp minus
+    # fault stamp, no log archaeology.
+    def _note_transition(kind: str, state: str) -> None:
+        status_note["transitions"][kind] = {
+            "state": state, "at": round(time.time(), 3),
+        }
+
+    def _on_zk_state(s) -> None:
+        status_note["zk_state"] = s
+        _note_transition("session", s)
+
+    zk.on("state", _on_zk_state)
+    ee.on("register",
+          lambda *_a: _note_transition("registration", "registered"))
+    ee.on("unregister",
+          lambda *_a: _note_transition("registration", "unregistered"))
+    ee.on("fail", lambda *_a: _note_transition("health", "down"))
+    ee.on("ok", lambda *_a: _note_transition("health", "up"))
     ee.on(
         "reconcile",
         lambda summary: status_note.__setitem__(
@@ -837,7 +859,12 @@ async def _status_snapshot(cfg: Config, zk, ee, note: dict) -> dict:
         "name": "registrar",
         "pid": os.getpid(),
         "version": __version__,
-        "uptimeSeconds": round(time.time() - note["started"], 1),
+        # uptime_s + last_transition (ISSUE 9 satellite): the MTTR-
+        # computable view — each entry is the wall stamp of the LAST
+        # session/health/registration state change (empty until the
+        # first change after startup).
+        "uptime_s": round(time.time() - note["started"], 1),
+        "last_transition": dict(note.get("transitions", {})),
         "session": {
             "id": f"0x{zk.session_id:x}",
             "state": note["zk_state"],

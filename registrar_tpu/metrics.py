@@ -69,6 +69,13 @@ without it:
                                         last-value gauge of the same
                                         name while tracing is on)
 
+:func:`instrument_slo` (ISSUE 9) exposes the availability-SLO
+harness's probe surface (:mod:`registrar_tpu.testing.slo`):
+
+    registrar_slo_probe_total{result}   availability probes, result="ok"|"fail"
+    registrar_slo_outage_seconds_total{fault}  probe-observed outage
+                                        seconds per owning fault class
+
 The MetricsServer additionally serves (ISSUE 8):
 
     GET /status        one JSON snapshot: session id/state, registration
@@ -722,6 +729,40 @@ def instrument_cache(cache, registry: Optional[MetricsRegistry] = None) -> Metri
         "Last observed write-to-invalidation-processed lag (seconds)",
     )
     lag_last.set_function(lambda: stats["coherence_lag_ms_last"] / 1000.0)
+    return reg
+
+
+def instrument_slo(harness, registry: Optional[MetricsRegistry] = None) -> MetricsRegistry:
+    """Expose the availability-SLO harness's probe counters (ISSUE 9).
+
+    ``harness`` is a :class:`registrar_tpu.testing.slo.SLOHarness` (or
+    anything with its event surface): ``probe(result)`` fires once per
+    availability sample, ``outage(fault, seconds)`` once per attributed
+    merged outage window at report time.  Both label sets are
+    pre-seeded — results from the two probe verdicts, fault classes
+    from the harness's docs/FAULTS.md catalog ids — so every series
+    exists from the first scrape (the registry's convention).
+    """
+    reg = registry if registry is not None else MetricsRegistry()
+    probes = reg.counter(
+        "registrar_slo_probe_total",
+        "Availability probes by result (ok = the live Binder answer "
+        "carried the full fleet)",
+    )
+    for result in ("ok", "fail"):
+        probes.inc(0, labels={"result": result})
+    outage = reg.counter(
+        "registrar_slo_outage_seconds_total",
+        "Probe-observed outage seconds by the fault class owning the "
+        "merged window (overlapping faults never double-count)",
+    )
+    for fault in getattr(harness, "fault_ids", ()):
+        outage.inc(0, labels={"fault": fault})
+    harness.on("probe", lambda result: probes.inc(labels={"result": result}))
+    harness.on(
+        "outage",
+        lambda fault, seconds: outage.inc(seconds, labels={"fault": fault}),
+    )
     return reg
 
 

@@ -1,0 +1,967 @@
+"""Availability-SLO fleet simulator: measure the nines, not just convergence.
+
+Every robustness layer in this tree (netem toxics, session rebirth,
+handoff restarts, reconcile repair) is proven by *eventual convergence*
+tests; an operator's question is "how many nines, and how fast do we
+recover per fault class?"  This module (ISSUE 9 tentpole, ROADMAP item
+5) turns the accumulated fault machinery into a measured availability
+envelope:
+
+  * **Fleet** — N in-process registrars (the tests/test_soak.py fleet
+    shape: one :class:`~registrar_tpu.zk.client.ZKClient` per member
+    against one :class:`~registrar_tpu.testing.server.ZKServer`), each
+    member connected through its own
+    :class:`~registrar_tpu.testing.netem.ChaosProxy` so per-member
+    network faults are injectable.
+  * **Prober** — a continuously-polling resolver samples the Binder
+    answer at a fixed cadence over BOTH read paths: live
+    (:func:`registrar_tpu.binderview.resolve` against a direct client)
+    and cached (through :class:`~registrar_tpu.zkcache.ZKCache`).  A
+    probe is **ok** iff the live answer carries every member of the
+    fleet; the cached answer is additionally compared against the live
+    one to count **stale** serves.  Each probe runs inside an
+    ``slo.probe`` span carrying the active scenario/fault marks
+    (:func:`registrar_tpu.trace.annotate`), so a failing probe's trace
+    id points straight into the flight recorder.
+  * **Scenarios** — seeded, named churn traces keyed to the
+    docs/FAULTS.md fault-class catalog (``id:`` rows; checklib's
+    ``fault-id-drift`` rule diffs the two): deploy waves (drain +
+    re-register), crash loops (session force-expired with a
+    SIGKILL-shaped stale handoff state — the successor's seeded resume
+    is refused and it registers fresh), health-check flaps, expiry
+    storms, and per-member netem blackhole episodes long enough to
+    expire the session.
+  * **SLO math** — pure functions over the probe timeline (no fleet
+    needed; unit-tested in tests/test_slo.py): availability and nines,
+    outage-window extraction and merging (overlapping faults never
+    double-count downtime), and per-fault MTTD/MTTR attribution keyed
+    to the injection timestamps.
+
+The runner (``tools/slo.py``, ``make slo`` / ``make slo-quick``) drives
+a trace, writes ``slo-report.json``, and gates the quick trace against
+``SLO_BASELINE.json`` exactly the way bench.py gates perf — floors
+pinned from the append-only ``SLO_HISTORY.json``, regressions fail.
+
+Metrics: :func:`registrar_tpu.metrics.instrument_slo` exposes
+``registrar_slo_probe_total{result}`` and
+``registrar_slo_outage_seconds_total{fault}`` from the harness's event
+surface (``probe`` per sample, ``outage`` per attributed window).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import math
+import random
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+from registrar_tpu import binderview
+from registrar_tpu import metrics as metrics_mod
+from registrar_tpu import trace as trace_mod
+from registrar_tpu.events import EventEmitter, spawn_owned
+from registrar_tpu.registration import register, unregister
+from registrar_tpu.retry import RetryPolicy
+from registrar_tpu.testing.netem import DOWN, UP, Blackhole, ChaosProxy
+from registrar_tpu.testing.server import ZKServer
+from registrar_tpu.zk.client import SessionExpiredError, ZKClient
+from registrar_tpu.zkcache import ZKCache
+
+log = logging.getLogger("registrar_tpu.testing.slo")
+
+#: The fault-class catalog (docs/FAULTS.md "Fault classes", the ``id:``
+#: column).  Every scenario injects through :meth:`SLOHarness.inject`
+#: with one of these literals; checklib's ``fault-id-drift`` rule diffs
+#: the injection sites against the doc table in both directions.
+FAULT_IDS = (
+    "deploy-wave",
+    "crash-loop",
+    "health-flap",
+    "expiry-storm",
+    "netem-episode",
+)
+
+#: nines(1.0) would be infinite; the cap keeps a flawless short trace
+#: reportable (and honest: a 5 s trace cannot demonstrate nine nines).
+MAX_NINES = 9.0
+
+
+# ---------------------------------------------------------------------------
+# SLO math: pure functions over probe timelines (unit-tested, no fleet)
+# ---------------------------------------------------------------------------
+
+
+class Probe:
+    """One availability sample: ``t`` (harness clock, seconds), ``ok``
+    (the live answer carried the full fleet), ``missing`` (how many
+    members the answer lacked), and the probe span's ``trace_id`` (the
+    flight-recorder pointer for a failing sample)."""
+
+    __slots__ = ("t", "ok", "missing", "trace_id")
+
+    def __init__(
+        self,
+        t: float,
+        ok: bool,
+        missing: int = 0,
+        trace_id: Optional[str] = None,
+    ):
+        self.t = t
+        self.ok = ok
+        self.missing = missing
+        self.trace_id = trace_id
+
+    def __repr__(self) -> str:
+        state = "ok" if self.ok else f"fail(-{self.missing})"
+        return f"Probe({self.t:.3f}, {state})"
+
+
+class FaultEvent:
+    """One injected fault: identity (catalog ``fault`` id + member),
+    its injection/clear stamps, and the probe-derived verdicts filled in
+    by :func:`attribute` — ``detected_at`` (first failing probe at or
+    after injection) and ``recovered_at`` (first ok probe after
+    detection).  MTTD/MTTR are both measured **from injection**, the
+    operator's clock."""
+
+    __slots__ = (
+        "fault", "member", "injected_at", "cleared_at",
+        "detected_at", "recovered_at",
+    )
+
+    def __init__(self, fault: str, member: Optional[int], injected_at: float):
+        self.fault = fault
+        self.member = member
+        self.injected_at = injected_at
+        self.cleared_at: Optional[float] = None
+        self.detected_at: Optional[float] = None
+        self.recovered_at: Optional[float] = None
+
+    @property
+    def mttd_s(self) -> Optional[float]:
+        if self.detected_at is None:
+            return None
+        return self.detected_at - self.injected_at
+
+    @property
+    def mttr_s(self) -> Optional[float]:
+        if self.recovered_at is None:
+            return None
+        return self.recovered_at - self.injected_at
+
+    def __repr__(self) -> str:
+        return (
+            f"FaultEvent({self.fault!r}, member={self.member}, "
+            f"injected_at={self.injected_at:.3f})"
+        )
+
+
+def availability(probes: Sequence[Probe]) -> float:
+    """Fraction of ok probes.  Raises on an empty timeline — a prober
+    that never sampled must read as a broken run, not as 100%."""
+    if not probes:
+        raise ValueError("no probes: availability is unmeasured, not 1.0")
+    ok = sum(1 for p in probes if p.ok)
+    return ok / len(probes)
+
+
+def nines(avail: float) -> float:
+    """Availability as "nines": 0.999 -> 3.0, capped at MAX_NINES."""
+    if not 0.0 <= avail <= 1.0:
+        raise ValueError("availability must be within [0, 1]")
+    if avail >= 1.0:
+        return MAX_NINES
+    return min(max(0.0, round(-math.log10(1.0 - avail), 3)), MAX_NINES)
+
+
+def outage_windows(
+    probes: Sequence[Probe], end: Optional[float] = None
+) -> List[Tuple[float, float]]:
+    """Half-open ``(start, end)`` windows where the probe stream saw
+    failure: a window opens at the first failing probe and closes at
+    the next ok probe.  A trailing failure closes at ``end`` (default:
+    the last probe's stamp) — an unrecovered outage still has a
+    measurable duration."""
+    windows: List[Tuple[float, float]] = []
+    start = None
+    for p in probes:
+        if not p.ok and start is None:
+            start = p.t
+        elif p.ok and start is not None:
+            windows.append((start, p.t))
+            start = None
+    if start is not None:
+        close = end if end is not None else probes[-1].t
+        windows.append((start, max(close, start)))
+    return windows
+
+
+def merge_windows(
+    windows: Sequence[Tuple[float, float]]
+) -> List[Tuple[float, float]]:
+    """Sort and coalesce overlapping/adjacent windows, so downtime from
+    overlapping fault classes is counted once."""
+    merged: List[Tuple[float, float]] = []
+    for start, end in sorted(windows):
+        if merged and start <= merged[-1][1]:
+            merged[-1] = (merged[-1][0], max(merged[-1][1], end))
+        else:
+            merged.append((start, end))
+    return merged
+
+
+def total_outage_s(windows: Sequence[Tuple[float, float]]) -> float:
+    return sum(end - start for start, end in merge_windows(windows))
+
+
+def attribute(
+    faults: Sequence[FaultEvent], probes: Sequence[Probe]
+) -> None:
+    """Fill ``detected_at``/``recovered_at`` on every fault event from
+    the probe timeline.
+
+    Detection is the first failing probe at or after the injection —
+    bounded at ``cleared_at`` when the fault was cleared: a fault whose
+    whole outage fell between two probe ticks is reported *undetected*
+    (shorter than the cadence can observe), never credited with a later
+    unrelated scenario's failure.  Recovery is the first ok probe after
+    detection.  When two faults overlap, each still gets its own
+    MTTD/MTTR measured from its own injection stamp — the later fault
+    "detects" immediately (the outage is already observable) and both
+    recover at the same ok probe; only the merged-window *downtime* is
+    deduplicated (see :func:`window_owner`)."""
+    for fault in faults:
+        horizon = (
+            fault.cleared_at if fault.cleared_at is not None else math.inf
+        )
+        fault.detected_at = next(
+            (
+                p.t
+                for p in probes
+                if fault.injected_at <= p.t <= horizon and not p.ok
+            ),
+            None,
+        )
+        if fault.detected_at is not None:
+            fault.recovered_at = next(
+                (p.t for p in probes if p.t > fault.detected_at and p.ok),
+                None,
+            )
+
+
+def window_owner(
+    window: Tuple[float, float], faults: Sequence[FaultEvent]
+) -> Optional[FaultEvent]:
+    """The fault that OWNS a merged outage window: the earliest-injected
+    fault whose detection..recovery interval overlaps it.  One window,
+    one owner — overlapping fault classes never double-count downtime
+    (``registrar_slo_outage_seconds_total`` sums to the merged total)."""
+    start, end = window
+    owner = None
+    for fault in faults:
+        if fault.detected_at is None:
+            continue
+        recovered = (
+            fault.recovered_at if fault.recovered_at is not None else end
+        )
+        if fault.detected_at < end and recovered > start:
+            if owner is None or fault.injected_at < owner.injected_at:
+                owner = fault
+    return owner
+
+
+def _round_stats(values: List[float]) -> Dict[str, Optional[float]]:
+    if not values:
+        return {"mean": None, "max": None}
+    return {
+        "mean": round(sum(values) / len(values), 4),
+        "max": round(max(values), 4),
+    }
+
+
+def fault_summary(
+    faults: Sequence[FaultEvent],
+    probes: Sequence[Probe],
+    end: Optional[float] = None,
+) -> Tuple[Dict[str, Dict[str, Any]], List[Tuple[float, float]]]:
+    """Per-fault-class rollup + the merged outage windows.
+
+    Each class reports its injected/detected counts, MTTD/MTTR mean and
+    max (seconds, from injection), and the downtime attributed to the
+    windows it owns.  Calls :func:`attribute` on the way."""
+    attribute(faults, probes)
+    windows = merge_windows(outage_windows(probes, end))
+    per: Dict[str, Dict[str, Any]] = {}
+    mttds: Dict[str, List[float]] = {}
+    mttrs: Dict[str, List[float]] = {}
+    for fault in faults:
+        entry = per.setdefault(
+            fault.fault,
+            {"injected": 0, "detected": 0, "outage_s": 0.0},
+        )
+        entry["injected"] += 1
+        if fault.detected_at is not None:
+            entry["detected"] += 1
+            mttds.setdefault(fault.fault, []).append(fault.mttd_s)
+            if fault.mttr_s is not None:
+                mttrs.setdefault(fault.fault, []).append(fault.mttr_s)
+    for window in windows:
+        owner = window_owner(window, faults)
+        if owner is not None:
+            per[owner.fault]["outage_s"] = round(
+                per[owner.fault]["outage_s"] + (window[1] - window[0]), 4
+            )
+    for fid, entry in per.items():
+        stats = _round_stats(mttds.get(fid, []))
+        entry["mttd_s_mean"], entry["mttd_s_max"] = stats["mean"], stats["max"]
+        stats = _round_stats(mttrs.get(fid, []))
+        entry["mttr_s_mean"], entry["mttr_s_max"] = stats["mean"], stats["max"]
+    return per, windows
+
+
+# ---------------------------------------------------------------------------
+# The fleet harness
+# ---------------------------------------------------------------------------
+
+#: members reconnect fast: the harness measures recovery, and the
+#: production-shaped 1-90 s envelope would make every scenario read as
+#: its backoff, not its detection bound
+_MEMBER_RECONNECT = RetryPolicy(
+    max_attempts=float("inf"), initial_delay=0.05, max_delay=0.4,
+    jitter="decorrelated",
+)
+
+
+class _Member:
+    """One fleet member: its proxy, client, and registration."""
+
+    __slots__ = ("idx", "hostname", "admin_ip", "proxy", "client", "znodes")
+
+    def __init__(self, idx: int, hostname: str, admin_ip: str):
+        self.idx = idx
+        self.hostname = hostname
+        self.admin_ip = admin_ip
+        self.proxy: Optional[ChaosProxy] = None
+        self.client: Optional[ZKClient] = None
+        self.znodes: List[str] = []
+
+
+class SLOHarness(EventEmitter):
+    """Seeded fleet + prober + fault injection (module docstring).
+
+    Events: ``probe(result)`` per sample (``"ok"``/``"fail"``) and
+    ``outage(fault, seconds)`` per attributed window at report time —
+    :func:`registrar_tpu.metrics.instrument_slo` turns these into the
+    ``registrar_slo_*`` counters.
+
+    ``repair=False`` injects every fault but withholds the recovery
+    actions (no member ever restarts or re-registers) — the
+    deliberately broken run tools/slo.py uses to prove the probe
+    actually detects outages (a measurable nines drop).
+    """
+
+    def __init__(
+        self,
+        members: int = 5,
+        seed: int = 0,
+        probe_interval: float = 0.02,
+        session_timeout_ms: int = 800,
+        repair: bool = True,
+        domain: str = "slo.fleet.us",
+        tracer: Optional[trace_mod.Tracer] = None,
+    ):
+        super().__init__()
+        if members < 2:
+            raise ValueError("a fleet needs at least 2 members")
+        self.n_members = members
+        self.seed = seed
+        self.rng = random.Random(seed)
+        self.probe_interval = probe_interval
+        self.session_timeout_ms = session_timeout_ms
+        self.repair = repair
+        self.domain = domain
+        self.fault_ids = FAULT_IDS
+        self.tracer = (
+            tracer
+            if tracer is not None
+            else trace_mod.Tracer(sample_rate=1.0, max_spans=8192)
+        )
+        #: latency histograms fed from the probe spans (the PR-8
+        #: machinery: registrar_resolve_seconds by source) plus the
+        #: registrar_slo_* counters
+        self.registry = metrics_mod.instrument_tracing(self.tracer)
+        metrics_mod.instrument_slo(self, self.registry)
+
+        self.server: Optional[ZKServer] = None
+        self.members: List[_Member] = []
+        self.live_client: Optional[ZKClient] = None
+        self.cache_client: Optional[ZKClient] = None
+        self.cache: Optional[ZKCache] = None
+
+        self.probes: List[Probe] = []
+        self.faults: List[FaultEvent] = []
+        #: (fault_id, segment_start, segment_end) per scenario run
+        self.segments: List[Tuple[str, float, float]] = []
+        self.scenario: Optional[str] = None
+        self.stale_probes = 0
+        self.cached_probes = 0
+        self._tasks: set = set()
+        self._stop_probing = asyncio.Event()
+        self._started_at: Optional[float] = None
+        self._finished_at: Optional[float] = None
+
+    # -- clock --------------------------------------------------------------
+
+    def now(self) -> float:
+        return asyncio.get_running_loop().time()
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def _registration(self) -> Dict[str, Any]:
+        return {
+            "domain": self.domain,
+            "type": "load_balancer",
+            "service": {
+                "type": "service",
+                "service": {"srvce": "_http", "proto": "_tcp", "port": 80},
+            },
+        }
+
+    def _make_client(self, member: _Member) -> ZKClient:
+        return ZKClient(
+            [member.proxy.address],
+            timeout_ms=self.session_timeout_ms,
+            connect_timeout_ms=300,
+            connect_pass_timeout_ms=self.session_timeout_ms,
+            reconnect_policy=_MEMBER_RECONNECT,
+        )
+
+    async def start(self) -> "SLOHarness":
+        self.server = await ZKServer().start()
+        for i in range(self.n_members):
+            member = _Member(i, f"slo{i}", f"10.9.{i // 256}.{i % 256}")
+            member.proxy = await ChaosProxy(
+                self.server.address, seed=self.rng.randrange(2**32)
+            ).start()
+            member.client = await self._make_client(member).connect()
+            member.znodes = await register(
+                member.client, self._registration(),
+                admin_ip=member.admin_ip, hostname=member.hostname,
+                settle_delay=0,
+            )
+            self.members.append(member)
+        self.live_client = await ZKClient(
+            [self.server.address], timeout_ms=8000
+        ).connect()
+        self.cache_client = await ZKClient(
+            [self.server.address], timeout_ms=8000
+        ).connect()
+        self.live_client.tracer = self.tracer
+        self.cache = ZKCache(self.cache_client)
+        self.cache.tracer = self.tracer
+        self._started_at = self.now()
+        spawn_owned(self._probe_loop(), self._tasks)
+        return self
+
+    async def stop(self) -> None:
+        self._stop_probing.set()
+        for task in list(self._tasks):
+            task.cancel()
+        if self._tasks:
+            await asyncio.gather(*self._tasks, return_exceptions=True)
+        self._tasks.clear()
+        if self.cache is not None:
+            self.cache.close()
+        for client in (self.live_client, self.cache_client):
+            if client is not None and not client.closed:
+                await client.close()
+        for member in self.members:
+            if member.client is not None and not member.client.closed:
+                await member.client.close()
+            if member.proxy is not None:
+                await member.proxy.stop()
+        if self.server is not None:
+            await self.server.stop()
+
+    async def __aenter__(self) -> "SLOHarness":
+        return await self.start()
+
+    async def __aexit__(self, *_exc) -> None:
+        await self.stop()
+
+    # -- fault bookkeeping --------------------------------------------------
+
+    @property
+    def expected(self) -> set:
+        return {m.admin_ip for m in self.members}
+
+    def inject(self, fault: str, member: Optional[int] = None) -> FaultEvent:
+        """Record (and trace) a fault-class injection.  Every scenario
+        routes through here with a docs/FAULTS.md catalog literal, which
+        is what the ``fault-id-drift`` rule machine-checks."""
+        if fault not in self.fault_ids:
+            raise ValueError(f"unknown fault class {fault!r} (FAULT_IDS)")
+        event = FaultEvent(fault, member, self.now())
+        self.faults.append(event)
+        self.tracer.event(
+            "slo.fault", fault=fault, member=member,
+            scenario=self.scenario,
+        )
+        log.debug("inject %s member=%s at %.3f", fault, member,
+                  event.injected_at)
+        return event
+
+    def clear(self, event: FaultEvent) -> None:
+        event.cleared_at = self.now()
+
+    def _active_faults(self) -> str:
+        return ",".join(
+            f.fault for f in self.faults if f.cleared_at is None
+        )
+
+    # -- the prober ---------------------------------------------------------
+
+    async def _probe_loop(self) -> None:
+        while not self._stop_probing.is_set():
+            try:
+                await self._probe_once()
+            except asyncio.CancelledError:
+                raise
+            except Exception:  # noqa: BLE001 - the prober must outlive faults
+                log.exception("probe iteration failed")
+            try:
+                await asyncio.wait_for(
+                    self._stop_probing.wait(), timeout=self.probe_interval
+                )
+            except asyncio.TimeoutError:
+                pass
+
+    async def _probe_once(self) -> None:
+        expected = self.expected
+        with trace_mod.annotate(
+            scenario=self.scenario, faults=self._active_faults()
+        ):
+            with self.tracer.span("slo.probe") as span:
+                t = self.now()
+                live_set: set = set()
+                try:
+                    res = await binderview.resolve(
+                        self.live_client, self.domain, "A"
+                    )
+                    live_set = {a.data for a in res.answers}
+                except asyncio.CancelledError:
+                    raise
+                except Exception as err:  # noqa: BLE001 - a failed read IS a failed probe
+                    span.set_attr("err", repr(err))
+                ok = live_set == expected
+                span.set_attr("result", "ok" if ok else "fail")
+                try:
+                    cres = await binderview.resolve(
+                        self.cache, self.domain, "A"
+                    )
+                    self.cached_probes += 1
+                    if {a.data for a in cres.answers} != live_set:
+                        self.stale_probes += 1
+                except asyncio.CancelledError:
+                    raise
+                except Exception:  # noqa: BLE001 - cached failure counts as stale
+                    self.cached_probes += 1
+                    self.stale_probes += 1
+        self.probes.append(
+            Probe(t, ok, len(expected - live_set), span.trace_id)
+        )
+        self.emit("probe", "ok" if ok else "fail")
+
+    async def wait_healthy(self, timeout: float = 8.0) -> None:
+        """Block until the prober sees a full fleet again (scenario
+        barrier: the next scenario starts from health, so its fault
+        class owns its own windows)."""
+        deadline = self.now() + timeout
+        while True:
+            if self.probes and self.probes[-1].ok:
+                return
+            if self.now() >= deadline:
+                raise RuntimeError(
+                    f"fleet never reconverged after {self.scenario!r} "
+                    f"(last probe: {self.probes[-1] if self.probes else None})"
+                )
+            await asyncio.sleep(self.probe_interval)
+
+    # -- member recovery actions --------------------------------------------
+
+    async def _connect_fresh(self, client: ZKClient) -> ZKClient:
+        try:
+            return await client.connect()
+        except SessionExpiredError:
+            # A seeded resume the server refused (the session died with
+            # the "crashed" predecessor): the client has already reset
+            # to a fresh-session handshake — connect again, exactly the
+            # successor-daemon fallback (main._attempt_resume).
+            return await client.connect()
+
+    async def _restart_member(
+        self, member: _Member, resume: Optional[Tuple[int, bytes]] = None
+    ) -> None:
+        """Bring a member back with a fresh process's client.
+
+        ``resume`` is the SIGKILL-shaped stale-statefile path: the
+        "successor" offers the dead session's (id, passwd) the way a
+        leftover handoff state file would; the server refuses it and
+        the member falls back to a fresh registration."""
+        if member.client is not None and not member.client.closed:
+            await member.client.close()
+        client = self._make_client(member)
+        if resume is not None:
+            client.seed_session(
+                resume[0], resume[1],
+                negotiated_timeout_ms=self.session_timeout_ms,
+            )
+        member.client = await self._connect_fresh(client)
+        member.znodes = await register(
+            member.client, self._registration(),
+            admin_ip=member.admin_ip, hostname=member.hostname,
+            settle_delay=0,
+        )
+
+    def _live_members(self) -> List[_Member]:
+        return [
+            m
+            for m in self.members
+            if m.client is not None and m.client.connected
+        ]
+
+    def _pick_member(self) -> Optional[_Member]:
+        """A member whose client is still alive — with repair disabled,
+        earlier scenarios leave corpses behind, and injecting into a
+        corpse would be a no-op the attribution then mis-reads."""
+        candidates = self._live_members()
+        if not candidates:
+            return None
+        return candidates[self.rng.randrange(len(candidates))]
+
+    # -- scenarios (one per docs/FAULTS.md fault class) ---------------------
+
+    async def run_scenario(self, fault_id: str, **kwargs) -> None:
+        """Run one named scenario, bracket its probe segment, and (with
+        repair on) wait for reconvergence before returning."""
+        methods = {
+            "deploy-wave": self._scenario_deploy_wave,
+            "crash-loop": self._scenario_crash_loop,
+            "health-flap": self._scenario_health_flap,
+            "expiry-storm": self._scenario_expiry_storm,
+            "netem-episode": self._scenario_netem_episode,
+        }
+        if fault_id not in methods:
+            raise ValueError(f"unknown scenario {fault_id!r}")
+        self.scenario = fault_id
+        started = self.now()
+        try:
+            await methods[fault_id](**kwargs)
+            if self.repair:
+                await self.wait_healthy()
+        finally:
+            self.segments.append((fault_id, started, self.now()))
+            self.scenario = None
+
+    async def _scenario_deploy_wave(
+        self, wave: Optional[int] = None, down_s: float = 0.1
+    ) -> None:
+        """A rolling deploy using drain restarts: each member leaves DNS
+        (clean unregister), the process "exits", and a successor
+        re-registers — the bounded per-member gap drain mode promises
+        (handoff mode's zero-gap restart is proven by
+        tests/test_restart_e2e.py; this measures the drain envelope)."""
+        count = wave if wave is not None else max(2, self.n_members // 2)
+        live = self._live_members()
+        order = self.rng.sample(live, min(count, len(live)))
+        for member in order:
+            event = self.inject("deploy-wave", member=member.idx)
+            await unregister(member.client, member.znodes)
+            await member.client.close()
+            await asyncio.sleep(down_s)
+            if self.repair:
+                await self._restart_member(member)
+                self.clear(event)
+
+    async def _scenario_crash_loop(
+        self, crashes: int = 2, restart_delay: float = 0.15
+    ) -> None:
+        """SIGKILL shape, in a loop: the session is force-expired out
+        from under the member (ephemerals vanish at once, like a host
+        dying with its supervisor), a stale handoff state survives, and
+        the successor's seeded resume is refused — it registers fresh,
+        exactly the degraded statefile fallback of docs/OPERATIONS.md's
+        restart fault rows."""
+        member = self._pick_member()
+        if member is None:
+            return  # nobody left to crash (repair disabled earlier)
+        for _ in range(crashes):
+            event = self.inject("crash-loop", member=member.idx)
+            stale = (member.client.session_id, member.client.session_passwd)
+            await self.server.expire_session(member.client.session_id)
+            await asyncio.sleep(restart_delay)
+            if not self.repair:
+                break  # the member stays dead; looping adds nothing
+            await self._restart_member(member, resume=stale)
+            self.clear(event)
+            await self.wait_healthy()
+
+    async def _scenario_health_flap(
+        self, flaps: int = 3, down_s: float = 0.1, up_s: float = 0.08
+    ) -> None:
+        """Health-check flapping: the agent's fail->deregister /
+        ok->re-register transitions, at the znode level — the member
+        leaves DNS deliberately and comes back on "recovery"."""
+        member = self._pick_member()
+        if member is None:
+            return  # nobody left to flap (repair disabled earlier)
+        for _ in range(flaps):
+            event = self.inject("health-flap", member=member.idx)
+            await unregister(member.client, member.znodes)
+            await asyncio.sleep(down_s)
+            if not self.repair:
+                break  # the member stays deregistered; no more flaps
+            member.znodes = await register(
+                member.client, self._registration(),
+                admin_ip=member.admin_ip, hostname=member.hostname,
+                settle_delay=0,
+            )
+            self.clear(event)
+            await self.wait_healthy()
+            await asyncio.sleep(up_s)
+
+    async def _scenario_expiry_storm(
+        self, victims: Optional[int] = None, restart_delay: float = 0.15
+    ) -> None:
+        """Several members' sessions expired at once (an ensemble-side
+        purge): the fleet-wide recovery runs concurrently, the way a
+        reborn fleet's jittered pipelines would."""
+        count = victims if victims is not None else max(2, self.n_members // 2)
+        live = self._live_members()
+        chosen = self.rng.sample(live, min(count, len(live)))
+        events = []
+        for member in chosen:
+            events.append(self.inject("expiry-storm", member=member.idx))
+            await self.server.expire_session(member.client.session_id)
+        await asyncio.sleep(restart_delay)
+        if self.repair:
+            await asyncio.gather(
+                *(self._restart_member(m) for m in chosen)
+            )
+            for event in events:
+                self.clear(event)
+
+    async def _scenario_netem_episode(
+        self, episodes: int = 1, blackhole_s: Optional[float] = None
+    ) -> None:
+        """A per-member network fault episode: the member's proxy goes
+        total-void (Blackhole both directions + connection drop) long
+        enough for the server to expire the unreachable session; the
+        link then heals and the member re-registers."""
+        hold = (
+            blackhole_s
+            if blackhole_s is not None
+            else 2.2 * self.session_timeout_ms / 1000.0
+        )
+        member = self._pick_member()
+        if member is None:
+            return  # nobody left to blackhole (repair disabled earlier)
+        for _ in range(episodes):
+            event = self.inject("netem-episode", member=member.idx)
+            member.proxy.add(Blackhole(), direction=UP)
+            member.proxy.add(Blackhole(), direction=DOWN)
+            member.proxy.drop_connections()
+            await asyncio.sleep(hold)
+            member.proxy.clear()
+            if self.repair:
+                await self._restart_member(member)
+                self.clear(event)
+                await self.wait_healthy()
+
+    # -- the report ---------------------------------------------------------
+
+    async def settle(self, seconds: float = 0.2) -> None:
+        """Trailing ok probes so the last scenario's windows close."""
+        await asyncio.sleep(seconds)
+
+    def report(self, trace_name: str = "custom") -> Dict[str, Any]:
+        """Stop probing and roll the timeline up into the SLO report.
+
+        Emits one ``outage`` event per attributed merged window (the
+        ``registrar_slo_outage_seconds_total{fault}`` feed), so call it
+        exactly once per run."""
+        self._stop_probing.set()
+        self._finished_at = self.now()
+        end = self._finished_at
+        per_fault, windows = fault_summary(self.faults, self.probes, end)
+        # Per-class availability over the UNION of that class's probe
+        # segments — a trace may run the same scenario more than once
+        # (the full trace does), and the class's number must cover all
+        # of its runs, not just the last.
+        segment_probes: Dict[str, List[Probe]] = {}
+        for fid, start_t, end_t in self.segments:
+            segment_probes.setdefault(fid, []).extend(
+                p for p in self.probes if start_t <= p.t <= end_t
+            )
+        for fid, probes in segment_probes.items():
+            if fid in per_fault and probes:
+                avail = availability(probes)
+                per_fault[fid]["availability"] = round(avail, 6)
+                per_fault[fid]["nines"] = nines(avail)
+        for window in windows:
+            owner = window_owner(window, self.faults)
+            if owner is not None:
+                self.emit("outage", owner.fault, window[1] - window[0])
+        overall = availability(self.probes) if self.probes else 0.0
+        worst = max(
+            windows, key=lambda w: w[1] - w[0], default=None
+        )
+        worst_info = None
+        if worst is not None:
+            owner = window_owner(worst, self.faults)
+            trace_ids = [
+                p.trace_id
+                for p in self.probes
+                if worst[0] <= p.t <= worst[1]
+                and not p.ok
+                and p.trace_id is not None
+            ]
+            worst_info = {
+                "start_s": round(worst[0] - self._started_at, 4),
+                "duration_s": round(worst[1] - worst[0], 4),
+                "fault": owner.fault if owner is not None else None,
+                "trace_ids": trace_ids[:5],
+            }
+        hist = self.registry.get("registrar_resolve_seconds")
+        staleness = {
+            "stale_cached_probes": self.stale_probes,
+            "cached_probes": self.cached_probes,
+            "stale_ratio": round(
+                self.stale_probes / self.cached_probes, 6
+            ) if self.cached_probes else None,
+            "cache_coherence_lag_ms_last": self.cache.stats[
+                "coherence_lag_ms_last"
+            ] if self.cache is not None else None,
+        }
+        for source in ("cached", "live"):
+            for q in (0.50, 0.95, 0.99):
+                value = hist.quantile(q, {"source": source})
+                staleness[
+                    f"resolve_{source}_p{int(q * 100)}_ms"
+                ] = round(value * 1000.0, 4) if value is not None else None
+        mttr_all = [f.mttr_s for f in self.faults if f.mttr_s is not None]
+        mttd_all = [f.mttd_s for f in self.faults if f.mttd_s is not None]
+        measured = sum(
+            1
+            for entry in per_fault.values()
+            if entry["detected"] and entry["mttr_s_mean"] is not None
+        )
+        downtime = round(total_outage_s(windows), 4)
+        gate_metrics = {
+            "availability_pct": round(overall * 100.0, 4),
+            "downtime_s_total": downtime,
+            "worst_outage_s": (
+                worst_info["duration_s"] if worst_info is not None else 0.0
+            ),
+            "mttr_s_mean": _round_stats(mttr_all)["mean"],
+            "mttd_s_mean": _round_stats(mttd_all)["mean"],
+            "fault_classes_measured": measured,
+        }
+        return {
+            "trace": trace_name,
+            "seed": self.seed,
+            "repair": self.repair,
+            "members": self.n_members,
+            "probe_interval_ms": round(self.probe_interval * 1000.0, 1),
+            "duration_s": round(end - self._started_at, 3),
+            "probes": {
+                "total": len(self.probes),
+                "ok": sum(1 for p in self.probes if p.ok),
+                "fail": sum(1 for p in self.probes if not p.ok),
+            },
+            "availability": round(overall, 6),
+            "nines": nines(overall) if self.probes else 0.0,
+            "faults": per_fault,
+            "outages": {
+                "windows": len(windows),
+                "downtime_s_total": downtime,
+                "worst": worst_info,
+            },
+            "staleness": staleness,
+            "gate_metrics": gate_metrics,
+        }
+
+
+# ---------------------------------------------------------------------------
+# Named traces
+# ---------------------------------------------------------------------------
+
+#: The trace matrix (tools/slo.py --trace).  ``quick`` is the CI/gate
+#: trace: every fault class once, ~8 s wall; ``full`` is the long soak
+#: (make slo): a bigger fleet, repeated episodes.
+TRACES: Dict[str, Dict[str, Any]] = {
+    "quick": {
+        "members": 5,
+        "probe_interval": 0.02,
+        "session_timeout_ms": 800,
+        "pause_s": 0.5,
+        "scenarios": (
+            ("deploy-wave", {"wave": 2, "down_s": 0.1}),
+            ("crash-loop", {"crashes": 2, "restart_delay": 0.12}),
+            ("health-flap", {"flaps": 2, "down_s": 0.1}),
+            ("expiry-storm", {"victims": 3, "restart_delay": 0.12}),
+            ("netem-episode", {"episodes": 1}),
+        ),
+    },
+    "full": {
+        "members": 10,
+        "probe_interval": 0.05,
+        "session_timeout_ms": 1500,
+        "pause_s": 1.5,
+        "scenarios": (
+            ("deploy-wave", {"wave": 6, "down_s": 0.15}),
+            ("crash-loop", {"crashes": 4, "restart_delay": 0.2}),
+            ("health-flap", {"flaps": 4, "down_s": 0.15}),
+            ("expiry-storm", {"victims": 5, "restart_delay": 0.2}),
+            ("netem-episode", {"episodes": 2}),
+            ("deploy-wave", {"wave": 6, "down_s": 0.15}),
+            ("expiry-storm", {"victims": 5, "restart_delay": 0.2}),
+        ),
+    },
+}
+
+
+async def run_trace(
+    trace: str = "quick",
+    seed: Optional[int] = None,
+    repair: bool = True,
+    scenarios: Optional[Sequence[Tuple[str, Dict[str, Any]]]] = None,
+) -> Dict[str, Any]:
+    """Drive one named trace end to end and return the SLO report."""
+    if trace not in TRACES:
+        raise ValueError(f"unknown trace {trace!r} (have {sorted(TRACES)})")
+    params = TRACES[trace]
+    if seed is None:
+        seed = random.randrange(2**32)
+    harness = SLOHarness(
+        members=params["members"],
+        seed=seed,
+        probe_interval=params["probe_interval"],
+        session_timeout_ms=params["session_timeout_ms"],
+        repair=repair,
+    )
+    await harness.start()
+    try:
+        for fault_id, kwargs in (
+            scenarios if scenarios is not None else params["scenarios"]
+        ):
+            await harness.run_scenario(fault_id, **kwargs)
+            # Steady-state gap between scenarios: the availability
+            # denominator includes healthy operation (a trace that is
+            # 100% fault time measures the faults, not the service),
+            # and the next scenario's windows start from health.
+            await harness.settle(params.get("pause_s", 0.5))
+        await harness.settle(max(0.2, 5 * params["probe_interval"]))
+        return harness.report(trace_name=trace)
+    finally:
+        await harness.stop()

@@ -757,6 +757,23 @@ async def _cmd_status(args) -> int:
         print(f"zkcli: status: {host}:{port}: {e}", file=sys.stderr)
         return 2
     print(json.dumps(snapshot, indent=2, default=str))
+    # uptime + last-transition stamps (ISSUE 9 satellite): the MTTR
+    # arithmetic at a glance — how long the daemon has been up and how
+    # long ago each slow-moving state last changed.
+    uptime = snapshot.get("uptime_s")
+    transitions = snapshot.get("last_transition") or {}
+    if uptime is not None or transitions:
+        import time as time_mod
+
+        bits = []
+        if uptime is not None:
+            bits.append(f"up {uptime}s")
+        for kind in ("session", "registration", "health"):
+            entry = transitions.get(kind)
+            if entry and entry.get("at") is not None:
+                age = max(0.0, round(time_mod.time() - entry["at"], 1))
+                bits.append(f"{kind} -> {entry.get('state')} {age}s ago")
+        print(f"zkcli: status: {'; '.join(bits)}", file=sys.stderr)
     session = snapshot.get("session") or {}
     registration = snapshot.get("registration") or {}
     health = snapshot.get("health") or {}

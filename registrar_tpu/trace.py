@@ -71,6 +71,47 @@ _current: contextvars.ContextVar = contextvars.ContextVar(
     "registrar_trace_span", default=None
 )
 
+#: ambient attrs stamped onto every span/event created while an
+#: :class:`annotate` block is active (or None — the common case costs
+#: one contextvar read per span).  Propagates across awaits and task
+#: spawns exactly like the current span.
+_ambient: contextvars.ContextVar = contextvars.ContextVar(
+    "registrar_trace_ambient", default=None
+)
+
+
+class annotate:
+    """Stamp extra attrs onto every span and event created inside the
+    block — across every nested call layer (ISSUE 9).
+
+    The SLO harness wraps each availability probe in
+    ``annotate(scenario=..., faults=...)`` so the probe's whole span
+    tree — ``slo.probe`` down through ``resolve.query`` and the
+    ``zk.op`` leaves — carries the active scenario and fault-class
+    marks without threading them through the resolver's signatures.  An
+    outage pulled out of the flight recorder is then attributable on
+    sight.  Explicit attrs passed at the call site win over ambient
+    ones on a key collision.  Nesting merges (inner blocks override per
+    key); exiting restores the enclosing block's view."""
+
+    __slots__ = ("attrs", "_token")
+
+    def __init__(self, **attrs):
+        self.attrs = attrs
+        self._token = None
+
+    def __enter__(self) -> "annotate":
+        current = _ambient.get()
+        merged = {**current, **self.attrs} if current else dict(self.attrs)
+        self._token = _ambient.set(merged)
+        return self
+
+    def __exit__(self, *_exc) -> bool:
+        if self._token is not None:
+            _ambient.reset(self._token)
+            self._token = None
+        return False
+
 
 class _NoopSpan:
     """The shared do-nothing span a disabled tracer hands out.
@@ -290,6 +331,9 @@ class Tracer:
                 or self._rng.random() < self.sample_rate
             )
         )
+        ambient = _ambient.get()
+        if ambient:
+            attrs = {**ambient, **attrs}
         return Span(self, name, parent, sampled, attrs)
 
     #: ``span`` is the same method, not a delegating wrapper — one
@@ -312,6 +356,9 @@ class Tracer:
             if not sp.sampled:
                 return
             trace_id = sp.trace_id
+        ambient = _ambient.get()
+        if ambient:
+            attrs = {**ambient, **attrs}
         self.events_recorded += 1
         self._ring.append(
             {

@@ -328,6 +328,19 @@ PROGRAM_SEEDED_VIOLATIONS = {
             and reconnects.
             """,
     },
+    "fault-id-drift": {
+        "registrar_tpu/seeded.py": """\
+            def storm(harness):
+                harness.inject("ghost-fault")
+            """,
+        "docs/FAULTS.md": """\
+            # Faults
+
+            | Fault class | injected |
+            |---|---|
+            | `id: real-fault` | the documented one |
+            """,
+    },
     "span-name-drift": {
         "registrar_tpu/seeded.py": """\
             class _Recorder:

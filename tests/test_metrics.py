@@ -314,6 +314,21 @@ class TestInstrumentation:
             assert "registrar_zk_connected 1" in text
             assert "registrar_znodes_owned 1" in text
             assert "registrar_uptime_seconds" in text
+            # /status: uptime_s + last_transition stamps (ISSUE 9
+            # satellite) — MTTR is computable from a live daemon, so
+            # the registration transition must carry a wall stamp.
+            status, _, body = await _http_get(
+                "127.0.0.1", port, "/status"
+            )
+            assert status == 200
+            import json as json_mod
+            import time as time_mod
+
+            snapshot = json_mod.loads(body)
+            assert snapshot["uptime_s"] >= 0
+            reg_transition = snapshot["last_transition"]["registration"]
+            assert reg_transition["state"] == "registered"
+            assert abs(time_mod.time() - reg_transition["at"]) < 60
         finally:
             task.cancel()
             try:

@@ -206,6 +206,40 @@ class TestSpans:
         assert lonely["trace_id"] is None
         assert attached["trace_id"] == span.trace_id
 
+    async def test_annotate_stamps_ambient_attrs(self):
+        # ISSUE 9: annotate() marks every span/event created inside the
+        # block — across nested call layers — without threading attrs
+        # through signatures (the SLO prober's scenario/fault marks).
+        from registrar_tpu.trace import annotate
+
+        tracer = Tracer()
+        with tracer.span("amb.before"):
+            pass
+        with annotate(scenario="crash-loop", faults="crash-loop"):
+            with tracer.span("amb.outer"):
+                with annotate(scenario="inner", extra=1):
+                    with tracer.span("amb.inner", extra=2):
+                        tracer.event("amb.event")
+            with tracer.span("amb.after_inner"):
+                pass
+        with tracer.span("amb.outside"):
+            pass
+        assert _spans(tracer, "amb.before")[0]["attrs"] == {}
+        assert _spans(tracer, "amb.outer")[0]["attrs"] == {
+            "scenario": "crash-loop", "faults": "crash-loop",
+        }
+        # nested blocks merge per key; explicit call-site attrs win
+        assert _spans(tracer, "amb.inner")[0]["attrs"] == {
+            "scenario": "inner", "faults": "crash-loop", "extra": 2,
+        }
+        assert _events(tracer, "amb.event")[0]["attrs"]["scenario"] == "inner"
+        # exiting the inner block restores the outer view...
+        assert _spans(tracer, "amb.after_inner")[0]["attrs"] == {
+            "scenario": "crash-loop", "faults": "crash-loop",
+        }
+        # ...and exiting the outer one restores clean spans
+        assert _spans(tracer, "amb.outside")[0]["attrs"] == {}
+
     async def test_dump_to_file(self, tmp_path):
         tracer = Tracer()
         with tracer.span("dumped.op"):

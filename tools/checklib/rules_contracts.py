@@ -300,6 +300,111 @@ def metric_name_drift(model: ProgramModel) -> Iterator[Finding]:
                 )
 
 
+# -- fault-id-drift ------------------------------------------------------------
+
+FAULTS_CATALOG_DOC = "docs/FAULTS.md"
+
+#: fault-class ids are kebab-case tokens with at least one dash
+#: (``crash-loop``, ``netem-episode``) — the dash requirement keeps
+#: ordinary single-word string call-args out of the diff
+_FAULT_ID = re.compile(r"^[a-z][a-z0-9]*(-[a-z0-9]+)+$")
+
+#: a catalog row's machine-readable marker: ``id: crash-loop`` (bare or
+#: backticked) in docs/FAULTS.md
+_DOC_FAULT_ID = re.compile(r"\bid:\s*`?([a-z][a-z0-9-]*)`?")
+
+
+def _code_fault_ids(model: ProgramModel):
+    """Constant fault-class ids at harness injection sites in the
+    package — ``<harness>.inject("crash-loop", ...)`` — as
+    ``{id: (rel_path, lineno)}`` (first site wins)."""
+    out: dict = {}
+    for mod in model.modules.values():
+        if not mod.rel_path.startswith("registrar_tpu/"):
+            continue
+        for node in ast.walk(mod.ctx.tree):
+            if not isinstance(node, ast.Call) or not node.args:
+                continue
+            func = node.func
+            func_name = (
+                func.attr
+                if isinstance(func, ast.Attribute)
+                else getattr(func, "id", "")
+            )
+            if func_name != "inject":
+                continue
+            arg = node.args[0]
+            if (
+                isinstance(arg, ast.Constant)
+                and isinstance(arg.value, str)
+                and _FAULT_ID.match(arg.value)
+            ):
+                out.setdefault(arg.value, (mod.rel_path, node.lineno))
+    return out
+
+
+@rule(
+    "fault-id-drift",
+    "fault-class ids drift between the SLO harness injection sites "
+    "and the docs/FAULTS.md catalog",
+    scope="program",
+)
+def fault_id_drift(model: ProgramModel) -> Iterator[Finding]:
+    # Fault-class ids are a contract exactly like span names: the SLO
+    # report keys MTTD/MTTR by them, the outage-seconds metric labels
+    # by them, and operators grep docs/FAULTS.md's catalog for the
+    # recovery path behind a bad number.  A scenario renamed in the
+    # harness silently orphans its catalog row (and dashboard filters)
+    # without failing a single test — so both directions are diffed,
+    # the same shape as span-name-drift.
+    root = model.package_root()
+    if root is None:
+        return
+    code = _code_fault_ids(model)
+    lines = read_doc_lines(
+        os.path.join(root, *FAULTS_CATALOG_DOC.split("/"))
+    )
+    doc_ids: dict = {}
+    if lines is not None:
+        for i, line in enumerate(lines, start=1):
+            for m in _DOC_FAULT_ID.finditer(line):
+                if _FAULT_ID.match(m.group(1)):
+                    doc_ids.setdefault(m.group(1), i)
+    if not code and not doc_ids:
+        return  # no SLO harness and no catalog: nothing to diff
+    if lines is None:
+        # the harness injects but the catalog doc is missing entirely:
+        # anchor ONE finding per id at its injection site
+        for fid, (rel, lineno) in sorted(code.items()):
+            yield Finding(
+                "fault-id-drift",
+                rel,
+                lineno,
+                f"fault id '{fid}' is injected by the harness but "
+                f"{FAULTS_CATALOG_DOC} (the fault-class catalog) does "
+                "not exist",
+            )
+        return
+    for fid, (rel, lineno) in sorted(code.items()):
+        if fid not in doc_ids:
+            yield Finding(
+                "fault-id-drift",
+                rel,
+                lineno,
+                f"fault id '{fid}' is injected by the harness but has "
+                f"no `id:` row in {FAULTS_CATALOG_DOC}",
+            )
+    for fid, lineno in sorted(doc_ids.items()):
+        if fid not in code:
+            yield Finding(
+                "fault-id-drift",
+                FAULTS_CATALOG_DOC,
+                lineno,
+                f"fault id '{fid}' is cataloged but no harness "
+                "injection site uses it (renamed or removed scenario?)",
+            )
+
+
 # -- span-name-drift -----------------------------------------------------------
 
 OBSERVABILITY_DOC = "docs/OBSERVABILITY.md"
