@@ -58,6 +58,16 @@ test-jax:
 chaos:
 	CHAOS_SECONDS=30 $(PYTHON) -m pytest tests/test_netem.py tests/test_chaos.py -x -q
 
+# Ensemble leg (ISSUE 10): a seeded 3-member quorum ensemble under a
+# leader-kill + rolling-restart + partition storm with read-only-capable
+# workers, plus the ensemble e2e suite (leader death mid-registration,
+# quorum loss, rolling restart under a polling resolver).  Same
+# CHAOS_SEED knob as `chaos`.
+chaos-ensemble:
+	CHAOS_SECONDS=20 $(PYTHON) -m pytest \
+	    "tests/test_chaos.py::test_chaos_ensemble_quorum_storm" \
+	    tests/test_ensemble.py -x -q
+
 # Zero-downtime restart e2e (ISSUE 5): the real daemon is SIGTERMed and
 # relaunched mid-resolve-loop — handoff mode must show ZERO NO_NODE
 # observations (same ZK session resumed across the process boundary),

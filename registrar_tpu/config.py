@@ -98,6 +98,12 @@ class ZookeeperConfig:
     #: stalled reply tears the connection down and the op fails with the
     #: retryable OPERATION_TIMEOUT (docs/FAULTS.md).
     request_timeout_ms: Optional[int] = None
+    #: ``canBeReadOnly`` (ISSUE 10): allow the client to attach to a
+    #: read-only ensemble member during quorum loss / partition so
+    #: heartbeat and resolve reads keep answering; writes fail with the
+    #: retryable NOT_READONLY until the rw-probe fails the session over.
+    #: Default False = reference-exact handshake bytes.
+    can_be_read_only: bool = False
 
 
 @dataclass
@@ -252,12 +258,16 @@ def parse_config(raw: Mapping[str, Any]) -> Config:
             raise ConfigError(f"config.zookeeper.chroot: {e}") from e
         if chroot == "/":
             chroot = None
+    can_be_read_only = zk_raw.get("canBeReadOnly", False)
+    if not isinstance(can_be_read_only, bool):
+        raise ConfigError("config.zookeeper.canBeReadOnly must be a boolean")
     zookeeper = ZookeeperConfig(
         servers=servers,
         timeout_ms=_ms(zk_raw, "timeout", 30000),
         connect_timeout_ms=_ms(zk_raw, "connectTimeout", 4000),
         chroot=chroot,
         request_timeout_ms=_optional_ms(zk_raw, "requestTimeout"),
+        can_be_read_only=can_be_read_only,
     )
 
     registration = raw.get("registration")

@@ -192,6 +192,7 @@ def _client_from_config(cfg: Config) -> ZKClient:
         request_timeout_ms=cfg.zookeeper.request_timeout_ms,
         survive_session_expiry=cfg.survive_session_expiry,
         max_session_rebirths=cfg.max_session_rebirths,
+        can_be_read_only=cfg.zookeeper.can_be_read_only,
     )
 
 
@@ -875,6 +876,10 @@ async def _status_snapshot(cfg: Config, zk, ee, note: dict) -> dict:
                 if zk.connected_server
                 else None
             ),
+            # True while attached to a read-only (minority) member:
+            # resolves/heartbeats serve, writes refuse — the
+            # OPERATIONS.md "read-only mode" alert's source of truth.
+            "readOnly": getattr(zk, "read_only", False),
             "negotiatedTimeoutMs": zk.negotiated_timeout_ms,
             "rebirths": zk.rebirths,
         },

@@ -607,6 +607,17 @@ def instrument(ee, zk, registry: Optional[MetricsRegistry] = None) -> MetricsReg
         "ZooKeeper watch notifications delivered to this client "
         "(the firehose behind cache invalidation and watch re-arm)",
     )
+    write_refusals = reg.counter(
+        "registrar_write_refusals_total",
+        "ZooKeeper writes refused by reason (read_only = the request "
+        "reached a read-only minority/quorum-loss member; retried once "
+        "the client fails over — ISSUE 10)",
+    )
+    member_role = reg.gauge(
+        "registrar_zk_member_role",
+        "Info gauge: 1 for the kind of ensemble member the session is "
+        "attached to (role=read_write|read_only|disconnected)",
+    )
 
     start = time.monotonic()
     uptime.set_function(lambda: time.monotonic() - start)
@@ -629,6 +640,24 @@ def instrument(ee, zk, registry: Optional[MetricsRegistry] = None) -> MetricsReg
         resumes.inc(0, labels={"outcome": outcome})
     for result in ("applied", "noop", "failed"):
         reloads.inc(0, labels={"result": result})
+    for reason in ("read_only",):
+        write_refusals.inc(0, labels={"reason": reason})
+
+    member_roles = ("read_write", "read_only", "disconnected")
+
+    def set_member_role(*_a) -> None:
+        if zk.connected:
+            role = (
+                "read_only"
+                if getattr(zk, "read_only", False)
+                else "read_write"
+            )
+        else:
+            role = "disconnected"
+        for r in member_roles:
+            member_role.set(1.0 if r == role else 0.0, labels={"role": r})
+
+    set_member_role()
 
     def on_sweep(summary) -> None:
         sweeps.inc()
@@ -638,6 +667,11 @@ def instrument(ee, zk, registry: Optional[MetricsRegistry] = None) -> MetricsReg
     zk.on("session_reborn", lambda *_a: rebirths.inc())
     zk.on("rebirth_breaker_tripped", lambda *_a: breaker_trips.inc())
     zk.on("watch", lambda *_a: watch_events.inc())
+    zk.on(
+        "write_refused",
+        lambda reason: write_refusals.inc(labels={"reason": reason}),
+    )
+    zk.on("state", set_member_role)
     ee.on("handoff", lambda *_a: handoffs.inc())
     ee.on("drain", lambda *_a: drains.inc())
     ee.on("resume", lambda outcome: resumes.inc(labels={"outcome": outcome}))

@@ -64,8 +64,16 @@ class RetryPolicy:
     def delay(self, attempt: int) -> float:
         """Deterministic delay before retry number ``attempt`` (0-based) —
         the pure doubling schedule, jitter ignored (kept stable for the
-        reference-parity pins in tests/test_retry.py)."""
-        return min(self.initial_delay * (2**attempt), self.max_delay)
+        reference-parity pins in tests/test_retry.py).
+
+        The exponent is clamped: an unbounded reconnect loop that has
+        been retrying for hours reaches attempts past 1024, where a raw
+        ``2**attempt`` overflows float conversion and the retry loop —
+        the thing keeping a disconnected daemon alive — dies with
+        OverflowError.  2**64 × any initial_delay is already beyond any
+        real max_delay, so the clamp never changes a produced value.
+        """
+        return min(self.initial_delay * (2 ** min(attempt, 64)), self.max_delay)
 
     def schedule(self, rng: Optional[random.Random] = None) -> Iterator[float]:
         """Yield successive backoff delays, honoring the jitter mode.
@@ -110,7 +118,10 @@ def is_transient(err: BaseException) -> bool:
     Transient: CONNECTION_LOSS (the connection died; a reconnect may
     already be in progress), OPERATION_TIMEOUT (a per-operation deadline
     tore the connection down, :class:`~registrar_tpu.zk.client.
-    OperationTimeoutError`), and plain socket/timeout errors.
+    OperationTimeoutError`), NOT_READONLY (the write reached a read-only
+    minority member — it succeeds once the client fails over to a
+    read-write member or quorum returns, which the client's rw-probe
+    drives), and plain socket/timeout errors.
 
     NOT transient: SESSION_EXPIRED (a dead session cannot be retried back
     to life — the orchestrator must build a new one) and every other
@@ -127,7 +138,9 @@ def is_transient(err: BaseException) -> bool:
     retry boundary is decided HERE, not by silence.
     """
     if isinstance(err, ZKError):
-        return err.code in (Err.CONNECTION_LOSS, Err.OPERATION_TIMEOUT)
+        return err.code in (
+            Err.CONNECTION_LOSS, Err.OPERATION_TIMEOUT, Err.NOT_READONLY
+        )
     if isinstance(err, (ValueError, RuntimeError)):
         return False
     return isinstance(err, (ConnectionError, asyncio.TimeoutError, OSError))

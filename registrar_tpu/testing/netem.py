@@ -49,7 +49,7 @@ import asyncio
 import logging
 import socket
 import struct
-from typing import Dict, List, Optional, Set, Tuple
+from typing import Dict, List, Optional, Sequence, Set, Tuple
 
 from registrar_tpu.events import spawn_owned
 
@@ -497,3 +497,37 @@ STORM_TOXICS = {
     "slicer": lambda rng: Slicer(max_size=rng.randint(2, 16)),
     "reset": lambda rng: ResetAfter(n=rng.randint(0, 4096)),
 }
+
+
+async def proxy_fleet(
+    addresses: Sequence[Tuple[str, int]],
+    rng=None,
+    sock_buf: Optional[int] = None,
+) -> List["ChaosProxy"]:
+    """One started :class:`ChaosProxy` per upstream address, each with
+    its own seed drawn from ``rng`` (a ``random.Random``; None = module
+    RNG).
+
+    The ensemble front-door shape (ISSUE 10): a client pointed at the
+    returned proxies' addresses reaches every ensemble member through an
+    independently faultable wire — the chaos storm's ensemble leg and
+    the SLO harness's ensemble mode both build their fleets with this,
+    so per-member network faults and member kills compose.  Callers own
+    the proxies (``stop()`` each when done).
+    """
+    import random as random_mod
+
+    draw = (rng or random_mod).randrange
+    proxies: List[ChaosProxy] = []
+    try:
+        for address in addresses:
+            proxies.append(
+                await ChaosProxy(
+                    address, seed=draw(2**32), sock_buf=sock_buf
+                ).start()
+            )
+    except BaseException:
+        for proxy in proxies:
+            await proxy.stop()
+        raise
+    return proxies

@@ -12,7 +12,13 @@ subset our client uses), with genuine session semantics:
   * session reattachment by (session_id, passwd) within the timeout,
   * one-shot watches (data / exists / children) with NodeCreated /
     NodeDeleted / NodeDataChanged / NodeChildrenChanged notifications,
-  * zxid ordering across all write ops.
+  * zxid ordering across all write ops,
+  * a real leader/follower replication protocol for ensembles (ISSUE
+    10): quorum-gated writes ordered by the elected leader, elections
+    with a configurable window, read-only minority mode behind the 3.4
+    ``read_only`` handshake flag, committed-backlog catch-up for
+    rejoining members, and leader-only session expiry (see
+    :class:`_SharedState` and :class:`ZKEnsemble`).
 
 Because the client under test talks to this server over an actual socket,
 the full wire path (framing, jute encoding, xid bookkeeping, watch
@@ -36,8 +42,9 @@ import logging
 import os
 import signal
 import time
+from collections import deque
 from dataclasses import dataclass, field
-from typing import Dict, List, Optional, Set, Tuple
+from typing import Deque, Dict, List, Optional, Sequence, Set, Tuple
 
 from registrar_tpu.events import spawn_owned
 from registrar_tpu.zk import protocol as proto
@@ -268,6 +275,17 @@ _FOUR_LETTER_WORDS = frozenset(
 
 _SERVER_VERSION = "3.4.14-registrar-tpu-testing"
 
+#: state-changing (quorum) opcodes gated by the replication write gate:
+#: a read-only member answers them NOT_READONLY, a mid-election member
+#: drops the connection.  SYNC is quorum-bound too — it flushes the
+#: leader's pipeline, which a partitioned minority cannot reach.
+_QUORUM_OPS = frozenset(
+    (
+        OpCode.CREATE, OpCode.DELETE, OpCode.SET_DATA, OpCode.SET_ACL,
+        OpCode.MULTI, OpCode.SYNC,
+    )
+)
+
 
 class _SharedState:
     """Replicated state an ensemble's members hold in common.
@@ -285,6 +303,23 @@ class _SharedState:
     pre-commit state when another member commits, and catches up on
     sync()/own-write/quiescence (see ZKServer.apply_delay_ms) — the
     stale-follower-read behavior sync() exists to fence.
+
+    ISSUE 10 adds the replication *protocol* on top of the replicated
+    state: one elected leader orders and commits writes (ZAB-style zxid
+    ordering through :meth:`ZKServer._next_zxid`, which also appends to
+    the committed-backlog ``log``), and a write is only admitted while
+    the serving member can reach a leader holding **quorum**
+    (``ensemble_size // 2 + 1`` live members in its partition group).  A
+    member cut off from quorum degrades to ZooKeeper's read-only mode:
+    its read view freezes (majority commits are invisible across a
+    partition), the ``read_only`` handshake flag gates which clients may
+    attach, and writes answer ``Err.NOT_READONLY``.  Elections take
+    ``election_ms`` (members are ``looking`` and drop writers meanwhile,
+    like real followers that lost their leader); only the leader expires
+    sessions, so a quorum-less ensemble keeps every session — and its
+    ephemerals — frozen until quorum returns, exactly the property the
+    registrar fleet's "writes resume without operator action" recovery
+    depends on.
     """
 
     def __init__(self) -> None:
@@ -318,12 +353,138 @@ class _SharedState:
         #: follower applying the backlog would still fire the armed
         #: exists watch's NODE_CREATED (round-4 advisor finding).
         self.lag_creates: Dict[str, int] = {}
+        # -- replication protocol (ISSUE 10); inert for standalone
+        # -- servers, configured by ZKEnsemble ---------------------------
+        #: configured member count (NOT live count: quorum arithmetic is
+        #: over the configured ensemble, like real ZK's QuorumMaj)
+        self.ensemble_size = 1
+        #: writes need a leader that can reach this many live members
+        self.quorum = 1
+        #: election duration (ms): leader death -> new leader serving
+        self.election_ms = 0.0
+        #: the elected leader, or None (mid-election / quorum lost)
+        self.leader: Optional["ZKServer"] = None
+        #: monotonic deadline of the pending election; None = no pending
+        self.election_due: Optional[float] = None
+        #: monotonic stamp of the current election's start (MTTR math)
+        self.election_started: Optional[float] = None
+        #: completed elections (test/4lw observability)
+        self.elections = 0
+        #: member-connectivity partition groups as sets of server_ids;
+        #: None = fully connected (set via ZKEnsemble.partition)
+        self.groups: Optional[List[Set[int]]] = None
+        #: committed-transaction backlog: (zxid, op, path) per commit,
+        #: bounded — a rejoining member whose departure point fell off
+        #: the tail must take a full snapshot instead of a diff replay
+        #: (ZKEnsemble(backlog_max=...) sizes it)
+        self.log: Deque[Tuple[int, str, str]] = deque(maxlen=512)
         ensure_system_nodes(self.root)
 
     def recount_lag(self) -> None:
         self.lag_members = sum(
             1 for m in self.members if m.apply_delay_ms > 0
         )
+
+    # -- quorum / election (ISSUE 10) ----------------------------------------
+
+    def _group_ids(self, server_id: int) -> Optional[Set[int]]:
+        """The partition group containing ``server_id`` (None = all)."""
+        if self.groups is None:
+            return None
+        for group in self.groups:
+            if server_id in group:
+                return group
+        return {server_id}  # unlisted member: isolated
+
+    def reachable(self, member: "ZKServer") -> List["ZKServer"]:
+        """Live members ``member`` can talk to (its partition group)."""
+        group = self._group_ids(member.server_id)
+        return [
+            m for m in self.members
+            if group is None or m.server_id in group
+        ]
+
+    def _quorum_candidates(self) -> List["ZKServer"]:
+        return [
+            m for m in self.members if len(self.reachable(m)) >= self.quorum
+        ]
+
+    def reevaluate(self) -> None:
+        """Recompute leadership and roles after a membership or
+        partition change.
+
+        A live leader that still reaches quorum keeps the crown (a
+        rejoining follower never forces an election, like real ZK); a
+        dead or isolated leader starts an election over the members that
+        can still assemble quorum, completing after ``election_ms``
+        (the sweep loops drive completion; 0 = instant).  With no quorum
+        anywhere, every member degrades to read-only and the election
+        stays parked until membership changes again.
+        """
+        lead = self.leader
+        if (
+            lead is not None
+            and lead in self.members
+            and len(self.reachable(lead)) >= self.quorum
+        ):
+            self.election_due = None
+            self._assign_roles(lead)
+            return
+        self.leader = None
+        candidates = self._quorum_candidates()
+        if not candidates:
+            # No quorum anywhere: park the election, everyone read-only.
+            self.election_due = None
+            self.election_started = None
+            self._assign_roles(None)
+            return
+        now = time.monotonic()
+        if self.election_due is None:
+            self.election_started = now
+            self.election_due = now + self.election_ms / 1000.0
+            for member in self.members:
+                member._set_role(
+                    "looking" if member in candidates else "read-only"
+                )
+        if self.election_ms <= 0 or now >= self.election_due:
+            self.complete_election()
+
+    def complete_election(self) -> None:
+        """Elect the most-caught-up candidate (highest applied zxid,
+        ties to the lowest server_id — real ZK's epoch/zxid/sid order)."""
+        self.election_due = None
+        candidates = self._quorum_candidates()
+        if not candidates:
+            self._assign_roles(None)
+            return
+        leader = max(
+            candidates, key=lambda m: (m._view_zxid(), -m.server_id)
+        )
+        self.elections += 1
+        elapsed = (
+            time.monotonic() - self.election_started
+            if self.election_started is not None
+            else 0.0
+        )
+        self.election_started = None
+        log.debug(
+            "member %d elected leader (election %d, %.0f ms)",
+            leader.server_id, self.elections, elapsed * 1000.0,
+        )
+        self._assign_roles(leader)
+
+    def _assign_roles(self, leader: Optional["ZKServer"]) -> None:
+        self.leader = leader
+        in_quorum = (
+            set(self.reachable(leader)) if leader is not None else set()
+        )
+        for member in self.members:
+            if member in in_quorum:
+                member._set_role(
+                    "leader" if member is leader else "follower"
+                )
+            else:
+                member._set_role("read-only")
 
 
 def ensure_system_nodes(root: ZNode) -> None:
@@ -395,8 +556,11 @@ class ZKServer:
         self.max_session_timeout_ms = max_session_timeout_ms
         self.tick_ms = tick_ms
         self.server_id = server_id
-        #: reported by the srvr/mntr admin words; ZKEnsemble sets
-        #: "leader"/"follower"
+        #: the member's replication role, reported by the srvr/mntr admin
+        #: words and enforced by the write gate: "standalone" (no
+        #: ensemble), "leader" / "follower" (in quorum), "read-only"
+        #: (minority / quorum lost), "looking" (mid-election).  Assigned
+        #: by _SharedState.reevaluate for ensemble members.
         self.mode = "standalone"
         self._is_ensemble_member = shared is not None
         if snapshot is not None and shared is not None:
@@ -443,6 +607,26 @@ class ZKServer:
         #: connections refused because the client had seen a newer zxid
         #: than this member's view (test observability)
         self.refused_count = 0
+        #: handshakes refused because this member is read-only and the
+        #: client did not offer the read_only flag (test observability)
+        self.refused_ro = 0
+        #: handshakes refused because this member was mid-election
+        #: ("looking"; test observability — distinct from refused_count's
+        #: newer-zxid refusals)
+        self.refused_looking = 0
+        #: write requests answered NOT_READONLY while read-only
+        self.writes_refused = 0
+        #: write connections dropped mid-election ("looking")
+        self.election_drops = 0
+        #: commits this member ordered while leader (ZAB observability)
+        self.commits = 0
+        #: writes this member forwarded to the leader while follower
+        self.forwarded_writes = 0
+        #: catch-up bookkeeping: committed-backlog txns replayed on
+        #: rejoin/catch-up, and full-snapshot restores (backlog truncated
+        #: past the member's departure point)
+        self.catchup_replayed = 0
+        self.catchup_snapshots = 0
         #: soft-quota violations logged by this member (test observability)
         self.quota_warnings = 0
         #: request/reply counters surfaced via the 4lw admin commands.
@@ -528,6 +712,10 @@ class ZKServer:
         self.port = self._server.sockets[0].getsockname()[1]
         self._state.members.add(self)
         self._state.recount_lag()
+        if self._is_ensemble_member:
+            # Joining member: roles recompute (a live leader keeps the
+            # crown; a quorum-less ensemble may become electable again).
+            self._state.reevaluate()
         self._sweeper = asyncio.create_task(self._sweep_loop())
         log.debug("ZKServer listening on %s:%d", self.host, self.port)
         return self
@@ -540,6 +728,11 @@ class ZKServer:
     async def stop(self) -> None:
         self._state.members.discard(self)
         self._state.recount_lag()
+        if self._is_ensemble_member:
+            # Departing member: a dead leader triggers an election; a
+            # death that breaks quorum degrades the survivors to
+            # read-only (their write gate starts refusing).
+            self._state.reevaluate()
         if self._sweeper:
             self._sweeper.cancel()
             try:
@@ -566,6 +759,83 @@ class ZKServer:
     @property
     def address(self) -> Tuple[str, int]:
         return (self.host, self.port)
+
+    # -- replication roles (ISSUE 10) ----------------------------------------
+
+    def _freeze_view(self) -> None:
+        """Pin this member's read view at the current replicated state
+        (used on entering read-only: majority commits made across a
+        partition must be invisible here until the member rejoins)."""
+        if self._lag_root is None:
+            self._lag_root = _clone_tree(self._state.root)
+            self._lag_zxid = self._state.zxid
+
+    def _set_role(self, role: str) -> None:
+        """Apply a role transition computed by the shared-state election.
+
+        Entering ``read-only`` freezes the read view and drops every
+        client connection (real ZK restarts the server in ro mode; the
+        surviving clients must renegotiate with the ``read_only``
+        handshake flag or fail over).  Leaving it catches the member up
+        — counted as backlog replay or a snapshot restore — and drops
+        connections again so ro sessions renegotiate to read-write.
+        Leader/follower churn keeps connections: a follower serving a
+        client does not care which member orders the commits.
+        """
+        old = self.mode
+        if old == role:
+            return
+        self.mode = role
+        if role == "read-only":
+            self._freeze_view()
+            if old in ("leader", "follower", "looking"):
+                self._spawn(self.drop_connections())
+            log.debug("member %d degraded to read-only", self.server_id)
+        elif old == "read-only":
+            self._count_catchup()
+            self._catch_up()
+            self._spawn(self.drop_connections())
+            log.debug(
+                "member %d rejoined quorum as %s", self.server_id, role
+            )
+
+    def _count_catchup(self) -> None:
+        """Account a frozen (read-only) member's pending catch-up from
+        its applied zxid — the rejoin-after-partition shape."""
+        if self._lag_root is not None:
+            self.catchup_from(self._lag_zxid)
+
+    def catchup_from(self, departed_zxid: Optional[int]) -> None:
+        """Account a rejoin sync from ``departed_zxid`` — the ONE copy
+        of the classification rule, shared by restart-after-kill
+        (ZKEnsemble.restart) and partition-heal (_count_catchup): diff
+        replay when the committed backlog still covers the departure
+        point, else a full snapshot restore (real ZK's DIFF vs SNAP)."""
+        if departed_zxid is None or self._state.zxid <= departed_zxid:
+            return
+        backlog = self._state.log
+        if backlog and backlog[0][0] <= departed_zxid + 1:
+            self.catchup_replayed += sum(
+                1 for zxid, _, _ in backlog if zxid > departed_zxid
+            )
+        else:
+            # The departure point fell off the bounded backlog: a real
+            # member would transfer a full snapshot (SNAP sync).
+            self.catchup_snapshots += 1
+
+    def _write_gate(self) -> str:
+        """Admission verdict for a state-changing request on this member:
+        ``"ok"`` (leader reachable with quorum — commit proceeds),
+        ``"ro"`` (read-only: answer NOT_READONLY), ``"drop"``
+        (mid-election: drop the connection, like a follower that lost
+        its leader)."""
+        if not self._is_ensemble_member:
+            return "ok"
+        if self.mode in ("leader", "follower"):
+            return "ok"
+        if self.mode == "looking":
+            return "drop"
+        return "ro"
 
     # -- test controls ------------------------------------------------------
 
@@ -783,7 +1053,12 @@ class ZKServer:
         if cmd == "ruok":
             return "imok"
         if cmd == "isro":
-            return "rw"
+            # "ro" for a read-only (minority) member — and mid-election,
+            # when the member cannot admit writers either; the client's
+            # rw-probe uses this to find a serving read-write member.
+            return (
+                "ro" if self.mode in ("read-only", "looking") else "rw"
+            )
         nodes, data_size = self._count_nodes()
         watches, watched_paths = self._watch_stats()
         if cmd == "srvr" or cmd == "stat":
@@ -811,6 +1086,15 @@ class ZKServer:
                 f"Mode: {self.mode}",
                 f"Node count: {nodes}",
             ]
+            if self._is_ensemble_member:
+                # Election/quorum observability (ISSUE 10): operators and
+                # tests read the member's real role, applied zxid (the
+                # Zxid line above), and quorum shape off one probe.
+                lines += [
+                    f"Quorum size: {self._state.quorum}",
+                    f"Ensemble size: {self._state.ensemble_size}",
+                    f"Elections: {self._state.elections}",
+                ]
             return "\n".join(lines) + "\n"
         if cmd == "mntr":
             ephemerals = sum(len(s.ephemerals) for s in self.sessions.values())
@@ -828,6 +1112,18 @@ class ZKServer:
                 ("zk_approximate_data_size", data_size),
                 ("zk_expired_sessions", self.expired_count),
             ]
+            if self._is_ensemble_member:
+                rows += [
+                    ("zk_quorum_size", self._state.quorum),
+                    ("zk_ensemble_size", self._state.ensemble_size),
+                    ("zk_applied_zxid", self._view_zxid()),
+                    ("zk_elections", self._state.elections),
+                    ("zk_write_refusals", self.writes_refused),
+                    ("zk_leader_commits", self.commits),
+                    ("zk_forwarded_writes", self.forwarded_writes),
+                    ("zk_catchup_replayed_txns", self.catchup_replayed),
+                    ("zk_catchup_snapshot_loads", self.catchup_snapshots),
+                ]
             return "".join(f"{k}\t{v}\n" for k, v in rows)
         if cmd == "cons":
             lines = []
@@ -923,6 +1219,12 @@ class ZKServer:
         while True:
             await asyncio.sleep(self.tick_ms / 1000.0)
             now = time.monotonic()
+            # A pending election completes after election_ms (driven by
+            # whichever member's sweeper ticks first — deterministic to
+            # within one tick, which the tests budget for).
+            st = self._state
+            if st.election_due is not None and now >= st.election_due:
+                st.complete_election()
             # Lagging member batch catch-up: once the commit stream has
             # been quiescent for apply_delay_ms, the member applies its
             # backlog (real followers stream commits; quiescence-gating is
@@ -933,6 +1235,14 @@ class ZKServer:
                 and now - self._state.last_commit >= self.apply_delay_ms / 1000.0
             ):
                 self._catch_up()
+            # Only the LEADER expires sessions (real ZK's session tracker
+            # lives on the leader): a quorum-less ensemble keeps every
+            # session — and its ephemerals — frozen until quorum returns,
+            # so a fleet riding out an outage through a read-only member
+            # resumes with the same sessions.  Standalone servers sweep
+            # as before.
+            if self._is_ensemble_member and st.leader is not self:
+                continue
             for sess in list(self.sessions.values()):
                 # A live connection keeps the session alive via pings; the
                 # expiry countdown only runs while disconnected (matching
@@ -1068,7 +1378,7 @@ class ZKServer:
         parent, _, name = path.rpartition("/")
         return (parent or "/", name)
 
-    def _next_zxid(self) -> int:
+    def _next_zxid(self, op: str = "", path: str = "") -> int:
         # A commit is about to apply to the replicated state: every other
         # live member configured to lag, and currently caught up, freezes
         # its read view at the pre-commit state.  (The committing member
@@ -1088,6 +1398,17 @@ class ZKServer:
                     member._lag_zxid = self._state.zxid
         self.zxid += 1
         self._state.last_commit = time.monotonic()
+        if self._is_ensemble_member:
+            # ZAB bookkeeping: the LEADER orders and commits every write
+            # (a serving follower forwards — here, the shared state makes
+            # the forward a direct commit through the same zxid order);
+            # the bounded committed backlog feeds rejoin catch-up.
+            leader = self._state.leader
+            if leader is not None:
+                leader.commits += 1
+                if leader is not self:
+                    self.forwarded_writes += 1
+            self._state.log.append((self.zxid, op, path))
         return self.zxid
 
     def _view_zxid(self) -> int:
@@ -1105,8 +1426,13 @@ class ZKServer:
         compare each armed path's stale state against the live tree and
         synthesize the missed event — the same reconciliation the
         SetWatches handler performs for reconnecting clients.
+
+        A read-only member never catches up here: across a partition the
+        majority's commits are unreachable, so its view stays frozen
+        until the election machinery readmits it (``_set_role`` flips
+        the role back first, then drives this catch-up).
         """
-        if self._lag_root is None:
+        if self._lag_root is None or self.mode == "read-only":
             return
         stale_root, self._lag_root = self._lag_root, None
         frozen_zxid = self._lag_zxid
@@ -1342,7 +1668,7 @@ class ZKServer:
         if name in parent.children:
             raise proto.ZKError(Err.NODE_EXISTS, path)
 
-        zxid = self._next_zxid()
+        zxid = self._next_zxid("create", path)
         if self._state.lag_members:
             self._state.lag_creates[path] = zxid
         now = _now_ms()
@@ -1391,7 +1717,7 @@ class ZKServer:
             raise proto.ZKError(Err.NOT_EMPTY, path)
         # Allocate the zxid before mutating: lagging members freeze their
         # read view at the pre-commit state inside _next_zxid.
-        zxid = self._next_zxid()
+        zxid = self._next_zxid("delete", path)
         del parent.children[name]
         parent.cversion += 1
         parent.pzxid = zxid
@@ -1422,7 +1748,7 @@ class ZKServer:
         if version != -1 and node.version != version:
             raise proto.ZKError(Err.BAD_VERSION, path)
         # zxid first: _next_zxid freezes lagging members' pre-commit view.
-        node.mzxid = self._next_zxid()
+        node.mzxid = self._next_zxid("setData", path)
         node.data = data or b""
         node.version += 1
         node.mtime = _now_ms()
@@ -1681,6 +2007,24 @@ class ZKServer:
                 req.session_id, req.last_zxid_seen, view_zxid,
             )
             return
+        if self.mode == "looking":
+            # Mid-election a real member is not serving clients at all
+            # (LOOKING state closes the client port); refuse by closing,
+            # the same wire shape as the zxid refusal but counted apart
+            # — the client's next reconnect attempt lands after the
+            # election.
+            self.refused_looking += 1
+            return
+        if self.mode == "read-only" and not req.read_only:
+            # Real ZooKeeper's ReadOnlyZooKeeperServer only admits
+            # clients that set the ConnectRequest read_only flag
+            # (canBeReadOnly); everyone else is refused so they keep
+            # looking for a read-write member.
+            self.refused_ro += 1
+            log.debug(
+                "refusing non-read-only client while in read-only mode"
+            )
+            return
         sess = self._establish_session(req)
         w = Writer()
         if sess is None:
@@ -1708,6 +2052,10 @@ class ZKServer:
             timeout_ms=sess.timeout_ms,
             session_id=sess.session_id,
             passwd=sess.passwd,
+            # The 3.4 wire flag (protocol.py ConnectResponse): tells the
+            # client it attached to a read-only member — reads serve,
+            # writes answer NOT_READONLY until it fails over.
+            read_only=self.mode == "read-only",
         ).write(w)
         await conn.send(w.to_bytes())
 
@@ -1721,6 +2069,25 @@ class ZKServer:
             r = Reader(payload)
             hdr = proto.RequestHeader.read(r)
             if hdr.type == OpCode.CLOSE_SESSION:
+                # closeSession is a QUORUM transaction too (it commits
+                # the ephemeral deletes): a read-only minority member
+                # cannot process it — the session and its ephemerals
+                # stay alive until a leader expires them, exactly the
+                # frozen-until-quorum invariant; mid-election the writer
+                # is dropped like any other write.
+                if self._is_ensemble_member:
+                    verdict = self._write_gate()
+                    if verdict == "drop":
+                        self.election_drops += 1
+                        await conn.flush()
+                        await conn.close()
+                        return
+                    if verdict == "ro":
+                        self.writes_refused += 1
+                        await conn.send(
+                            self._reply(hdr.xid, Err.NOT_READONLY)
+                        )
+                        return
                 await self._close_session(sess)
                 w = Writer()
                 proto.ReplyHeader(hdr.xid, self.zxid, Err.OK).write(w)
@@ -1743,6 +2110,23 @@ class ZKServer:
                     # Real ZK answers AUTH_FAILED then drops the connection.
                     return
                 continue
+            if hdr.type in _QUORUM_OPS and self._is_ensemble_member:
+                verdict = self._write_gate()
+                if verdict == "drop":
+                    # Mid-election: a follower that lost its leader drops
+                    # its writers (the in-flight op surfaces client-side
+                    # as CONNECTION_LOSS, retryable); queued replies for
+                    # earlier reads in the burst still go out.
+                    self.election_drops += 1
+                    await conn.flush()
+                    await conn.close()
+                    return
+                if verdict == "ro":
+                    self.writes_refused += 1
+                    conn.queue(self._reply(hdr.xid, Err.NOT_READONLY))
+                    if conn.queue_full() or not frames.pending():
+                        await conn.flush()
+                    continue
             reply = await self._dispatch(conn, sess, hdr, r)
             if reply is not None:
                 conn.queue(reply)
@@ -1879,7 +2263,8 @@ class ZKServer:
                 # is allocated — a failed op must not consume a zxid or
                 # freeze lagging members.
                 fixed_acls = self._fix_acls(req.acls, sess)
-                self._next_zxid()  # a write transaction, but mzxid untouched
+                # a write transaction, but mzxid untouched
+                self._next_zxid("setAcl", req.path)
                 node.acls = fixed_acls
                 node.aversion += 1
                 self._catch_up()
@@ -1999,6 +2384,19 @@ class ZKEnsemble:
     :class:`_SharedState`.  Watches set via one member fire on writes made
     through any member.
 
+    ISSUE 10 makes the ensemble a real replication protocol, not just
+    shared state: one elected **leader** orders and commits writes at
+    quorum (``size // 2 + 1``); killing it runs an election
+    (``election_ms`` wide) during which candidates are ``looking``;
+    members cut off from quorum — by deaths or by
+    :meth:`partition` — serve **read-only** from a frozen
+    zxid-consistent view (3.4 ``read_only`` handshake; writes answer
+    NOT_READONLY) until quorum returns; a member
+    :meth:`restart`\\ ed after :meth:`kill` catches up by committed-
+    backlog replay or a snapshot (``backlog_max``); and only the leader
+    expires sessions, so a quorum-less ensemble freezes every session
+    in place.
+
     Usage::
 
         async with ZKEnsemble(3) as ens:
@@ -2016,14 +2414,32 @@ class ZKEnsemble:
         size: int = 3,
         host: str = "127.0.0.1",
         base_port: Optional[int] = None,
+        election_ms: float = 0.0,
+        backlog_max: int = 512,
         **server_kwargs,
     ):
         """``base_port``: members listen on consecutive ports starting
         here (for operators wanting a predictable servers list); default
-        lets the OS pick free ports (right for tests)."""
+        lets the OS pick free ports (right for tests).
+
+        ``election_ms``: how long a leader election takes (ISSUE 10).
+        0 (the default) elects instantly — the pre-quorum tests' shape;
+        > 0 opens a real election window after a leader death during
+        which candidate members are ``looking`` (handshakes refused,
+        write connections dropped) and the failover MTTR a client
+        measures includes the wait.
+
+        ``backlog_max``: committed-transaction backlog bound.  A member
+        rejoining within the backlog catches up by diff replay
+        (``catchup_replayed``); one whose departure point fell off the
+        tail takes a full snapshot (``catchup_snapshots``)."""
         if size < 1:
             raise ValueError("ensemble size must be >= 1")
         self.state = _SharedState()
+        self.state.ensemble_size = size
+        self.state.quorum = size // 2 + 1
+        self.state.election_ms = election_ms
+        self.state.log = deque(maxlen=max(1, backlog_max))
         self.servers: List[Optional[ZKServer]] = []
         self._host = host
         self._server_kwargs = server_kwargs
@@ -2031,6 +2447,9 @@ class ZKEnsemble:
         self._ports: List[Optional[int]] = [
             base_port + i if base_port else None for i in range(size)
         ]
+        #: shared-state zxid at the moment each member was killed — the
+        #: rejoin sync point (snapshot-vs-replay accounting in restart())
+        self._departed_zxid: Dict[int, int] = {}
 
     def _new_member(self, i: int, port: int = 0) -> ZKServer:
         member = ZKServer(
@@ -2049,6 +2468,12 @@ class ZKEnsemble:
             await member.start()
             self._ports[i] = member.port
             self.servers.append(member)
+        # The INITIAL election completes immediately even with an
+        # election window configured: the window models failover (a
+        # leader dying under live clients), not cold boot — tests must
+        # be able to connect the moment start() returns.
+        if self.state.election_due is not None:
+            self.state.complete_election()
         self._elect()
         return self
 
@@ -2070,14 +2495,56 @@ class ZKEnsemble:
         return [(self._host, p) for p in self._ports if p is not None]
 
     def _elect(self) -> None:
-        # Cosmetic leader/follower labels for the srvr/mntr admin words;
-        # replication itself needs no leader here (single event loop).
-        leader_set = False
-        for member in self.servers:
-            if member is None or member._server is None:
-                continue
-            member.mode = "follower" if leader_set else "leader"
-            leader_set = True
+        # Role assignment is the shared state's election machinery
+        # (ISSUE 10); member start()/stop() already trigger it — this
+        # remains as the explicit recompute hook.
+        self.state.reevaluate()
+
+    @property
+    def leader_index(self) -> Optional[int]:
+        """Index (into ``servers``) of the current leader, or None
+        (mid-election / quorum lost)."""
+        leader = self.state.leader
+        if leader is None:
+            return None
+        for i, member in enumerate(self.servers):
+            if member is leader:
+                return i
+        return None
+
+    @property
+    def has_quorum(self) -> bool:
+        return self.state.leader is not None
+
+    @property
+    def size(self) -> int:
+        return self._size
+
+    def partition(self, groups: Sequence[Sequence[int]]) -> None:
+        """Split member-to-member connectivity into ``groups`` of member
+        indices (0-based).  The group that can assemble quorum keeps (or
+        elects) the leader; every other member degrades to read-only
+        with a frozen view — the partition-to-minority fault class.
+        Members not named in any group are isolated singletons.
+        """
+        seen: Set[int] = set()
+        for group in groups:
+            for i in group:
+                if not 0 <= i < self._size:
+                    raise ValueError(f"member index {i} out of range")
+                if i in seen:
+                    raise ValueError(f"member {i} in more than one group")
+                seen.add(i)
+        # groups are stored by server_id (= index + 1, stable across
+        # member restarts)
+        self.state.groups = [{i + 1 for i in group} for group in groups]
+        self.state.reevaluate()
+
+    def heal_partition(self) -> None:
+        """Restore full member-to-member connectivity (rejoining
+        minority members catch up and resume as followers)."""
+        self.state.groups = None
+        self.state.reevaluate()
 
     async def kill(self, i: int) -> None:
         """Stop member ``i`` (connections die; sessions and ephemerals
@@ -2085,20 +2552,25 @@ class ZKEnsemble:
         member = self.servers[i]
         if member is None or member._server is None:
             return
+        # The rejoin sync point: what this member had applied when it
+        # departed (its view zxid — a lagging/ro member is behind the
+        # shared head, and restart() owes it the difference).
+        self._departed_zxid[i] = member._view_zxid()
         await member.stop()
         self.servers[i] = None
-        self._elect()
 
     async def restart(self, i: int) -> ZKServer:
         """Bring member ``i`` back on its original port, joined to the
-        ensemble's shared state."""
+        ensemble's shared state — catching up via committed-backlog
+        replay, or a full snapshot when the backlog no longer covers its
+        departure point (``catchup_replayed`` / ``catchup_snapshots``)."""
         if self.servers[i] is not None and self.servers[i]._server is not None:
             return self.servers[i]
         member = self._new_member(i, port=self._ports[i] or 0)
         await member.start()
+        member.catchup_from(self._departed_zxid.pop(i, None))
         self._ports[i] = member.port
         self.servers[i] = member
-        self._elect()
         return member
 
     def set_lag(self, i: int, apply_delay_ms: int) -> None:
@@ -2145,17 +2617,27 @@ async def _ctl_conn(ens: "ZKEnsemble", size: int, reader, writer) -> None:
             parts = line.decode("ascii", errors="replace").split()
             try:
                 action = parts[0]
-                member = int(parts[1]) - 1
-                if not 0 <= member < size:
-                    raise ValueError(f"member {parts[1]} out of range")
-                if action == "stop":
-                    await ens.kill(member)
-                elif action == "start":
-                    await ens.restart(member)
-                elif action == "lag":
-                    ens.set_lag(member, int(parts[2]))
+                if action == "heal":
+                    ens.heal_partition()
+                elif action == "partition":
+                    # 'partition 1,2|3' — groups of 1-based members
+                    groups = [
+                        [int(m) - 1 for m in grp.split(",") if m]
+                        for grp in parts[1].split("|")
+                    ]
+                    ens.partition(groups)
                 else:
-                    raise ValueError(f"unknown action {action!r}")
+                    member = int(parts[1]) - 1
+                    if not 0 <= member < size:
+                        raise ValueError(f"member {parts[1]} out of range")
+                    if action == "stop":
+                        await ens.kill(member)
+                    elif action == "start":
+                        await ens.restart(member)
+                    elif action == "lag":
+                        ens.set_lag(member, int(parts[2]))
+                    else:
+                        raise ValueError(f"unknown action {action!r}")
             except (IndexError, ValueError) as e:
                 writer.write(f"err {e}\n".encode())
             except Exception as e:  # noqa: BLE001 - report, keep serving
@@ -2208,6 +2690,13 @@ async def _amain(argv=None) -> None:
         "read barrier from the command line",
     )
     parser.add_argument(
+        "--election-ms", type=float, default=0.0, metavar="MS",
+        help="(ensemble only) leader-election duration: after a leader "
+        "death, candidate members spend MS milliseconds 'looking' "
+        "(handshakes refused, writers dropped) before the new leader "
+        "serves — rehearses client failover MTTR from the command line",
+    )
+    parser.add_argument(
         "--ctl-port", type=int, default=None, metavar="PORT",
         help="(ensemble only) listen on PORT (0 = pick a free one) for "
         "line-oriented member control: 'stop N' / 'start N' / 'lag N MS' "
@@ -2252,6 +2741,7 @@ async def _amain(argv=None) -> None:
             size=args.ensemble,
             host=args.host,
             base_port=args.port or None,
+            election_ms=args.election_ms,
             max_session_timeout_ms=args.max_session_timeout,
         )
         await ens.start()

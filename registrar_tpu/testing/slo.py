@@ -9,10 +9,12 @@ envelope:
 
   * **Fleet** — N in-process registrars (the tests/test_soak.py fleet
     shape: one :class:`~registrar_tpu.zk.client.ZKClient` per member
-    against one :class:`~registrar_tpu.testing.server.ZKServer`), each
-    member connected through its own
-    :class:`~registrar_tpu.testing.netem.ChaosProxy` so per-member
-    network faults are injectable.
+    against one :class:`~registrar_tpu.testing.server.ZKServer`, or —
+    ``ensemble=`` > 1, ISSUE 10 — a quorum
+    :class:`~registrar_tpu.testing.server.ZKEnsemble` with real leader
+    elections), each member connected through its own per-backend
+    :class:`~registrar_tpu.testing.netem.ChaosProxy` so member network
+    faults and ensemble faults compose.
   * **Prober** — a continuously-polling resolver samples the Binder
     answer at a fixed cadence over BOTH read paths: live
     (:func:`registrar_tpu.binderview.resolve` against a direct client)
@@ -62,8 +64,8 @@ from registrar_tpu import trace as trace_mod
 from registrar_tpu.events import EventEmitter, spawn_owned
 from registrar_tpu.registration import register, unregister
 from registrar_tpu.retry import RetryPolicy
-from registrar_tpu.testing.netem import DOWN, UP, Blackhole, ChaosProxy
-from registrar_tpu.testing.server import ZKServer
+from registrar_tpu.testing.netem import DOWN, UP, Blackhole, ChaosProxy, proxy_fleet
+from registrar_tpu.testing.server import ZKEnsemble, ZKServer
 from registrar_tpu.zk.client import SessionExpiredError, ZKClient
 from registrar_tpu.zkcache import ZKCache
 
@@ -79,6 +81,11 @@ FAULT_IDS = (
     "health-flap",
     "expiry-storm",
     "netem-episode",
+    # ensemble fault classes (ISSUE 10; need ensemble= > 1)
+    "leader-kill",
+    "quorum-loss",
+    "rolling-upgrade",
+    "partition-minority",
 )
 
 #: nines(1.0) would be infinite; the cap keeps a flawless short trace
@@ -331,19 +338,34 @@ _MEMBER_RECONNECT = RetryPolicy(
     jitter="decorrelated",
 )
 
+#: registration retry for members (re)registering under harness faults:
+#: transient failures (CONNECTION_LOSS through a healing proxy, a
+#: NOT_READONLY from a minority member, an election-window drop) are the
+#: thing being measured — the member must keep trying through them, at
+#: harness cadence rather than the production 1-90 s envelope
+_REGISTER_RETRY = RetryPolicy(
+    max_attempts=80, initial_delay=0.05, max_delay=0.3,
+    jitter="decorrelated",
+)
+
 
 class _Member:
-    """One fleet member: its proxy, client, and registration."""
+    """One fleet member: its proxies (one per ensemble member — a single
+    list entry against a standalone server), client, and registration."""
 
-    __slots__ = ("idx", "hostname", "admin_ip", "proxy", "client", "znodes")
+    __slots__ = ("idx", "hostname", "admin_ip", "proxies", "client", "znodes")
 
     def __init__(self, idx: int, hostname: str, admin_ip: str):
         self.idx = idx
         self.hostname = hostname
         self.admin_ip = admin_ip
-        self.proxy: Optional[ChaosProxy] = None
+        self.proxies: List[ChaosProxy] = []
         self.client: Optional[ZKClient] = None
         self.znodes: List[str] = []
+
+    @property
+    def proxy(self) -> Optional[ChaosProxy]:
+        return self.proxies[0] if self.proxies else None
 
 
 class SLOHarness(EventEmitter):
@@ -369,7 +391,17 @@ class SLOHarness(EventEmitter):
         repair: bool = True,
         domain: str = "slo.fleet.us",
         tracer: Optional[trace_mod.Tracer] = None,
+        ensemble: int = 1,
+        election_ms: float = 150.0,
     ):
+        """``ensemble`` (ISSUE 10): > 1 runs the fleet against an
+        N-member :class:`ZKEnsemble` with a real leader/quorum protocol
+        — each fleet member fronts every ensemble member with its own
+        ChaosProxy, clients are ``can_be_read_only`` with seeded connect
+        order, and the ensemble fault classes (leader-kill, quorum-loss,
+        rolling-upgrade, partition-minority) become injectable.
+        ``election_ms`` sizes the leader-election window the failover
+        MTTR must ride through."""
         super().__init__()
         if members < 2:
             raise ValueError("a fleet needs at least 2 members")
@@ -380,6 +412,8 @@ class SLOHarness(EventEmitter):
         self.session_timeout_ms = session_timeout_ms
         self.repair = repair
         self.domain = domain
+        self.n_ensemble = ensemble
+        self.election_ms = election_ms
         self.fault_ids = FAULT_IDS
         self.tracer = (
             tracer
@@ -393,6 +427,7 @@ class SLOHarness(EventEmitter):
         metrics_mod.instrument_slo(self, self.registry)
 
         self.server: Optional[ZKServer] = None
+        self.ensemble: Optional[ZKEnsemble] = None
         self.members: List[_Member] = []
         self.live_client: Optional[ZKClient] = None
         self.cache_client: Optional[ZKClient] = None
@@ -427,22 +462,62 @@ class SLOHarness(EventEmitter):
             },
         }
 
+    def _zk_addresses(self) -> List[Tuple[str, int]]:
+        """Every live-or-restartable backend address (the stable
+        ensemble servers list, or the standalone server's)."""
+        if self.ensemble is not None:
+            return list(self.ensemble.addresses)
+        return [self.server.address]
+
+    def _any_server(self) -> ZKServer:
+        """A live server to drive test controls (force-expiry) through —
+        shared session table, so any ensemble member works."""
+        if self.ensemble is not None:
+            live = self.ensemble.live
+            if not live:
+                raise RuntimeError("no live ensemble member")
+            return live[0]
+        return self.server
+
     def _make_client(self, member: _Member) -> ZKClient:
         return ZKClient(
-            [member.proxy.address],
+            [p.address for p in member.proxies],
             timeout_ms=self.session_timeout_ms,
             connect_timeout_ms=300,
             connect_pass_timeout_ms=self.session_timeout_ms,
             reconnect_policy=_MEMBER_RECONNECT,
+            # Ensemble mode: attach read-only during quorum loss (reads
+            # keep serving; writes retry through NOT_READONLY), fail
+            # over fast when a read-write member returns, and keep the
+            # connect-order shuffle seed-deterministic per fleet member.
+            can_be_read_only=self.ensemble is not None,
+            rng=random.Random(self.rng.randrange(2**32)),
         )
 
+    def _probe_client(self) -> ZKClient:
+        client = ZKClient(
+            self._zk_addresses(),
+            timeout_ms=8000,
+            connect_timeout_ms=300,
+            connect_pass_timeout_ms=2000,
+            reconnect_policy=_MEMBER_RECONNECT,
+            can_be_read_only=self.ensemble is not None,
+            rng=random.Random(self.rng.randrange(2**32)),
+        )
+        client.rw_probe_interval_s = 0.1
+        return client
+
     async def start(self) -> "SLOHarness":
-        self.server = await ZKServer().start()
+        if self.n_ensemble > 1:
+            self.ensemble = await ZKEnsemble(
+                self.n_ensemble, election_ms=self.election_ms
+            ).start()
+        else:
+            self.server = await ZKServer().start()
+        backends = self._zk_addresses()
         for i in range(self.n_members):
             member = _Member(i, f"slo{i}", f"10.9.{i // 256}.{i % 256}")
-            member.proxy = await ChaosProxy(
-                self.server.address, seed=self.rng.randrange(2**32)
-            ).start()
+            member.proxies = await proxy_fleet(backends, rng=self.rng)
             member.client = await self._make_client(member).connect()
             member.znodes = await register(
                 member.client, self._registration(),
@@ -450,12 +525,8 @@ class SLOHarness(EventEmitter):
                 settle_delay=0,
             )
             self.members.append(member)
-        self.live_client = await ZKClient(
-            [self.server.address], timeout_ms=8000
-        ).connect()
-        self.cache_client = await ZKClient(
-            [self.server.address], timeout_ms=8000
-        ).connect()
+        self.live_client = await self._probe_client().connect()
+        self.cache_client = await self._probe_client().connect()
         self.live_client.tracer = self.tracer
         self.cache = ZKCache(self.cache_client)
         self.cache.tracer = self.tracer
@@ -478,8 +549,10 @@ class SLOHarness(EventEmitter):
         for member in self.members:
             if member.client is not None and not member.client.closed:
                 await member.client.close()
-            if member.proxy is not None:
-                await member.proxy.stop()
+            for proxy in member.proxies:
+                await proxy.stop()
+        if self.ensemble is not None:
+            await self.ensemble.stop()
         if self.server is not None:
             await self.server.stop()
 
@@ -620,7 +693,7 @@ class SLOHarness(EventEmitter):
         member.znodes = await register(
             member.client, self._registration(),
             admin_ip=member.admin_ip, hostname=member.hostname,
-            settle_delay=0,
+            settle_delay=0, retry_policy=_REGISTER_RETRY,
         )
 
     def _live_members(self) -> List[_Member]:
@@ -650,9 +723,21 @@ class SLOHarness(EventEmitter):
             "health-flap": self._scenario_health_flap,
             "expiry-storm": self._scenario_expiry_storm,
             "netem-episode": self._scenario_netem_episode,
+            "leader-kill": self._scenario_leader_kill,
+            "quorum-loss": self._scenario_quorum_loss,
+            "rolling-upgrade": self._scenario_rolling_upgrade,
+            "partition-minority": self._scenario_partition_minority,
+        }
+        ensemble_only = {
+            "leader-kill", "quorum-loss", "rolling-upgrade",
+            "partition-minority",
         }
         if fault_id not in methods:
             raise ValueError(f"unknown scenario {fault_id!r}")
+        if fault_id in ensemble_only and self.ensemble is None:
+            raise ValueError(
+                f"scenario {fault_id!r} needs ensemble= > 1 (ISSUE 10)"
+            )
         self.scenario = fault_id
         started = self.now()
         try:
@@ -698,7 +783,7 @@ class SLOHarness(EventEmitter):
         for _ in range(crashes):
             event = self.inject("crash-loop", member=member.idx)
             stale = (member.client.session_id, member.client.session_passwd)
-            await self.server.expire_session(member.client.session_id)
+            await self._any_server().expire_session(member.client.session_id)
             await asyncio.sleep(restart_delay)
             if not self.repair:
                 break  # the member stays dead; looping adds nothing
@@ -742,7 +827,7 @@ class SLOHarness(EventEmitter):
         events = []
         for member in chosen:
             events.append(self.inject("expiry-storm", member=member.idx))
-            await self.server.expire_session(member.client.session_id)
+            await self._any_server().expire_session(member.client.session_id)
         await asyncio.sleep(restart_delay)
         if self.repair:
             await asyncio.gather(
@@ -768,15 +853,119 @@ class SLOHarness(EventEmitter):
             return  # nobody left to blackhole (repair disabled earlier)
         for _ in range(episodes):
             event = self.inject("netem-episode", member=member.idx)
-            member.proxy.add(Blackhole(), direction=UP)
-            member.proxy.add(Blackhole(), direction=DOWN)
-            member.proxy.drop_connections()
+            for proxy in member.proxies:
+                proxy.add(Blackhole(), direction=UP)
+                proxy.add(Blackhole(), direction=DOWN)
+                proxy.drop_connections()
             await asyncio.sleep(hold)
-            member.proxy.clear()
+            for proxy in member.proxies:
+                proxy.clear()
             if self.repair:
                 await self._restart_member(member)
                 self.clear(event)
                 await self.wait_healthy()
+
+    # -- ensemble scenarios (ISSUE 10; need ensemble= > 1) -------------------
+
+    async def _scenario_leader_kill(
+        self, kills: int = 1, down_s: float = 0.3
+    ) -> None:
+        """SIGKILL-shaped leader death **mid-registration**: a fleet
+        member deregisters (the observable outage the probes time), the
+        ensemble leader is killed while the member's re-registration is
+        in flight, and the write rides the election + failover — retried
+        through connection drops and NOT_READONLY until the new leader
+        commits it.  MTTR covers deregistration -> election -> commit."""
+        for _ in range(kills):
+            member = self._pick_member()
+            leader_idx = self.ensemble.leader_index
+            if member is None or leader_idx is None:
+                return  # no live fleet member / no leader to kill
+            event = self.inject("leader-kill", member=leader_idx)
+            await unregister(member.client, member.znodes)
+            member.znodes = []
+            reregister = asyncio.ensure_future(
+                register(
+                    member.client, self._registration(),
+                    admin_ip=member.admin_ip, hostname=member.hostname,
+                    settle_delay=0, retry_policy=_REGISTER_RETRY,
+                )
+            )
+            await asyncio.sleep(0)  # the pipeline is now in flight
+            await self.ensemble.kill(leader_idx)
+            # The leader stays dead for down_s: the re-registration must
+            # ride the election + failover, not race an instant restart.
+            await asyncio.sleep(down_s)
+            try:
+                member.znodes = await reregister
+            except Exception:
+                reregister.cancel()
+                raise
+            if self.repair:
+                await self.ensemble.restart(leader_idx)
+                self.clear(event)
+                await self.wait_healthy()
+            else:
+                return  # the leader stays dead
+
+    async def _scenario_quorum_loss(self, hold_s: float = 0.6) -> None:
+        """Kill members down to a minority: the survivors degrade to
+        read-only (fleet writes refuse with NOT_READONLY; resolves keep
+        answering through the ro member), sessions freeze (no leader =
+        no expiry), and when the members return writes resume without
+        operator action — the registrations were never lost."""
+        size = self.ensemble.size
+        running = set(self.ensemble.live)
+        live = [
+            i for i, m in enumerate(self.ensemble.servers)
+            if m is not None and m in running
+        ]
+        majority = size // 2 + 1
+        victims = live[: max(0, len(live) - (majority - 1))]
+        if not victims:
+            return
+        event = self.inject("quorum-loss")
+        for i in victims:
+            await self.ensemble.kill(i)
+        await asyncio.sleep(hold_s)
+        if not self.repair:
+            return  # quorum never returns
+        for i in victims:
+            await self.ensemble.restart(i)
+        self.clear(event)
+        await self.wait_healthy()
+
+    async def _scenario_rolling_upgrade(self, pause_s: float = 0.25) -> None:
+        """Restart every ensemble member one at a time (quorum held
+        throughout): the fleet's sessions fail over member to member and
+        the polling resolver should see no gap at all."""
+        event = self.inject("rolling-upgrade")
+        for i in range(self.ensemble.size):
+            await self.ensemble.kill(i)
+            await asyncio.sleep(pause_s)
+            if not self.repair:
+                return  # the "upgrade" wedges after the first member
+            await self.ensemble.restart(i)
+            await asyncio.sleep(pause_s)
+        self.clear(event)
+        await self.wait_healthy()
+
+    async def _scenario_partition_minority(self, hold_s: float = 0.6) -> None:
+        """Partition one member away from the majority: it degrades to
+        read-only with a frozen view while the majority keeps serving
+        writes; healing the partition catches it back up."""
+        size = self.ensemble.size
+        minority = size - 1
+        event = self.inject("partition-minority", member=minority)
+        self.ensemble.partition(
+            [list(range(size - 1)), [minority]]
+        )
+        await asyncio.sleep(hold_s)
+        if not self.repair:
+            return  # the partition never heals
+        self.ensemble.heal_partition()
+        self.clear(event)
+        await self.wait_healthy()
 
     # -- the report ---------------------------------------------------------
 
@@ -872,6 +1061,17 @@ class SLOHarness(EventEmitter):
             "seed": self.seed,
             "repair": self.repair,
             "members": self.n_members,
+            "ensemble": {
+                "members": self.n_ensemble,
+                "election_ms": (
+                    self.election_ms if self.n_ensemble > 1 else None
+                ),
+                "elections": (
+                    self.ensemble.state.elections
+                    if self.ensemble is not None
+                    else 0
+                ),
+            },
             "probe_interval_ms": round(self.probe_interval * 1000.0, 1),
             "duration_s": round(end - self._started_at, 3),
             "probes": {
@@ -905,12 +1105,22 @@ TRACES: Dict[str, Dict[str, Any]] = {
         "probe_interval": 0.02,
         "session_timeout_ms": 800,
         "pause_s": 0.5,
+        # The quick trace runs against a real 3-member ensemble (ISSUE
+        # 10): every pre-existing fault class now recovers through
+        # leader/follower members, and the headline leader-failover
+        # scenario's envelope lands in SLO_HISTORY.json.
+        "ensemble": 3,
+        "election_ms": 120.0,
         "scenarios": (
             ("deploy-wave", {"wave": 2, "down_s": 0.1}),
             ("crash-loop", {"crashes": 2, "restart_delay": 0.12}),
             ("health-flap", {"flaps": 2, "down_s": 0.1}),
             ("expiry-storm", {"victims": 3, "restart_delay": 0.12}),
             ("netem-episode", {"episodes": 1}),
+            ("leader-kill", {"kills": 1, "down_s": 0.3}),
+            ("rolling-upgrade", {"pause_s": 0.15}),
+            ("partition-minority", {"hold_s": 0.4}),
+            ("quorum-loss", {"hold_s": 0.4}),
         ),
     },
     "full": {
@@ -918,12 +1128,18 @@ TRACES: Dict[str, Dict[str, Any]] = {
         "probe_interval": 0.05,
         "session_timeout_ms": 1500,
         "pause_s": 1.5,
+        "ensemble": 3,
+        "election_ms": 150.0,
         "scenarios": (
             ("deploy-wave", {"wave": 6, "down_s": 0.15}),
             ("crash-loop", {"crashes": 4, "restart_delay": 0.2}),
             ("health-flap", {"flaps": 4, "down_s": 0.15}),
             ("expiry-storm", {"victims": 5, "restart_delay": 0.2}),
             ("netem-episode", {"episodes": 2}),
+            ("leader-kill", {"kills": 2, "down_s": 0.3}),
+            ("rolling-upgrade", {"pause_s": 0.3}),
+            ("partition-minority", {"hold_s": 0.8}),
+            ("quorum-loss", {"hold_s": 0.8}),
             ("deploy-wave", {"wave": 6, "down_s": 0.15}),
             ("expiry-storm", {"victims": 5, "restart_delay": 0.2}),
         ),
@@ -949,6 +1165,8 @@ async def run_trace(
         probe_interval=params["probe_interval"],
         session_timeout_ms=params["session_timeout_ms"],
         repair=repair,
+        ensemble=params.get("ensemble", 1),
+        election_ms=params.get("election_ms", 150.0),
     )
     await harness.start()
     try:

@@ -47,7 +47,7 @@ import argparse
 import asyncio
 import json
 import sys
-from typing import List, Tuple
+from typing import List, Optional, Tuple
 
 from registrar_tpu import binderview
 from registrar_tpu.retry import RetryPolicy
@@ -538,6 +538,10 @@ async def _config_session(args, what: str):
             if cfg.zookeeper.request_timeout_ms is not None
             else max(int(args.timeout * 1000), 1)
         ),
+        # Honor the config's read-only opt-in (ISSUE 10): an audit
+        # (`verify`) must still answer during quorum loss — reads work
+        # on a read-only member; a drain's deletes fail truthfully.
+        can_be_read_only=cfg.zookeeper.can_be_read_only,
     )
     try:
         await asyncio.wait_for(zk.connect(), timeout=args.timeout)
@@ -736,14 +740,34 @@ def _metrics_endpoint(args, what: str):
     return cfg.metrics.host, cfg.metrics.port
 
 
+async def _member_role(server: str, timeout: float) -> Optional[str]:
+    """The connected ensemble member's replication role, read off its
+    ``srvr`` admin word (ISSUE 10): leader / follower / read-only /
+    standalone.  None when the probe fails — role reporting must never
+    break ``status`` against a member that dropped since the snapshot.
+    """
+    from registrar_tpu.zk.client import four_letter_word
+
+    host, _, port_s = server.rpartition(":")
+    try:
+        raw = await four_letter_word(host, int(port_s), b"srvr", timeout)
+    except (OSError, ValueError, asyncio.TimeoutError):
+        return None
+    for line in raw.decode("latin-1", "replace").splitlines():
+        if line.startswith("Mode: "):
+            return line[len("Mode: "):].strip()
+    return None
+
+
 async def _cmd_status(args) -> int:
     """One-shot daemon introspection: ``GET /status`` off the metrics
     listener, pretty-printed (ISSUE 8 — the runbook's first stop).
 
     Exit status follows the ``verify`` contract: 0 = healthy (session
     connected, registered, not health-down), 1 = degraded (any of those
-    false, or reconciler drift standing), 2 = unreachable (no metrics
-    block, daemon not answering, or the config unreadable).
+    false, read-only attach, or reconciler drift standing), 2 =
+    unreachable (no metrics block, daemon not answering, or the config
+    unreadable).
     """
     endpoint = _metrics_endpoint(args, "status")
     if endpoint is None:
@@ -778,9 +802,23 @@ async def _cmd_status(args) -> int:
     registration = snapshot.get("registration") or {}
     health = snapshot.get("health") or {}
     reconcile_info = snapshot.get("reconcile") or {}
+    # The connected ensemble member's real role, probed off its srvr
+    # admin word (ISSUE 10): election outcomes at a glance.
+    if session.get("server"):
+        role = await _member_role(session["server"], args.timeout)
+        ro = " (read-only session)" if session.get("readOnly") else ""
+        print(
+            f"zkcli: status: zk member {session['server']} "
+            f"role={role or 'unknown'}{ro}",
+            file=sys.stderr,
+        )
     problems = []
     if not session.get("connected"):
         problems.append(f"session {session.get('state', 'unknown')}")
+    elif session.get("readOnly"):
+        # Attached, but to a read-only minority member: resolves answer,
+        # writes refuse — the OPERATIONS.md read-only-mode alert.
+        problems.append("read-only member (writes refused)")
     if not registration.get("registered"):
         problems.append("not registered")
     if health.get("down"):
@@ -964,6 +1002,9 @@ async def _cmd_serve_view(args) -> int:
         reconnect_policy=RetryPolicy(
             max_attempts=float("inf"), initial_delay=0.5, max_delay=15
         ),
+        # A pure reader: keep serving through a read-only minority
+        # member during quorum loss (ISSUE 10).
+        can_be_read_only=True,
     )
     try:
         await asyncio.wait_for(zk.connect(), timeout=10)
@@ -1539,6 +1580,11 @@ async def _amain(argv=None) -> int:
                 max_attempts=float("inf"), initial_delay=0.5, max_delay=15
             ),
             chroot=args.chroot,
+            # Read-mostly operator tooling must keep answering during
+            # quorum loss (ISSUE 10): attach to a read-only member when
+            # nothing better serves; a write then fails truthfully with
+            # NOT_READONLY instead of the whole session being refused.
+            can_be_read_only=True,
         )
     except ValueError as e:
         print(f"zkcli: {e}", file=sys.stderr)

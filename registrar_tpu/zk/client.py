@@ -41,6 +41,14 @@ Connection/session model:
     reconnect backoff by default (retry.RECONNECT_RETRY).  All proven
     against deterministic wire faults in
     :mod:`registrar_tpu.testing.netem` (tests/test_netem.py).
+  * Ensemble awareness (ISSUE 10): ``can_be_read_only`` opts into
+    attaching to a read-only member (minority partition / quorum loss)
+    so reads and heartbeats keep serving while writes fail with the
+    retryable ``NOT_READONLY``; a background ``isro`` probe fails the
+    session over the moment a read-write member reappears.  Unexpected
+    disconnects open a ``zk.failover`` span (old member -> new member,
+    including any leader-election wait), and the connect-order shuffle
+    is seedable (``rng=``) for deterministic failover tests.
   * ``ephemeral_plus`` creates (zkplus's flag, used at
     reference lib/register.js:157) are ephemeral creates that transparently
     mkdirp a missing parent.  Intentional divergence, documented: this
@@ -113,6 +121,26 @@ _OP_NAMES = {
 }
 
 
+async def four_letter_word(
+    host: str, port: int, word: bytes, timeout: float = 0.5
+) -> bytes:
+    """One connection-less admin "four letter word" probe (``isro``,
+    ``srvr``, ``mntr``, ...): connect, write the 4 ASCII bytes, read the
+    text answer, close.  The ONE copy of the probe dance — the client's
+    rw-hunt and zkcli's role reporting both ride it.  Raises
+    OSError/asyncio.TimeoutError on an unreachable or silent member.
+    """
+    reader, writer = await asyncio.wait_for(
+        asyncio.open_connection(host, port), timeout
+    )
+    try:
+        writer.write(word)
+        await writer.drain()
+        return await asyncio.wait_for(reader.read(1 << 16), timeout)
+    finally:
+        writer.close()
+
+
 class ZKClient(EventEmitter):
     """One logical ZooKeeper session over a sequence of TCP connections.
 
@@ -133,6 +161,8 @@ class ZKClient(EventEmitter):
         connect_pass_timeout_ms: Optional[int] = None,
         survive_session_expiry: bool = False,
         max_session_rebirths: Optional[int] = None,
+        can_be_read_only: bool = False,
+        rng: Optional[random.Random] = None,
     ):
         """``request_timeout_ms``: per-operation deadline.  When set, every
         awaited reply is bounded; on expiry the connection is torn down
@@ -158,7 +188,23 @@ class ZKClient(EventEmitter):
         rebirths per :data:`REBIRTH_WINDOW_S` (default
         :data:`DEFAULT_MAX_SESSION_REBIRTHS`); past it the breaker trips
         (``rebirth_breaker_tripped`` event) and expiry is terminal
-        again."""
+        again.
+
+        ``can_be_read_only`` (ISSUE 10; the Apache client's
+        ``canBeReadOnly``, config ``zookeeper.canBeReadOnly``): opt into
+        attaching to a read-only ensemble member (one partitioned to a
+        minority, or riding out quorum loss) when no read-write member
+        answers.  Reads and the heartbeat's EXISTS sweep keep working
+        there; writes fail with the retryable ``NOT_READONLY`` (surfaced
+        as the ``write_refused`` event) while a background probe polls
+        the other members' ``isro`` 4lw and fails the session over the
+        moment a read-write member appears (``rw_probe_interval_s``).
+        Default False: the reference-exact wire bytes, and read-only
+        members refuse us at the handshake.
+
+        ``rng`` seeds the connect-order shuffle (and nothing else), so
+        ensemble failover tests and chaos storms are deterministic per
+        CHAOS_SEED; default is the module RNG (reference behavior)."""
         super().__init__()
         servers = list(servers)
         if not servers:
@@ -192,6 +238,21 @@ class ZKClient(EventEmitter):
         # fleet dropped by an ensemble restart must not retry in lockstep.
         self.reconnect_policy = reconnect_policy or RECONNECT_RETRY
         self.survive_session_expiry = survive_session_expiry
+        self.can_be_read_only = can_be_read_only
+        #: seeds the connect-order shuffle only (None = module RNG)
+        self._rng = rng if rng is not None else random
+        #: True while the session is attached to a read-only member
+        #: (ConnectResponse read_only flag); reads serve, writes refuse
+        self.read_only = False
+        #: cadence of the isro sweep hunting a read-write member while
+        #: attached read-only (the Apache client's pingRwTimeout start)
+        self.rw_probe_interval_s = 1.0
+        #: rw member found by the probe — tried first on the next connect
+        self._prefer_rw: Optional[Tuple[str, int]] = None
+        self._rw_probe_task: Optional[asyncio.Task] = None
+        #: open ``zk.failover`` span while the session is between
+        #: members (unexpected teardown -> next successful connect)
+        self._failover_span = None
         if max_session_rebirths is not None and max_session_rebirths < 1:
             raise ValueError("max_session_rebirths must be >= 1")
         self.max_session_rebirths = (
@@ -315,6 +376,7 @@ class ZKClient(EventEmitter):
         self._closed = True
         if self._reconnect_task:
             self._reconnect_task.cancel()
+        self._abort_failover_span()
         await self._teardown(expected=True)
 
     async def connect(self) -> "ZKClient":
@@ -328,25 +390,46 @@ class ZKClient(EventEmitter):
         session it is trying to save has already expired.  Use
         :func:`create_zk_client` for the reference's infinite-backoff
         behavior.
+
+        With ``can_be_read_only``, read-write members are preferred: a
+        member that answers the handshake read-only is noted and the
+        pass keeps looking; only when no read-write member answered is
+        the read-only fallback reattached (degraded: reads serve, writes
+        refuse until the rw-probe finds a majority member).
         """
         if self._closed:
             raise ZKError(Err.SESSION_EXPIRED, None)
         last_err: Optional[Exception] = None
         order = list(self.servers)
-        random.shuffle(order)
+        self._rng.shuffle(order)
+        prefer, self._prefer_rw = self._prefer_rw, None
+        if prefer is not None and prefer in order:
+            # The rw-probe found a read-write member: leave read-only
+            # mode for it deterministically, not by shuffle luck.
+            order.remove(prefer)
+            order.insert(0, prefer)
         pass_timeout_ms = (
             self.connect_pass_timeout_ms
             if self.connect_pass_timeout_ms is not None
             else self.requested_timeout_ms
         )
         deadline = time.monotonic() + pass_timeout_ms / 1000.0
+        ro_fallback: Optional[Tuple[str, int]] = None
         for host, port in order:
             remaining = deadline - time.monotonic()
             if remaining <= 0:
                 break
             try:
-                await self._connect_one(host, port, max_wait=remaining)
+                await self._connect_one(
+                    host, port, max_wait=remaining, allow_read_only=False
+                )
                 return self
+            except _ReadOnlyMember:
+                # Keep hunting for a read-write member; come back to
+                # this one only if the whole pass finds none.
+                if ro_fallback is None:
+                    ro_fallback = (host, port)
+                log.debug("%s:%d is read-only; continuing the pass", host, port)
             except SessionExpiredError:
                 raise
             except asyncio.CancelledError:
@@ -354,6 +437,20 @@ class ZKClient(EventEmitter):
             except Exception as err:  # noqa: BLE001 - try next server
                 last_err = err
                 log.debug("connect to %s:%d failed: %r", host, port, err)
+        if ro_fallback is not None:
+            remaining = deadline - time.monotonic()
+            try:
+                await self._connect_one(
+                    ro_fallback[0], ro_fallback[1],
+                    max_wait=max(remaining, 0.05), allow_read_only=True,
+                )
+                return self
+            except SessionExpiredError:
+                raise
+            except asyncio.CancelledError:
+                raise
+            except Exception as err:  # noqa: BLE001 - fall through to raise
+                last_err = err
         raise (
             last_err
             if last_err
@@ -361,7 +458,11 @@ class ZKClient(EventEmitter):
         )
 
     async def _connect_one(
-        self, host: str, port: int, max_wait: Optional[float] = None
+        self,
+        host: str,
+        port: int,
+        max_wait: Optional[float] = None,
+        allow_read_only: bool = True,
     ) -> None:
         per_step = self.connect_timeout_ms / 1000.0
         # The pass budget is CUMULATIVE across the dial/handshake steps: a
@@ -386,6 +487,10 @@ class ZKClient(EventEmitter):
                 timeout_ms=self.requested_timeout_ms,
                 session_id=self.session_id,
                 passwd=self.session_passwd,
+                # The 3.4 wire flag: without it a read-only member
+                # refuses the handshake outright (and with the default
+                # can_be_read_only=False the bytes stay reference-exact).
+                read_only=self.can_be_read_only,
             )
             w = Writer()
             req.write(w)
@@ -406,6 +511,22 @@ class ZKClient(EventEmitter):
             writer.close()
             self._emit_expired()
             raise SessionExpiredError()
+        if resp.read_only and not allow_read_only:
+            # A read-only member while the pass is still hunting for a
+            # read-write one: drop the TRANSPORT only (no CLOSE_SESSION
+            # — the session stays alive server-side, exactly like a
+            # reconnect) and let connect() note the fallback.  ADOPT the
+            # session the handshake just established/attached first: a
+            # fresh client that hunted past N read-only members would
+            # otherwise mint a new session per refused handshake —
+            # orphans that, under quorum loss (leader-only expiry),
+            # could never be reaped.  The fallback (or the next pass)
+            # reattaches this same session instead.
+            self.session_id = resp.session_id
+            self.session_passwd = resp.passwd
+            self.negotiated_timeout_ms = resp.timeout_ms
+            writer.close()
+            raise _ReadOnlyMember()
 
         reattached = self.session_id == resp.session_id and self.session_id != 0
         # NOT consumed yet: the handshake tail below (auth replay, watch
@@ -420,6 +541,7 @@ class ZKClient(EventEmitter):
         self.session_passwd = resp.passwd
         self.negotiated_timeout_ms = resp.timeout_ms
         self.connected_server = (host, port)
+        self.read_only = bool(resp.read_only)
         self._reader = reader
         self._writer = writer
         self._connected = True
@@ -435,11 +557,30 @@ class ZKClient(EventEmitter):
             # the session boundary.
             await self._rearm_watches()
         log.debug(
-            "connected to %s:%d session=0x%x timeout=%dms",
+            "connected to %s:%d session=0x%x timeout=%dms%s",
             host, port, self.session_id, self.negotiated_timeout_ms,
+            " (read-only)" if self.read_only else "",
         )
-        self.emit("state", "connected")
+        if self._failover_span is not None:
+            # Failover complete: the span's duration is the whole
+            # between-members window (including any election wait).
+            sp, self._failover_span = self._failover_span, None
+            sp.set_attr("to", f"{host}:{port}")
+            sp.set_attr("read_only", self.read_only)
+            sp.finish()
+        self.emit(
+            "state", "connected_read_only" if self.read_only else "connected"
+        )
         self.emit("connect")
+        if self.read_only:
+            # Degraded attach: serve reads here while a background isro
+            # sweep hunts for a read-write member to fail writes over to
+            # (the Apache client's "Majority server found" probe).
+            # Started only now — after the handshake tail (auth replay,
+            # watch re-arm) — and the loop sleeps before its first poll,
+            # so the probe's teardown can never race the connect it
+            # rides on.
+            self._rw_probe_task = asyncio.create_task(self._rw_probe_loop())
         if self._resume_pending:
             # Consumed only on full success, like the rebirth marker
             # above: a drop in the handshake tail leaves the next
@@ -530,6 +671,7 @@ class ZKClient(EventEmitter):
         self._closed = True
         if self._reconnect_task:
             self._reconnect_task.cancel()
+        self._abort_failover_span()
         if self._connected:
             try:
                 await asyncio.wait_for(
@@ -543,9 +685,11 @@ class ZKClient(EventEmitter):
     async def _teardown(self, expected: bool) -> None:
         was_connected = self._connected
         self._connected = False
-        for task in (self._read_task, self._ping_task):
+        self.read_only = False
+        for task in (self._read_task, self._ping_task, self._rw_probe_task):
             if task is not None and task is not asyncio.current_task():
                 task.cancel()
+        self._rw_probe_task = None
         if self._writer is not None:
             try:
                 transport = getattr(self._writer, "transport", None)
@@ -580,8 +724,68 @@ class ZKClient(EventEmitter):
             self.emit("state", "disconnected")
             self.emit("close")
         if not expected and not self._closed and self.reconnect:
+            tr = trace.tracer_for(self)
+            if tr.enabled and was_connected and self._failover_span is None:
+                # The session is now between members: one zk.failover
+                # span covers the whole gap — teardown, reconnect
+                # attempts, any leader-election wait — and closes on the
+                # next successful handshake (old member -> new member).
+                old = self.connected_server
+                self._failover_span = tr.start_span(
+                    "zk.failover",
+                    **{"from": f"{old[0]}:{old[1]}" if old else "?"},
+                )
             if self._reconnect_task is None or self._reconnect_task.done():
                 self._reconnect_task = asyncio.create_task(self._reconnect_loop())
+
+    def _abort_failover_span(self) -> None:
+        """Close an open ``zk.failover`` span on a terminal path (client
+        closed / session expired for good): the failover never landed."""
+        if self._failover_span is not None:
+            sp, self._failover_span = self._failover_span, None
+            sp.finish("error")
+
+    async def _rw_probe_loop(self) -> None:
+        """While attached to a read-only member, poll the other members'
+        ``isro`` admin word and fail over the moment one answers ``rw``
+        (quorum returned, or the partition healed).  The teardown path
+        is the ordinary unexpected-disconnect machinery, so the session
+        reattaches through the preferred read-write member with watches
+        re-armed — writes resume without operator action.
+        """
+        try:
+            while self._connected and self.read_only and not self._closed:
+                await asyncio.sleep(self.rw_probe_interval_s)
+                if not (self._connected and self.read_only):
+                    return
+                found = await self._find_rw_server()
+                if found is not None:
+                    log.warning(
+                        "read-write member %s:%d available; failing over "
+                        "from read-only %s", found[0], found[1],
+                        self.connected_server,
+                    )
+                    self._prefer_rw = found
+                    await self._teardown(expected=False)
+                    return
+        except asyncio.CancelledError:
+            raise
+
+    async def _find_rw_server(self) -> Optional[Tuple[str, int]]:
+        """First server in the list (excluding the one we're on) whose
+        ``isro`` probe answers ``rw``; None when none does."""
+        for host, port in self.servers:
+            if (host, port) == self.connected_server:
+                continue
+            try:
+                answer = await four_letter_word(host, port, b"isro")
+                if answer.startswith(b"rw"):
+                    return (host, port)
+            except asyncio.CancelledError:
+                raise
+            except (OSError, asyncio.TimeoutError):
+                continue
+        return None
 
     async def _reconnect_loop(self) -> None:
         try:
@@ -608,6 +812,10 @@ class ZKClient(EventEmitter):
             raise
         except Exception:  # noqa: BLE001
             log.exception("reconnect loop gave up")
+            # The failover this span was timing never landed (a finite
+            # reconnect policy exhausted); leaving it open would hold a
+            # forever-pending span in the recorder.
+            self._abort_failover_span()
 
     def _emit_expired(self) -> None:
         """The server disowned our session: rebirth or terminal expiry.
@@ -667,6 +875,7 @@ class ZKClient(EventEmitter):
             )
             self.emit("rebirth_breaker_tripped", len(self._rebirth_times))
         self._closed = True
+        self._abort_failover_span()
         trace.tracer_for(self).event(
             "zk.session_expired", session=f"0x{self.session_id:x}"
         )
@@ -763,6 +972,11 @@ class ZKClient(EventEmitter):
         if fut.done():
             return
         if reply.err != Err.OK:
+            if reply.err == Err.NOT_READONLY:
+                # A write reached a read-only (minority) member: the
+                # caller gets the retryable error; observers (metrics:
+                # registrar_write_refusals_total) get the event.
+                self.emit("write_refused", "read_only")
             fut.set_exception(ZKError(reply.err))
         else:
             fut.set_result(r)
@@ -1512,6 +1726,13 @@ class MultiError(ZKError):
         super().__init__(first)
 
 
+class _ReadOnlyMember(Exception):
+    """Internal connect-pass signal: the handshake landed on a read-only
+    member while the pass was still hunting for a read-write one.  Never
+    escapes :meth:`ZKClient.connect` (the member is kept as the pass's
+    fallback)."""
+
+
 class SessionExpiredError(ZKError):
     def __init__(self) -> None:
         super().__init__(Err.SESSION_EXPIRED)
@@ -1566,6 +1787,8 @@ async def create_zk_client(
     request_timeout_ms: Optional[int] = None,
     survive_session_expiry: bool = False,
     max_session_rebirths: Optional[int] = None,
+    can_be_read_only: bool = False,
+    rng: Optional[random.Random] = None,
 ) -> ZKClient:
     """Create and connect a client, retrying forever (reference lib/zk.js:62-127).
 
@@ -1584,6 +1807,8 @@ async def create_zk_client(
         request_timeout_ms=request_timeout_ms,
         survive_session_expiry=survive_session_expiry,
         max_session_rebirths=max_session_rebirths,
+        can_be_read_only=can_be_read_only,
+        rng=rng,
     )
     return await connect_with_backoff(
         client, on_attempt=on_attempt, retry_policy=retry_policy

@@ -87,6 +87,11 @@ class Err:
     INVALID_ACL = -114
     AUTH_FAILED = -115
     SESSION_MOVED = -118
+    #: a state-changing request reached a read-only (minority/quorum-loss)
+    #: member — ZooKeeper 3.4's NotReadOnlyException.  Transient by
+    #: classification (retry.is_transient): the write succeeds once the
+    #: client fails over to a read-write member or quorum returns.
+    NOT_READONLY = -119
 
 #: error code -> symbolic name, mirroring the names upper layers match on
 #: (the reference matches `err.name !== 'NO_NODE'`, lib/register.js:88).

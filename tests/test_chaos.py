@@ -80,7 +80,10 @@ def _reg():
 class _Worker:
     """One registrar instance churning through the chaos."""
 
-    def __init__(self, i: int, ens: ZKEnsemble, seed: int, addresses=None):
+    def __init__(
+        self, i: int, ens: ZKEnsemble, seed: int, addresses=None,
+        can_be_read_only: bool = False,
+    ):
         self.i = i
         self.ens = ens
         self.rng = random.Random(seed)
@@ -89,6 +92,7 @@ class _Worker:
         #: where this worker dials: the ensemble directly, or (netem mode)
         #: the per-member ChaosProxy front doors
         self.addresses = addresses or ens.addresses
+        self.can_be_read_only = can_be_read_only
         self.client: ZKClient = None
         self.nodes = None
         self.ops = 0
@@ -102,7 +106,13 @@ class _Worker:
             request_timeout_ms=1500,
             connect_timeout_ms=500,
             reconnect_policy=FAST_RECONNECT,
+            # connect order seeded off the worker's own seeded RNG, so a
+            # CHAOS_SEED replay walks the members identically (ISSUE 10)
+            rng=random.Random(self.rng.randrange(2**32)),
+            can_be_read_only=self.can_be_read_only,
         )
+        if self.can_be_read_only:
+            self.client.rw_probe_interval_s = 0.1
         await self.client.connect()
 
     async def _register(self) -> None:
@@ -919,3 +929,122 @@ async def test_chaos_repeats_with_fixed_seed():
     assert a == b
     assert len(a) == 12
     assert any(ev[0].startswith("netem-") for ev in a), a
+
+
+async def _quorum_chaos_task(
+    ens: ZKEnsemble, rng: random.Random, stop: asyncio.Event, events: list
+) -> None:
+    """The ISSUE 10 storm palette: leader kills, member restarts,
+    rolling restarts, and partition-to-minority/heal — seeded, always
+    restorable (the storm-over pass heals and restarts everything)."""
+    while not stop.is_set():
+        await asyncio.sleep(rng.uniform(0.05, 0.15))
+        live = [
+            i
+            for i, m in enumerate(ens.servers)
+            if m is not None and m._server is not None
+        ]
+        dead = [i for i in range(ENSEMBLE) if i not in live]
+        roll = rng.random()
+        if roll < 0.30 and len(live) > 1:
+            # Leader-kill biased: the fault class this storm exists for.
+            leader = ens.leader_index
+            target = (
+                leader
+                if leader in live and rng.random() < 0.7
+                else rng.choice(live)
+            )
+            await ens.kill(target)
+            events.append(("kill", target))
+        elif roll < 0.60 and dead:
+            i = rng.choice(dead)
+            await ens.restart(i)
+            events.append(("restart", i))
+        elif roll < 0.75 and not dead and ens.state.groups is None:
+            iso = rng.randrange(ENSEMBLE)
+            ens.partition(
+                [[j for j in range(ENSEMBLE) if j != iso], [iso]]
+            )
+            events.append(("partition", iso))
+        elif ens.state.groups is not None:
+            ens.heal_partition()
+            events.append(("heal", -1))
+        elif live:
+            # rolling-upgrade step: one member out and straight back
+            i = rng.choice(live)
+            await ens.kill(i)
+            await asyncio.sleep(rng.uniform(0.05, 0.2))
+            await ens.restart(i)
+            events.append(("roll", i))
+    # storm over: full strength, full connectivity
+    ens.heal_partition()
+    for i in range(ENSEMBLE):
+        await ens.restart(i)
+
+
+async def test_chaos_ensemble_quorum_storm():
+    """The CI chaos job's ensemble leg (ISSUE 10): a seeded 3-member
+    fleet under leader-kill + rolling-restart + partition storm, with
+    read-only-capable workers churning registrations throughout.  The
+    fleet must converge with zero orphans and a whole Binder answer —
+    writes refused during quorum loss must have been retried, never
+    half-applied."""
+    seed = int(os.environ.get("CHAOS_SEED", random.randrange(2**32)))
+    churn_s = float(os.environ.get("CHAOS_SECONDS", "2.5"))
+    print(
+        f"CHAOS_SEED={seed} CHAOS_SECONDS={churn_s} (ensemble storm)",
+        file=sys.stderr,
+    )
+    rng = random.Random(seed)
+
+    async with ZKEnsemble(ENSEMBLE, tick_ms=20, election_ms=60) as ens:
+        workers = [
+            _Worker(
+                i, ens, rng.randrange(2**32), can_be_read_only=True
+            )
+            for i in range(N_WORKERS)
+        ]
+        for w in workers:
+            await w.connect()
+
+        stop = asyncio.Event()
+        events: list = []
+        tasks = [asyncio.create_task(w.churn(stop)) for w in workers]
+        chaos = asyncio.create_task(_quorum_chaos_task(ens, rng, stop, events))
+
+        await asyncio.sleep(churn_s)
+        stop.set()
+        await asyncio.gather(*tasks)
+        await chaos  # heals the partition, restarts every member
+        assert events, "storm injected no faults"
+
+        try:
+            # The final heal/restart may still be inside its election
+            # window: quorum returns within election_ms + one sweep tick.
+            deadline = asyncio.get_event_loop().time() + 5
+            while not ens.has_quorum:
+                assert asyncio.get_event_loop().time() < deadline, (
+                    "no leader elected after the storm"
+                )
+                await asyncio.sleep(0.02)
+
+            await asyncio.gather(*(w.converge() for w in workers))
+            # every worker owns its host znode with its live session
+            for w in workers:
+                st = await w.client.stat(f"{PATH}/{w.hostname}")
+                assert st.ephemeral_owner == w.client.session_id
+            # write refusals (if the storm produced quorum loss) were
+            # absorbed by the churn loop's retry — nothing half-applied:
+            # no ephemeral anywhere belongs to a dead session
+            await asyncio.sleep(0.3)  # one leader sweep for late expiries
+            orphans = _orphan_ephemerals(ens)
+            assert not orphans, f"orphan ephemerals: {orphans}"
+            # the Binder view answers with exactly the live fleet
+            res = await binderview.resolve(workers[0].client, DOMAIN, "A")
+            assert sorted(a.data for a in res.answers) == sorted(
+                w.admin_ip for w in workers
+            )
+        finally:
+            for w in workers:
+                if w.client is not None and not w.client.closed:
+                    await w.client.close()

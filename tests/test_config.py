@@ -85,6 +85,20 @@ class TestParse:
         with pytest.raises(ConfigError):
             parse_config(base)
 
+    def test_can_be_read_only_opt_in(self):
+        # Ensemble read-only attach (ISSUE 10): off by default
+        # (reference-exact handshake bytes), a boolean when configured.
+        base = {
+            "registration": {"domain": "a.b", "type": "host"},
+            "zookeeper": {"servers": [{"host": "h", "port": 1}]},
+        }
+        assert parse_config(base).zookeeper.can_be_read_only is False
+        base["zookeeper"]["canBeReadOnly"] = True
+        assert parse_config(base).zookeeper.can_be_read_only is True
+        base["zookeeper"]["canBeReadOnly"] = "yes"
+        with pytest.raises(ConfigError):
+            parse_config(base)
+
     def test_unknown_top_level_keys_surfaced(self):
         cfg = parse_config(
             {

@@ -152,10 +152,12 @@ async def test_registration_survives_member_death_without_reregistering():
 
 
 async def test_session_expires_while_home_member_is_down():
-    # If the client does NOT come back, the remaining members' expiry
-    # sweep must still reap the session and its ephemerals (in real ZK
-    # the surviving quorum does this).
-    async with ZKEnsemble(2, tick_ms=20) as ens:
+    # If the client does NOT come back, the surviving QUORUM's leader
+    # must still reap the session and its ephemerals (exactly real ZK:
+    # the session tracker lives on the leader — a 3-member ensemble
+    # losing one member keeps a leader; see TestQuorum for the
+    # quorum-lost case where sessions freeze instead).
+    async with ZKEnsemble(3, tick_ms=20) as ens:
         client = await ZKClient(
             ens.addresses, timeout_ms=200, reconnect=False
         ).connect()
@@ -845,3 +847,545 @@ async def test_standalone_server_unaffected_by_ensemble_changes():
     finally:
         await a.stop()
         await b.stop()
+
+
+class TestQuorum:
+    """ISSUE 10: the real replication protocol — elected leader, quorum
+    commit gate, read-only minority mode, elections with a window, and
+    the client armor that rides through all of it."""
+
+    async def test_roles_elected_leader_and_followers(self):
+        async with ZKEnsemble(3) as ens:
+            assert [m.mode for m in ens.live] == [
+                "leader", "follower", "follower"
+            ]
+            assert ens.leader_index == 0
+            assert ens.has_quorum
+
+    async def test_leader_kill_reelects_most_caught_up_member(self):
+        async with ZKEnsemble(3) as ens:
+            await ens.kill(0)
+            assert ens.leader_index == 1
+            assert ens.state.elections >= 2  # initial + failover
+            # a rejoining member does NOT dethrone the new leader
+            await ens.restart(0)
+            assert ens.leader_index == 1
+            assert ens.servers[0].mode == "follower"
+
+    async def test_session_reattaches_across_leader_election(self):
+        # Satellite 3: the client's session (and its ephemerals) survive
+        # a leader election with a real election window.
+        async with ZKEnsemble(3, election_ms=100, tick_ms=10) as ens:
+            from registrar_tpu.retry import RetryPolicy
+
+            fast = RetryPolicy(
+                max_attempts=float("inf"), initial_delay=0.02, max_delay=0.2
+            )
+            client = ZKClient(
+                ens.addresses, timeout_ms=60_000, reconnect_policy=fast
+            )
+            await client.connect()
+            try:
+                await client.create("/elect", b"x", CreateFlag.EPHEMERAL)
+                sid = client.session_id
+                leader = ens.leader_index
+                await ens.kill(leader)
+                # mid-election there is no leader ...
+                assert ens.leader_index is None
+                # ... and the ephemeral never leaves the replicated tree
+                deadline = asyncio.get_event_loop().time() + 10
+                while ens.leader_index is None:
+                    node = ens.get_node("/elect")
+                    assert node is not None and node.ephemeral_owner == sid
+                    assert asyncio.get_event_loop().time() < deadline
+                    await asyncio.sleep(0.01)
+                # same session on a surviving member, writes work again
+                deadline = asyncio.get_event_loop().time() + 10
+                while True:
+                    try:
+                        await client.set_data("/elect", b"y")
+                        break
+                    except ZKError:
+                        assert asyncio.get_event_loop().time() < deadline
+                        await asyncio.sleep(0.02)
+                assert client.session_id == sid
+                st = await client.stat("/elect")
+                assert st.ephemeral_owner == sid
+            finally:
+                await client.close()
+
+    async def test_minority_refuses_writes_serves_reads_read_only(self):
+        async with ZKEnsemble(3) as ens:
+            ro_client = ZKClient(
+                ens.addresses, timeout_ms=60_000, can_be_read_only=True,
+                reconnect=False,
+            )
+            await ro_client.connect()
+            try:
+                await ro_client.create("/ro", b"v1")
+                await ens.kill(1)
+                await ens.kill(2)
+                survivor = ens.servers[0]
+                assert survivor.mode == "read-only"
+                # the ro-capable client reattaches to the minority member
+                direct = ZKClient(
+                    [(survivor.host, survivor.port)],
+                    timeout_ms=60_000, can_be_read_only=True,
+                )
+                await direct.connect()
+                try:
+                    assert direct.read_only
+                    # reads answer (zxid-consistent frozen view)
+                    data, _ = await direct.get("/ro")
+                    assert data == b"v1"
+                    # writes refuse with the retryable NOT_READONLY
+                    refused = []
+                    direct.on("write_refused", refused.append)
+                    with pytest.raises(ZKError) as err:
+                        await direct.set_data("/ro", b"v2")
+                    from registrar_tpu.retry import is_transient
+                    from registrar_tpu.zk.protocol import Err
+
+                    assert err.value.code == Err.NOT_READONLY
+                    assert is_transient(err.value)
+                    assert refused == ["read_only"]
+                    assert survivor.writes_refused >= 1
+                finally:
+                    await direct.close()
+            finally:
+                await ro_client.close()
+
+    async def test_read_only_member_refuses_non_ro_handshake(self):
+        async with ZKEnsemble(3) as ens:
+            await ens.kill(1)
+            await ens.kill(2)
+            survivor = ens.servers[0]
+            plain = ZKClient(
+                [(survivor.host, survivor.port)],
+                timeout_ms=5000, connect_pass_timeout_ms=1500,
+                reconnect=False,
+            )
+            with pytest.raises(Exception):
+                await plain.connect()
+            assert survivor.refused_ro >= 1
+            await plain.close()
+
+    async def test_sessions_frozen_without_quorum_reaped_after(self):
+        # No leader -> no session expiry (the session tracker lives on
+        # the leader); quorum's return reaps the overdue session.
+        async with ZKEnsemble(3, tick_ms=10) as ens:
+            client = await ZKClient(
+                ens.addresses, timeout_ms=200, reconnect=False
+            ).connect()
+            await client.create("/frozen", b"", CreateFlag.EPHEMERAL)
+            sid = client.session_id
+            await ens.kill(1)
+            await ens.kill(2)
+            await client.close()  # disconnected; countdown starts
+            await asyncio.sleep(0.8)  # way past the negotiated timeout
+            assert sid in ens.state.sessions  # frozen, not expired
+            assert ens.get_node("/frozen") is not None
+            await ens.restart(1)  # quorum returns -> leader sweeps
+            deadline = asyncio.get_event_loop().time() + 5
+            while sid in ens.state.sessions:
+                assert asyncio.get_event_loop().time() < deadline
+                await asyncio.sleep(0.02)
+            assert ens.get_node("/frozen") is None
+
+    async def test_registration_during_quorum_loss_retries_clean(self):
+        # The acceptance case: a write refused during quorum loss is
+        # retried via the existing transient-retry path and lands once
+        # quorum returns — zero duplicate znodes, same session.
+        from registrar_tpu.retry import RetryPolicy
+
+        async with ZKEnsemble(3, tick_ms=10) as ens:
+            client = ZKClient(
+                ens.addresses, timeout_ms=60_000, can_be_read_only=True,
+                reconnect_policy=RetryPolicy(
+                    max_attempts=float("inf"), initial_delay=0.02,
+                    max_delay=0.2,
+                ),
+            )
+            client.rw_probe_interval_s = 0.05
+            await client.connect()
+            try:
+                await ens.kill(1)
+                await ens.kill(2)
+                sid = client.session_id
+                retry = RetryPolicy(
+                    max_attempts=200, initial_delay=0.02, max_delay=0.2
+                )
+                task = asyncio.ensure_future(
+                    register(
+                        zk=client,
+                        registration={
+                            "domain": "q.loss.us", "type": "load_balancer"
+                        },
+                        admin_ip="10.3.0.1",
+                        hostname="qhost",
+                        settle_delay=0,
+                        retry_policy=retry,
+                    )
+                )
+                await asyncio.sleep(0.3)  # refusals accumulate meanwhile
+                assert not task.done()
+                await ens.restart(1)  # quorum returns
+                znodes = await asyncio.wait_for(task, timeout=15)
+                # same session did the work; zero duplicates
+                assert client.session_id == sid
+                host_nodes = [p for p in znodes if p.endswith("/qhost")]
+                assert len(host_nodes) == 1
+                node = ens.get_node(host_nodes[0])
+                assert node is not None and node.ephemeral_owner == sid
+                parent = ens.get_node("/us/loss/q")
+                assert sorted(parent.children) == ["qhost"]
+                refused = sum(
+                    m.writes_refused for m in ens.servers if m is not None
+                )
+                assert refused >= 1  # the refusal path was exercised
+            finally:
+                await client.close()
+
+    async def test_rw_probe_fails_over_from_read_only_member(self):
+        from registrar_tpu.retry import RetryPolicy
+
+        async with ZKEnsemble(3, tick_ms=10) as ens:
+            client = ZKClient(
+                ens.addresses, timeout_ms=60_000, can_be_read_only=True,
+                reconnect_policy=RetryPolicy(
+                    max_attempts=float("inf"), initial_delay=0.02,
+                    max_delay=0.2,
+                ),
+            )
+            client.rw_probe_interval_s = 0.05
+            await client.connect()
+            try:
+                await ens.kill(1)
+                await ens.kill(2)
+                deadline = asyncio.get_event_loop().time() + 10
+                while not (client.connected and client.read_only):
+                    assert asyncio.get_event_loop().time() < deadline
+                    await asyncio.sleep(0.02)
+                await ens.restart(1)
+                await ens.restart(2)
+                # the probe notices rw members and moves the session
+                deadline = asyncio.get_event_loop().time() + 10
+                while not (client.connected and not client.read_only):
+                    assert asyncio.get_event_loop().time() < deadline
+                    await asyncio.sleep(0.02)
+                await client.create("/back", b"rw")  # writes work again
+            finally:
+                await client.close()
+
+    async def test_partition_minority_stale_reads_heal_catches_up(self):
+        async with ZKEnsemble(3) as ens:
+            writer = await ZKClient([ens.addresses[0]]).connect()
+            reader = ZKClient(
+                [ens.addresses[2]], timeout_ms=60_000,
+                can_be_read_only=True, reconnect=False,
+            )
+            await reader.connect()
+            try:
+                await writer.create("/part", b"v1")
+                ens.partition([[0, 1], [2]])
+                assert ens.servers[2].mode == "read-only"
+                assert ens.leader_index == 0
+                # majority serves writes; the minority's view is frozen
+                await writer.set_data("/part", b"v2")
+                ro = ZKClient(
+                    [ens.addresses[2]], timeout_ms=60_000,
+                    can_be_read_only=True, reconnect=False,
+                )
+                await ro.connect()
+                try:
+                    assert ro.read_only
+                    assert (await ro.get("/part"))[0] == b"v1"  # stale
+                finally:
+                    await ro.close()
+                ens.heal_partition()
+                assert ens.servers[2].mode == "follower"
+                # healed member caught up (counted as backlog replay)
+                assert ens.servers[2].catchup_replayed >= 1
+                direct = await ZKClient(
+                    [ens.addresses[2]], reconnect=False
+                ).connect()
+                try:
+                    assert (await direct.get("/part"))[0] == b"v2"
+                finally:
+                    await direct.close()
+            finally:
+                await reader.close()
+                await writer.close()
+
+    async def test_restart_catchup_replay_vs_snapshot(self):
+        # A member back within the backlog replays the committed diff;
+        # one whose departure fell off the bounded backlog snapshots.
+        async with ZKEnsemble(3, backlog_max=4) as ens:
+            client = await ZKClient([ens.addresses[0]]).connect()
+            try:
+                await ens.kill(2)
+                await client.create("/c1", b"")
+                await client.create("/c2", b"")
+                member = await ens.restart(2)
+                assert member.catchup_replayed == 2
+                assert member.catchup_snapshots == 0
+
+                await ens.kill(2)
+                for i in range(8):  # > backlog_max: tail truncated
+                    await client.create(f"/s{i}", b"")
+                member = await ens.restart(2)
+                assert member.catchup_snapshots == 1
+            finally:
+                await client.close()
+
+    async def test_4lw_reports_role_quorum_and_applied_zxid(self):
+        async def probe(member, word):
+            reader, writer = await asyncio.open_connection(
+                member.host, member.port
+            )
+            writer.write(word.encode())
+            await writer.drain()
+            out = await asyncio.wait_for(reader.read(1 << 20), timeout=5)
+            writer.close()
+            return out.decode()
+
+        async with ZKEnsemble(3) as ens:
+            srvr = await probe(ens.servers[0], "srvr")
+            assert "Mode: leader" in srvr
+            assert "Quorum size: 2" in srvr
+            assert "Ensemble size: 3" in srvr
+            assert "Mode: follower" in await probe(ens.servers[1], "srvr")
+            mntr = dict(
+                line.split("\t", 1)
+                for line in (await probe(ens.servers[1], "mntr")).splitlines()
+                if line
+            )
+            assert mntr["zk_server_state"] == "follower"
+            assert mntr["zk_quorum_size"] == "2"
+            assert "zk_applied_zxid" in mntr
+            assert await probe(ens.servers[1], "isro") == "rw"
+            # degrade to minority: role flips everywhere it is reported
+            await ens.kill(1)
+            await ens.kill(2)
+            assert await probe(ens.servers[0], "isro") == "ro"
+            assert "Mode: read-only" in await probe(ens.servers[0], "srvr")
+            mntr = dict(
+                line.split("\t", 1)
+                for line in (await probe(ens.servers[0], "mntr")).splitlines()
+                if line
+            )
+            assert mntr["zk_server_state"] == "read-only"
+
+    async def test_leader_kill_mid_registration_e2e(self):
+        # THE acceptance e2e: SIGKILL-shaped leader death while the
+        # registration pipeline is in flight; the same session converges
+        # with zero orphan/duplicate znodes and a measurable gap.
+        from registrar_tpu.retry import RetryPolicy
+
+        async with ZKEnsemble(3, election_ms=80, tick_ms=10) as ens:
+            client = ZKClient(
+                ens.addresses, timeout_ms=60_000,
+                reconnect_policy=RetryPolicy(
+                    max_attempts=float("inf"), initial_delay=0.02,
+                    max_delay=0.2,
+                ),
+            )
+            await client.connect()
+            try:
+                sid = client.session_id
+                retry = RetryPolicy(
+                    max_attempts=200, initial_delay=0.02, max_delay=0.2
+                )
+                task = asyncio.ensure_future(
+                    register(
+                        zk=client,
+                        registration={
+                            "domain": "mid.kill.us", "type": "load_balancer"
+                        },
+                        admin_ip="10.4.0.1",
+                        hostname="midhost",
+                        settle_delay=0.05,  # keeps the pipeline window open
+                        retry_policy=retry,
+                    )
+                )
+                await asyncio.sleep(0.02)  # mid-pipeline ...
+                await ens.kill(ens.leader_index)  # ... the leader dies
+                znodes = await asyncio.wait_for(task, timeout=15)
+                assert client.session_id == sid  # same session
+                host = [p for p in znodes if p.endswith("/midhost")][0]
+                node = ens.get_node(host)
+                assert node is not None and node.ephemeral_owner == sid
+                # zero duplicates/orphans anywhere under the domain
+                parent = ens.get_node("/us/kill/mid")
+                assert sorted(parent.children) == ["midhost"]
+                for child in parent.children.values():
+                    owner = child.ephemeral_owner
+                    assert owner in (0, sid)
+                await client.heartbeat(znodes)  # liveness post-failover
+            finally:
+                await client.close()
+
+    async def test_rolling_restart_zero_no_node_from_polling_resolver(self):
+        # Full rolling restart of every member; a 10 ms polling resolver
+        # must never observe NO_NODE (missing records) — transient
+        # connection losses during its own failover are retried, never
+        # counted: the DNS answer, whenever readable, is always whole.
+        from registrar_tpu import binderview
+        from registrar_tpu.retry import RetryPolicy
+
+        fast = RetryPolicy(
+            max_attempts=float("inf"), initial_delay=0.02, max_delay=0.2
+        )
+        async with ZKEnsemble(3, election_ms=60, tick_ms=10) as ens:
+            agent = ZKClient(
+                ens.addresses, timeout_ms=60_000, reconnect_policy=fast,
+            )
+            await agent.connect()
+            resolver = ZKClient(
+                ens.addresses, timeout_ms=60_000, reconnect_policy=fast,
+                can_be_read_only=True,
+            )
+            await resolver.connect()
+            try:
+                znodes = await register(
+                    zk=agent,
+                    registration={
+                        "domain": "roll.e2e.us",
+                        "type": "load_balancer",
+                        # the service record makes the domain resolvable
+                        # (the Binder A-answer the poller watches)
+                        "service": {
+                            "type": "service",
+                            "service": {
+                                "srvce": "_http", "proto": "_tcp",
+                                "port": 80,
+                            },
+                        },
+                    },
+                    admin_ip="10.5.0.1",
+                    hostname="rollhost",
+                    settle_delay=0,
+                )
+                sid = agent.session_id
+                stop = asyncio.Event()
+                no_node = []
+                answers = [0]
+
+                async def poll():
+                    while not stop.is_set():
+                        try:
+                            res = await binderview.resolve(
+                                resolver, "roll.e2e.us", "A"
+                            )
+                            if not res.answers:
+                                no_node.append("empty")
+                            else:
+                                answers[0] += 1
+                        except ZKError as err:
+                            from registrar_tpu.zk.protocol import Err
+
+                            if err.code == Err.NO_NODE:
+                                no_node.append(err.name)
+                            # transient wire errors: the resolver retries
+                        except (ConnectionError, OSError):
+                            pass
+                        await asyncio.sleep(0.01)
+
+                poller = asyncio.create_task(poll())
+                # the rolling restart: one member at a time, quorum held
+                for i in range(3):
+                    await ens.kill(i)
+                    await asyncio.sleep(0.25)
+                    await ens.restart(i)
+                    await asyncio.sleep(0.25)
+                stop.set()
+                await poller
+                assert not no_node, f"resolver saw NO_NODE: {no_node}"
+                assert answers[0] > 10  # the poller genuinely sampled
+                # the registration survived the whole upgrade untouched
+                assert agent.session_id == sid
+                await agent.heartbeat(znodes)
+            finally:
+                await resolver.close()
+                await agent.close()
+
+    async def test_connect_order_is_seedable(self):
+        # Satellite: rng= makes the connect-order shuffle deterministic
+        # per seed (chaos storms pin CHAOS_SEED through this).
+        import random as random_mod
+
+        async with ZKEnsemble(3) as ens:
+            expected = list(ens.addresses)
+            random_mod.Random(7).shuffle(expected)
+            client = ZKClient(
+                ens.addresses, reconnect=False, rng=random_mod.Random(7)
+            )
+            await client.connect()
+            try:
+                assert client.connected_server == expected[0]
+            finally:
+                await client.close()
+
+
+    async def test_ro_hunting_connect_adopts_not_orphans_sessions(self):
+        # A fresh ro-capable client whose connect pass hunts past a
+        # read-only member must ADOPT the session that handshake
+        # established and reattach it at the fallback — not mint one
+        # session per refused member (orphans that leader-only expiry
+        # could never reap while quorum is lost).
+        async with ZKEnsemble(3) as ens:
+            await ens.kill(1)
+            await ens.kill(2)
+            before = set(ens.state.sessions)
+            client = ZKClient(
+                ens.addresses, timeout_ms=60_000, can_be_read_only=True,
+                reconnect=False,
+            )
+            await client.connect()
+            try:
+                assert client.read_only
+                new = set(ens.state.sessions) - before
+                assert new == {client.session_id}, (
+                    f"connect pass left extra sessions: {new}"
+                )
+            finally:
+                await client.close()
+
+
+    async def test_close_session_refused_without_quorum(self):
+        # closeSession is a quorum transaction too: a read-only minority
+        # member must NOT commit the ephemeral deletes — the session and
+        # its znodes stay frozen until a leader (quorum) expires them.
+        async with ZKEnsemble(3, tick_ms=10) as ens:
+            client = ZKClient(
+                ens.addresses, timeout_ms=300, can_be_read_only=True,
+                reconnect=False,
+            )
+            await client.connect()
+            await client.create("/frozen-close", b"", CreateFlag.EPHEMERAL)
+            sid = client.session_id
+            await ens.kill(1)
+            await ens.kill(2)
+            # reattach read-only, then try a clean close
+            ro = ZKClient(
+                [ens.addresses[0]], timeout_ms=300, can_be_read_only=True,
+                reconnect=False,
+            )
+            ro.seed_session(
+                sid, client.session_passwd, negotiated_timeout_ms=300
+            )
+            await ro.connect()
+            assert ro.read_only
+            await ro.close()  # best-effort: the refusal is swallowed
+            await client.close()
+            # the minority never committed the close
+            assert sid in ens.state.sessions
+            assert ens.get_node("/frozen-close") is not None
+            assert ens.servers[0].writes_refused >= 1
+            # quorum returns: the leader expires the overdue session
+            await ens.restart(1)
+            deadline = asyncio.get_event_loop().time() + 5
+            while ens.get_node("/frozen-close") is not None:
+                assert asyncio.get_event_loop().time() < deadline
+                await asyncio.sleep(0.02)
+            assert sid not in ens.state.sessions

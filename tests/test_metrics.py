@@ -488,3 +488,66 @@ class TestRestartInstrumentation:
         finally:
             await client.close()
             await server.stop()
+
+
+class TestEnsembleInstrumentation:
+    """ISSUE 10: write-refusal counter + member-role info gauge."""
+
+    async def test_write_refusals_and_member_role(self):
+        from registrar_tpu.agent import RegistrarEvents
+        from registrar_tpu.testing.server import ZKEnsemble
+
+        async with ZKEnsemble(3) as ens:
+            client = ZKClient(
+                ens.addresses, timeout_ms=60_000, can_be_read_only=True,
+                reconnect=False,
+            )
+            await client.connect()
+            try:
+                reg = instrument(RegistrarEvents(), client)
+                text = reg.render()
+                # pre-seeded series exist before any refusal
+                assert (
+                    'registrar_write_refusals_total{reason="read_only"} 0'
+                    in text
+                )
+                assert (
+                    'registrar_zk_member_role{role="read_write"} 1' in text
+                )
+                assert (
+                    'registrar_zk_member_role{role="read_only"} 0' in text
+                )
+                # degrade to a read-only minority and renegotiate
+                await ens.kill(1)
+                await ens.kill(2)
+                survivor = ens.servers[0]
+                ro = ZKClient(
+                    [(survivor.host, survivor.port)],
+                    timeout_ms=60_000, can_be_read_only=True,
+                )
+                await ro.connect()
+                try:
+                    reg2 = instrument(RegistrarEvents(), ro)
+                    with pytest.raises(Exception):
+                        await ro.create("/refused", b"")
+                    text = reg2.render()
+                    assert (
+                        'registrar_write_refusals_total{reason="read_only"} 1'
+                        in text
+                    )
+                    assert (
+                        'registrar_zk_member_role{role="read_only"} 1'
+                        in text
+                    )
+                    assert (
+                        'registrar_zk_member_role{role="read_write"} 0'
+                        in text
+                    )
+                finally:
+                    await ro.close()
+                text = reg2.render()
+                assert (
+                    'registrar_zk_member_role{role="disconnected"} 1' in text
+                )
+            finally:
+                await client.close()

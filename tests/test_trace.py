@@ -913,8 +913,13 @@ class TestZkcliStatusTrace:
             )
             assert code == 0, err
             assert "healthy" in err
+            # ISSUE 10: the connected member's real role, probed off its
+            # srvr admin word (a standalone test server reports exactly
+            # that), plus the /status readOnly flag
+            assert "role=standalone" in err
             snapshot = json.loads(out)
             assert snapshot["session"]["connected"] is True
+            assert snapshot["session"]["readOnly"] is False
 
             code, out, err = await self._run_cli(
                 ["trace", "-f", str(cfg_path), "-n", "50"], capsys
@@ -1071,3 +1076,62 @@ class TestObservabilityConfig:
             Tracer(sample_rate=1.5)
         with pytest.raises(ValueError):
             Tracer(max_spans=0)
+
+
+class TestFailoverSpan:
+    """ISSUE 10: an unexpected disconnect opens ONE zk.failover span
+    that closes on the next successful handshake — old member, new
+    member, and a duration covering the whole between-members window."""
+
+    async def test_member_death_records_failover_span(self):
+        from registrar_tpu.retry import RetryPolicy
+        from registrar_tpu.testing.server import ZKEnsemble
+
+        fast = RetryPolicy(
+            max_attempts=float("inf"), initial_delay=0.02, max_delay=0.2
+        )
+        async with ZKEnsemble(3) as ens:
+            client = ZKClient(
+                ens.addresses, timeout_ms=60_000, reconnect_policy=fast
+            )
+            client.tracer = trace.Tracer(sample_rate=1.0)
+            await client.connect()
+            try:
+                old = client.connected_server
+                reconnected = asyncio.Event()
+                client.on("connect", lambda *a: reconnected.set())
+                for i, member in enumerate(ens.servers):
+                    if (member.host, member.port) == old:
+                        await ens.kill(i)
+                        break
+                await asyncio.wait_for(reconnected.wait(), timeout=10)
+                spans = _spans(client.tracer, "zk.failover")
+                assert len(spans) == 1
+                sp = spans[0]
+                assert sp["attrs"]["from"] == f"{old[0]}:{old[1]}"
+                new = client.connected_server
+                assert sp["attrs"]["to"] == f"{new[0]}:{new[1]}"
+                assert sp["status"] == "ok"
+                assert sp["duration_ms"] >= 0
+            finally:
+                await client.close()
+
+    async def test_terminal_close_finishes_open_failover_span_error(self):
+        from registrar_tpu.retry import RetryPolicy
+        from registrar_tpu.testing.server import ZKEnsemble
+
+        fast = RetryPolicy(
+            max_attempts=float("inf"), initial_delay=0.05, max_delay=0.2
+        )
+        async with ZKEnsemble(1) as ens:
+            client = ZKClient(
+                ens.addresses, timeout_ms=60_000, reconnect_policy=fast
+            )
+            client.tracer = trace.Tracer(sample_rate=1.0)
+            await client.connect()
+            await ens.kill(0)  # nothing to fail over to
+            await asyncio.sleep(0.05)
+            await client.close()  # terminal: the failover never landed
+            spans = _spans(client.tracer, "zk.failover")
+            assert len(spans) == 1
+            assert spans[0]["status"] == "error"
