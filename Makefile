@@ -7,7 +7,7 @@ NAME = registrar
 RELEASE_TARBALL = $(NAME)-release.tar.gz
 RELSTAGEDIR = /tmp/$(NAME)-release
 
-.PHONY: all check check-core test test-jax chaos restart-e2e bench bench-cached slo slo-quick release publish clean
+.PHONY: all check check-core test test-jax chaos restart-e2e bench bench-cached profile slo slo-quick release publish clean
 
 all: check test
 
@@ -79,6 +79,14 @@ restart-e2e:
 
 bench:
 	$(PYTHON) bench.py
+
+# Profile the two perf-round hot loops (warm cached resolve; 1000-znode
+# heartbeat sweep, solo + coalesced) under cProfile and write the top-25
+# cumulative report to profile-report.txt — so the next perf round
+# starts from data, not guesses (ISSUE 11).  CI's bench smoke leg
+# uploads the report as an artifact on every PR.
+profile:
+	$(PYTHON) bench.py --profile
 
 # Availability-SLO simulator (ISSUE 9): a seeded fleet of in-process
 # registrars under named churn traces (every docs/FAULTS.md fault

@@ -62,6 +62,57 @@ REGISTRATION = {
 
 BASELINE_FLOOR_MS = 1000.0  # reference lib/register.js:232-235 settle delay
 
+#: BENCH_SMOKE=1 (the CI bench leg): run the 1k-scale variants but skip
+#: the 10k-znode sweep — its metric is emitted as null so the gate reads
+#: it as "unmeasurable in this environment", exactly like daemon_rss_mb
+#: off-Linux.  The full matrix stays driver-box-only (r06-dev precedent).
+SMOKE = os.environ.get("BENCH_SMOKE") == "1"
+
+#: Every metric name this bench can emit, mapped to its gate direction —
+#: "lower"/"higher" for metrics pinned in BENCH_HISTORY.json, None for
+#: deliberately-unpinned extras (scheduler-noise-dominated deltas whose
+#: real gate is an in-process assert).  THE machine-checked contract:
+#: checklib's bench-metric-drift rule diffs this literal map against
+#: BENCH_HISTORY.json's directions and docs/PERF.md's metric tables, and
+#: gate() fails on any emitted metric missing from it — so a renamed
+#: metric cannot silently orphan its history pin or its doc row.
+BENCH_METRICS = {
+    "register_to_visible_ms": "lower",
+    "pipeline_ms_no_settle": "lower",
+    "heartbeat_ms": "lower",
+    "resolve_a_query_ms": "lower",
+    "concurrent_registrations_per_s": "higher",
+    "heartbeat_ms_100_znodes": "lower",
+    "heartbeat_ms_1000_znodes": "lower",
+    "heartbeat_ms_10000_znodes": "lower",
+    "heartbeat_ms_1000_znodes_coalesced_100_services": "lower",
+    "live_resolve_qps": "higher",
+    "concurrent_agents_100": "higher",
+    "resolve_a_ms_50_instances": "lower",
+    "resolve_srv_ms_50_instances": "lower",
+    "watch_fanout_ms_50_watchers": "lower",
+    "daemon_rss_mb": "lower",
+    "resolve_a_cached_ms_50_instances": "lower",
+    "resolve_srv_cached_ms_50_instances": "lower",
+    "cached_resolve_qps_50_instances": "higher",
+    "cache_coherence_lag_ms": "lower",
+    "resolve_cached_hist_p50_ms": "lower",
+    "resolve_cached_hist_p95_ms": "lower",
+    "resolve_cached_hist_p99_ms": "lower",
+    "resolve_a_cached_traced_ms": None,
+    "resolve_srv_cached_traced_ms": None,
+    "trace_overhead_pct": None,
+    "znodes_per_registration": None,
+}
+
+#: histogram-quantile metric names as literals (consumed from
+#: BENCH_METRICS-checkable constants, not built by f-string)
+HIST_QUANTILE_METRICS = (
+    (0.50, "resolve_cached_hist_p50_ms"),
+    (0.95, "resolve_cached_hist_p95_ms"),
+    (0.99, "resolve_cached_hist_p99_ms"),
+)
+
 FLEET_DOMAIN = "fleet.bench.emy-10.joyent.us"
 FLEET_REG = {
     "domain": FLEET_DOMAIN,
@@ -190,10 +241,8 @@ async def _cached_metrics(
         # recorded into the bench round so the distribution, not just the
         # burst median, is regression-gated.
         hist_quantiles = {
-            f"resolve_cached_hist_p{int(q * 100)}_ms": round(
-                hist.quantile(q, {"source": "cached"}) * 1000.0, 4
-            )
-            for q in (0.50, 0.95, 0.99)
+            name: round(hist.quantile(q, {"source": "cached"}) * 1000.0, 4)
+            for q, name in HIST_QUANTILE_METRICS
         }
 
         # Sustained throughput, mixed A+SRV (the cached-QPS headline);
@@ -248,6 +297,113 @@ async def _cached_metrics(
         }
     finally:
         cache.close()
+
+
+async def _create_ephemerals(client, paths) -> None:
+    """Create many ephemerals fast: chunked multi transactions (500 ops
+    per txn) instead of one awaited round trip — or task — per node; a
+    10k-node fixture stands up in tens of txns."""
+    from registrar_tpu.zk.client import Op
+
+    chunk = 500
+    for i in range(0, len(paths), chunk):
+        await client.multi(
+            [
+                Op.create(p, b"", CreateFlag.EPHEMERAL)
+                for p in paths[i : i + chunk]
+            ]
+        )
+
+
+LIVE_QPS_DOMAIN = "liveqps.emy-10.joyent.us"
+
+
+async def _live_resolve_qps(client, server, conns: int = 4,
+                            workers: int = 100, per_worker: int = 30) -> float:
+    """Aggregate live-read resolve throughput (ISSUE 11 matrix).
+
+    ``workers`` concurrent resolver coroutines spread over ``conns``
+    observer sessions, each resolving a dedicated single-host domain's A
+    record ``per_worker`` times; median wall-clock QPS of 3 rounds.
+    Uncached by construction (plain ZKClient source), so every resolve
+    pays the full wire path — read_node + instance get_many.  Its own
+    domain because the concurrency bench nests its throwaway domains as
+    CHILDREN of the shared bench domain, which would silently turn this
+    into a 100-way fan-out measurement.
+    """
+    await register(
+        client,
+        {
+            "domain": LIVE_QPS_DOMAIN,
+            "type": "load_balancer",
+            # The service record is what makes the domain node resolve
+            # (a bare host child answers nothing at the domain name).
+            "service": {
+                "type": "service",
+                "service": {"srvce": "_http", "proto": "_tcp", "port": 80},
+            },
+        },
+        admin_ip="10.4.0.1", hostname="livehost", settle_delay=0,
+    )
+    clients = []
+    try:
+        for _ in range(conns):
+            clients.append(await ZKClient([server.address]).connect())
+
+        async def worker(cl, count):
+            for _ in range(count):
+                res = await binderview.resolve(cl, LIVE_QPS_DOMAIN, "A")
+            return res
+
+        rates = []
+        for rnd in range(-1, 3):  # round -1 warms up, unmeasured
+            t0 = time.perf_counter()
+            results = await asyncio.gather(
+                *(
+                    worker(clients[i % conns], per_worker)
+                    for i in range(workers)
+                )
+            )
+            if rnd >= 0:
+                rates.append(
+                    workers * per_worker / (time.perf_counter() - t0)
+                )
+        if any(r.empty for r in results):
+            raise RuntimeError(
+                "live resolve QPS measured empty answers — the timed "
+                "path was not the real answer-assembly path"
+            )
+        return sorted(rates)[len(rates) // 2]
+    finally:
+        for cl in clients:
+            await cl.close()
+
+
+async def _concurrent_agents(server, n_agents: int, znodes_each: int) -> float:
+    """Full heartbeat sweeps per second across ``n_agents`` concurrent
+    sessions, each owning ``znodes_each`` ephemerals (the 1k-instance
+    fleet shape when 100 × 10).  Median of 5 concurrent rounds."""
+    agents = []
+    try:
+        for i in range(n_agents):
+            cl = await ZKClient([server.address]).connect()
+            base = f"/agents/a{i}"
+            await cl.mkdirp(base)
+            paths = [f"{base}/e{j}" for j in range(znodes_each)]
+            await _create_ephemerals(cl, paths)
+            agents.append((cl, paths))
+        rates = []
+        for rnd in range(-1, 5):  # warmup round unmeasured
+            t0 = time.perf_counter()
+            await asyncio.gather(
+                *(cl.heartbeat(paths) for cl, paths in agents)
+            )
+            if rnd >= 0:
+                rates.append(n_agents / (time.perf_counter() - t0))
+        return sorted(rates)[len(rates) // 2]
+    finally:
+        for cl, _ in agents:
+            await cl.close()
 
 
 async def _daemon_rss_mb(server) -> "float | None":
@@ -406,21 +562,21 @@ async def _bench() -> dict:
                 await c.close()
         throughput = sorted(rates)[len(rates) // 2]
 
-        # ---- scale extras (round-2: prove the O(N) paths stay flat) ----
+        # ---- scale extras (round-2: prove the O(N) paths stay flat;
+        # round-8: the 1k–10k-instance matrix, ISSUE 11) ----
 
         # Heartbeat over many owned znodes: one session, N ephemerals,
-        # the agent's hot loop #1 stat fan-out.
+        # the agent's hot loop #1 stat fan-out.  The 10k sweep is the
+        # matrix's deep end — skipped under BENCH_SMOKE (CI), where its
+        # metric reports null ("unmeasurable in this environment").
         heartbeat_scale = {}
-        for n in (100, 1000):
+        scale_paths = {}
+        for n in (100, 1000) if SMOKE else (100, 1000, 10000):
             base = f"/hbscale{n}"
             await client.mkdirp(base)
             paths = [f"{base}/e{i}" for i in range(n)]
-            await asyncio.gather(
-                *(
-                    client.create(p, b"", CreateFlag.EPHEMERAL)
-                    for p in paths
-                )
-            )
+            await _create_ephemerals(client, paths)
+            scale_paths[n] = paths
             hb_iters = 5
             t0 = time.perf_counter()
             for _ in range(hb_iters):
@@ -428,6 +584,39 @@ async def _bench() -> dict:
             heartbeat_scale[n] = round(
                 (time.perf_counter() - t0) * 1000.0 / hb_iters, 3
             )
+
+        # Coalesced multi-service sweep (ISSUE 11 tentpole): the same
+        # 1000 znodes probed as 100 services × 10 znodes through ONE
+        # heartbeat_many flush — the wire shape the agent coalescer
+        # produces for a multi-service host.
+        svc_groups = [
+            scale_paths[1000][i * 10 : (i + 1) * 10] for i in range(100)
+        ]
+        co_iters = 5
+        t0 = time.perf_counter()
+        for _ in range(co_iters):
+            outcomes = await client.heartbeat_many(svc_groups)
+            if any(outcomes):
+                # Checked EVERY iteration: a failing sweep returns on a
+                # different (typically faster) path, and folding it into
+                # the timing would record a broken run as an improvement.
+                raise RuntimeError(
+                    "coalesced heartbeat sweep reported per-service "
+                    f"errors: {[e for e in outcomes if e]!r}"
+                )
+        coalesced_ms = (time.perf_counter() - t0) * 1000.0 / co_iters
+
+        # Live (uncached) resolve throughput: 100 concurrent resolver
+        # coroutines over 4 observer sessions hammering a dedicated
+        # single-host domain — the aggregate QPS ceiling of the live
+        # read path (the cached path's QPS is measured separately).
+        live_qps = await _live_resolve_qps(client, server)
+
+        # 100 concurrent agents (the 1k-instance fleet shape: 100
+        # sessions × 10 owned znodes), all heartbeating at once; value
+        # is full agent sweeps per second.
+        agents_qps = await _concurrent_agents(server, n_agents=100,
+                                              znodes_each=10)
 
         # Resolution over a 50-instance service (the biggest realistic
         # Binder answer: a large stateless fleet behind one domain).
@@ -510,6 +699,12 @@ async def _bench() -> dict:
                 "znodes_per_registration": len(nodes),
                 "heartbeat_ms_100_znodes": heartbeat_scale[100],
                 "heartbeat_ms_1000_znodes": heartbeat_scale[1000],
+                "heartbeat_ms_10000_znodes": heartbeat_scale.get(10000),
+                "heartbeat_ms_1000_znodes_coalesced_100_services": round(
+                    coalesced_ms, 3
+                ),
+                "live_resolve_qps": round(live_qps, 1),
+                "concurrent_agents_100": round(agents_qps, 1),
                 "resolve_a_ms_50_instances": round(fleet_a_ms, 3),
                 "resolve_srv_ms_50_instances": round(fleet_srv_ms, 3),
                 "watch_fanout_ms_50_watchers": round(fanout_ms, 3),
@@ -567,6 +762,70 @@ async def _bench_cached() -> dict:
         await observer.close()
         await client.close()
         await server.stop()
+
+
+# ---- profiling (make profile) ----------------------------------------------
+
+PROFILE_REPORT = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), "profile-report.txt"
+)
+
+
+async def _profile_loops() -> None:
+    """The two hot loops the perf rounds attack, run long enough to
+    profile: the warm cached resolve and the 1000-znode heartbeat sweep
+    (solo + coalesced).  Stood up exactly like the bench proper."""
+    server = await ZKServer().start()
+    client = await ZKClient([server.address]).connect()
+    observer = await ZKClient([server.address]).connect()
+    cache = None
+    try:
+        await _register_fleet(client)
+        cache = ZKCache(observer)
+        srv_name = f"_http._tcp.{FLEET_DOMAIN}"
+        for _ in range(2000):
+            await binderview.resolve(cache, FLEET_DOMAIN, "A")
+            await binderview.resolve(cache, srv_name, "SRV")
+        base = "/profile-hb"
+        await client.mkdirp(base)
+        paths = [f"{base}/e{i}" for i in range(1000)]
+        await _create_ephemerals(client, paths)
+        for _ in range(25):
+            await client.heartbeat(paths)
+        groups = [paths[i * 10 : (i + 1) * 10] for i in range(100)]
+        for _ in range(25):
+            await client.heartbeat_many(groups)
+    finally:
+        if cache is not None:
+            cache.close()
+        await observer.close()
+        await client.close()
+        await server.stop()
+
+
+def run_profile(report_path: str = None) -> int:
+    """``--profile`` (make profile): cProfile the cached-resolve and
+    heartbeat bench loops, dump the top-25 cumulative report to
+    profile-report.txt — so the next perf round starts from data, not
+    guesses (ISSUE 11 satellite; uploaded as a CI artifact)."""
+    import cProfile
+    import io
+    import pstats
+
+    path = report_path or PROFILE_REPORT
+    prof = cProfile.Profile()
+    prof.runcall(asyncio.run, _profile_loops())
+    out = io.StringIO()
+    stats = pstats.Stats(prof, stream=out)
+    stats.sort_stats("cumulative").print_stats(25)
+    with open(path, "w", encoding="utf-8") as f:
+        f.write(
+            "# bench.py --profile: cached-resolve + heartbeat hot loops "
+            "under cProfile\n# top 25 by cumulative time\n"
+        )
+        f.write(out.getvalue())
+    print(f"bench: wrote {path}", file=sys.stderr)
+    return 0
 
 
 # ---- cross-round regression gate -------------------------------------------
@@ -700,6 +959,14 @@ def gate(result: dict, baseline: dict, tolerance_pct: "float | None" = None) -> 
             raise SystemExit(2)
     flat = flat_metrics(result)
     failures = []
+    for name in sorted(flat):
+        if name not in BENCH_METRICS:
+            # The runtime half of the bench-metric-drift contract: an
+            # emitted metric absent from the declared map means the
+            # static diff (checklib) is checking a stale name set.
+            failures.append(
+                f"{name}: emitted but not declared in bench.BENCH_METRICS"
+            )
     for name, spec in baseline["metrics"].items():
         expected, direction = spec["value"], spec["direction"]
         measured = flat.get(name)
@@ -754,6 +1021,8 @@ def main() -> int:
     if "--cached-only" in sys.argv[1:]:
         print(json.dumps(asyncio.run(_bench_cached())))
         return 0
+    if "--profile" in sys.argv[1:]:
+        return run_profile()
     if "--check-baseline" in sys.argv[1:]:
         problems = check_baseline()
         for p in problems:

@@ -62,6 +62,7 @@ from __future__ import annotations
 
 import asyncio
 import logging
+import weakref
 from typing import Any, List, Mapping, Optional, Sequence
 
 from registrar_tpu import registration as register_mod
@@ -657,6 +658,131 @@ async def _reregister_guarded(
             return True
 
 
+#: cap on how long a coalesced sweep waits for sibling services to join
+#: its flush (the window is otherwise interval/10, so fast test
+#: intervals stay fast); a sweep is never delayed past this.
+COALESCE_WINDOW_CAP_S = 0.05
+
+
+class HeartbeatCoalescer:
+    """Cork concurrent heartbeat sweeps from the register_plus services
+    sharing ONE ZKClient into a single pipelined EXISTS flush (ISSUE 11).
+
+    Each service's ``_heartbeat_loop`` still owns its cadence, failure
+    backoff, and NO_NODE→confirm→repair flow; what changes is only the
+    wire shape: sweeps that arrive within one window ride a single
+    :meth:`ZKClient.heartbeat_many` call (one corked write, one drain,
+    one shared deadline) instead of one flush per service.  Per-service
+    outcomes resolve the moment the client decides them (``on_outcome``),
+    so a healthy service is never held behind a failing sibling's retry
+    schedule.  With a single attached service the coalescer is a pure
+    pass-through to :meth:`ZKClient.heartbeat` — zero added latency, and
+    tests that monkeypatch ``client.heartbeat`` still intercept the
+    probe.  Sweeps are reads (EXISTS only): no single-flight lock needed.
+    """
+
+    def __init__(self, zk) -> None:
+        self._zk = zk
+        self._attached = 0
+        #: (nodes, retry, future) staged for the open window's flush
+        self._staged: list = []
+        self._flush_task: Optional[asyncio.Task] = None
+        self._tasks: set = set()
+
+    def attach(self) -> None:
+        self._attached += 1
+
+    def detach(self) -> None:
+        self._attached -= 1
+
+    async def sweep(self, nodes, retry, interval: float) -> None:
+        """One heartbeat sweep over ``nodes``; raises what a solo
+        ``zk.heartbeat(nodes, retry=retry)`` would raise."""
+        if self._attached <= 1 and not self._staged:
+            # Solo service: no window, no future — the daemon's common
+            # shape stays byte-identical to the uncoalesced loop.
+            await self._zk.heartbeat(nodes, retry=retry)
+            return
+        fut = asyncio.get_running_loop().create_future()
+        self._staged.append((list(nodes), retry, fut))
+        if self._flush_task is None or self._flush_task.done():
+            window = min(COALESCE_WINDOW_CAP_S, interval / 10.0)
+            self._flush_task = spawn_owned(
+                self._flush_after(window), self._tasks
+            )
+        err = await fut
+        if err is not None:
+            raise err
+
+    async def _flush_after(self, window_s: float) -> None:
+        try:
+            await asyncio.sleep(window_s)
+        except asyncio.CancelledError:
+            # Cancelled mid-window: nothing will sweep this batch — fail
+            # the staged futures over to their awaiting service loops
+            # (which are themselves being cancelled in the stop() case)
+            # instead of leaving them parked forever.
+            batch, self._staged = self._staged, []
+            self._flush_task = None
+            for _, _, fut in batch:
+                if not fut.done():
+                    fut.cancel()
+            raise
+        batch, self._staged = self._staged, []
+        self._flush_task = None
+        # Group by retry-policy identity: services configured alike (the
+        # normal fleet shape) share one flush; a divergent policy gets
+        # its own heartbeat_many with its own schedule — run
+        # CONCURRENTLY, so one round riding a failing group's backoff
+        # never head-of-line blocks another policy's healthy sweep.
+        rounds: dict = {}
+        for nodes, retry, fut in batch:
+            rounds.setdefault(id(retry), (retry, []))[1].append((nodes, fut))
+
+        async def run_round(retry, members) -> None:
+            futs = [f for _, f in members]
+
+            def release(i: int, err) -> None:
+                if not futs[i].done():
+                    futs[i].set_result(err)
+
+            try:
+                await self._zk.heartbeat_many(
+                    [nodes for nodes, _ in members],
+                    retry=retry,
+                    on_outcome=release,
+                )
+            except asyncio.CancelledError:
+                for f in futs:
+                    if not f.done():
+                        f.cancel()
+                raise
+            except Exception as err:  # noqa: BLE001 - fan the failure out
+                for f in futs:
+                    if not f.done():
+                        f.set_exception(err)
+
+        if len(rounds) == 1:
+            ((retry, members),) = rounds.values()
+            await run_round(retry, members)
+        elif rounds:
+            await asyncio.gather(
+                *(run_round(r, m) for r, m in rounds.values())
+            )
+
+
+#: per-client coalescer registry (weak: a closed client's coalescer dies
+#: with it; nothing here outlives the session it serves)
+_COALESCERS: "weakref.WeakKeyDictionary" = weakref.WeakKeyDictionary()
+
+
+def _coalescer_for(zk) -> HeartbeatCoalescer:
+    co = _COALESCERS.get(zk)
+    if co is None:
+        co = _COALESCERS[zk] = HeartbeatCoalescer(zk)
+    return co
+
+
 async def _heartbeat_loop(
     ee: RegistrarEvents,
     zk: ZKClient,
@@ -673,12 +799,35 @@ async def _heartbeat_loop(
     reattach raced a cleanup) unless the health checker holds the host
     down.  None = reference behavior: failures only back off.  ``lock``
     is the agent-wide single-flight guard the repair runs under.
+
+    Probes route through the per-client :class:`HeartbeatCoalescer`:
+    when several services share this client, sweeps landing in the same
+    window fuse into one pipelined flush; solo, it is a pass-through.
     """
     if lock is None:
         lock = asyncio.Lock()
+    coalescer = _coalescer_for(zk)
+    coalescer.attach()
+    try:
+        await _heartbeat_loop_body(
+            ee, zk, interval, retry, repair, lock, coalescer
+        )
+    finally:
+        coalescer.detach()
+
+
+async def _heartbeat_loop_body(
+    ee: RegistrarEvents,
+    zk: ZKClient,
+    interval: float,
+    retry: Optional[RetryPolicy],
+    repair,
+    lock: asyncio.Lock,
+    coalescer: HeartbeatCoalescer,
+) -> None:
     while not ee.stopped:
         try:
-            await zk.heartbeat(ee.znodes, retry=retry)
+            await coalescer.sweep(ee.znodes, retry, interval)
         except asyncio.CancelledError:
             raise
         except Exception as err:  # noqa: BLE001

@@ -104,6 +104,12 @@ class ZookeeperConfig:
     #: retryable NOT_READONLY until the rw-probe fails the session over.
     #: Default False = reference-exact handshake bytes.
     can_be_read_only: bool = False
+    #: ``eventLoop`` (ISSUE 11): "uvloop" swaps the asyncio event loop
+    #: for uvloop when (and only when) the package is importable —
+    #: import-guarded, falls back to asyncio with a warning, byte-
+    #: identical wire behavior either way (parity pinned).  None/
+    #: "asyncio" = the stdlib loop, the default.
+    event_loop: Optional[str] = None
 
 
 @dataclass
@@ -261,6 +267,11 @@ def parse_config(raw: Mapping[str, Any]) -> Config:
     can_be_read_only = zk_raw.get("canBeReadOnly", False)
     if not isinstance(can_be_read_only, bool):
         raise ConfigError("config.zookeeper.canBeReadOnly must be a boolean")
+    event_loop = zk_raw.get("eventLoop")
+    if event_loop is not None and event_loop not in ("asyncio", "uvloop"):
+        raise ConfigError(
+            'config.zookeeper.eventLoop must be "asyncio" or "uvloop"'
+        )
     zookeeper = ZookeeperConfig(
         servers=servers,
         timeout_ms=_ms(zk_raw, "timeout", 30000),
@@ -268,6 +279,7 @@ def parse_config(raw: Mapping[str, Any]) -> Config:
         chroot=chroot,
         request_timeout_ms=_optional_ms(zk_raw, "requestTimeout"),
         can_be_read_only=can_be_read_only,
+        event_loop=event_loop,
     )
 
     registration = raw.get("registration")

@@ -959,8 +959,35 @@ def _cold_reload_changes(old: Config, new: Config) -> list:
     return cold
 
 
+def install_event_loop(cfg: Config) -> str:
+    """Apply ``zookeeper.eventLoop`` (ISSUE 11); returns the loop in
+    effect (``"uvloop"`` or ``"asyncio"``).
+
+    ``"uvloop"`` installs uvloop's event-loop policy when the package is
+    importable; a missing/broken uvloop logs a warning and falls back to
+    asyncio — the daemon never fails to start over an optional
+    accelerator.  Default (absent key, or ``"asyncio"``): no policy
+    change at all, byte-identical to every prior release.  The wire
+    behavior is loop-independent either way (parity pinned by
+    tests/test_main.py).
+    """
+    if cfg.zookeeper.event_loop != "uvloop":
+        return "asyncio"
+    try:
+        import uvloop  # noqa: PLC0415 - optional, import-guarded
+    except ImportError:
+        logging.getLogger("registrar").warning(
+            "config zookeeper.eventLoop is \"uvloop\" but uvloop is not "
+            "installed; continuing on the stdlib asyncio loop"
+        )
+        return "asyncio"
+    asyncio.set_event_loop_policy(uvloop.EventLoopPolicy())
+    return "uvloop"
+
+
 def main(argv=None) -> None:
     cfg = configure(argv)
+    install_event_loop(cfg)
     try:
         asyncio.run(run(cfg))
     except KeyboardInterrupt:

@@ -98,6 +98,26 @@ class ZNode:
             pzxid=self.pzxid,
         )
 
+    def stat_packed(self) -> bytes:
+        """The 68-byte wire Stat packed straight from the node fields —
+        no :class:`Stat` dataclass intermediate (the EXISTS/GET_DATA
+        reply fast lane under 1k–10k-znode sweeps, ISSUE 11).
+        Byte-identity with ``self.stat()._packed()`` is pinned by
+        tests/test_wire_golden.py."""
+        return proto.pack_stat(
+            self.czxid,
+            self.mzxid,
+            self.ctime,
+            self.mtime,
+            self.version,
+            self.cversion,
+            self.aversion,
+            self.ephemeral_owner,
+            len(self.data),
+            len(self.children),
+            self.pzxid,
+        )
+
 
 @dataclass
 class Session:
@@ -117,6 +137,13 @@ class Session:
     def connected(self) -> bool:
         return self.conn is not None
 
+
+#: sentinel returned by the sync GET_DATA fast lane when the request
+#: must route through the async ``_dispatch`` (quota-stats refresh)
+_SLOW_PATH = object()
+
+#: quota subtree prefix (reads under it may rewrite the stats node)
+_QUOTA_PREFIX = QUOTA_ROOT + "/"
 
 #: Reply-batching caps: flush at least every this-many queued replies —
 #: or this many queued bytes (a burst of big getData answers must not
@@ -2061,9 +2088,14 @@ class ZKServer:
 
         # --- request loop ---
         while not conn.closed:
-            payload = await frames.frame()
+            # Sync fast lane: a pipelined sweep leaves the next frame
+            # already buffered — skip the coroutine round trip per
+            # request (ISSUE 11; frame() still owns EOF/corrupt-length).
+            payload = frames.frame_nowait()
             if payload is None:
-                return
+                payload = await frames.frame()
+                if payload is None:
+                    return
             self.packets_received += 1
             sess.last_heard = time.monotonic()
             r = Reader(payload)
@@ -2127,7 +2159,23 @@ class ZKServer:
                     if conn.queue_full() or not frames.pending():
                         await conn.flush()
                     continue
-            reply = await self._dispatch(conn, sess, hdr, r)
+            # Coroutine-free lanes for the hot read ops (ISSUE 11): a
+            # 10k-node heartbeat sweep is 10k EXISTS requests and a
+            # resolve burst is getData/getChildren2 — none of which ever
+            # await; routing them through the async _dispatch cost a
+            # coroutine per request.
+            if hdr.type == OpCode.EXISTS:
+                reply = self._exists_fast(conn, sess, hdr, r)
+            elif hdr.type == OpCode.GET_DATA:
+                reply = self._get_data_fast(conn, sess, hdr, r)
+                if reply is _SLOW_PATH:  # quota-stats read: may setData
+                    reply = await self._dispatch(
+                        conn, sess, hdr, Reader(payload, 8)
+                    )
+            elif hdr.type in (OpCode.GET_CHILDREN, OpCode.GET_CHILDREN2):
+                reply = self._children_fast(conn, sess, hdr, r)
+            else:
+                reply = await self._dispatch(conn, sess, hdr, r)
             if reply is not None:
                 conn.queue(reply)
             # Flush once per input burst — but also whenever the staged
@@ -2188,23 +2236,7 @@ class ZKServer:
                 self._catch_up()
                 return self._reply(hdr.xid, Err.OK)
             if op == OpCode.EXISTS:
-                req = proto.ExistsRequest.read(r)
-                proto.check_path(req.path)
-                try:
-                    node = self._resolve_read(req.path)
-                except KeyError:
-                    if req.watch:
-                        self._add_watch(
-                            _WATCH_EXIST, req.path, conn, stale_view=True
-                        )
-                    raise proto.ZKError(Err.NO_NODE, req.path)
-                if req.watch:
-                    self._add_watch(
-                        _WATCH_DATA, req.path, conn, stale_view=True
-                    )
-                return self._reply(
-                    hdr.xid, Err.OK, proto.ExistsResponse(stat=node.stat())
-                )
+                return self._exists_fast(conn, sess, hdr, r)
             if op == OpCode.GET_DATA:
                 req = proto.GetDataRequest.read(r)
                 proto.check_path(req.path)
@@ -2218,10 +2250,10 @@ class ZKServer:
                     self._add_watch(
                         _WATCH_DATA, req.path, conn, stale_view=True
                     )
-                return self._reply(
-                    hdr.xid,
-                    Err.OK,
-                    proto.GetDataResponse(data=node.data, stat=node.stat()),
+                return (
+                    proto.pack_reply_header(hdr.xid, self._view_zxid(), Err.OK)
+                    + proto.pack_buffer(node.data)
+                    + node.stat_packed()
                 )
             if op == OpCode.SET_DATA:
                 req = proto.SetDataRequest.read(r)
@@ -2272,25 +2304,7 @@ class ZKServer:
                     hdr.xid, Err.OK, proto.SetACLResponse(stat=node.stat())
                 )
             if op in (OpCode.GET_CHILDREN, OpCode.GET_CHILDREN2):
-                req = proto.GetChildrenRequest.read(r)
-                proto.check_path(req.path)
-                try:
-                    node = self._resolve_read(req.path)
-                except KeyError:
-                    raise proto.ZKError(Err.NO_NODE, req.path)
-                self._check_acl(node.acls, proto.Perms.READ, sess)
-                if req.watch:
-                    self._add_watch(
-                        _WATCH_CHILD, req.path, conn, stale_view=True
-                    )
-                children = sorted(node.children)
-                if op == OpCode.GET_CHILDREN:
-                    body = proto.GetChildrenResponse(children=children)
-                else:
-                    body = proto.GetChildren2Response(
-                        children=children, stat=node.stat()
-                    )
-                return self._reply(hdr.xid, Err.OK, body)
+                return self._children_fast(conn, sess, hdr, r)
             if op == OpCode.SET_WATCHES:
                 req = proto.SetWatches.read(r)
                 # Real ZooKeeper compares each path's state against the
@@ -2358,6 +2372,102 @@ class ZKServer:
                 return self._reply(hdr.xid, Err.OK)
             log.warning("unimplemented opcode %d", op)
             return self._reply(hdr.xid, Err.UNIMPLEMENTED)
+        except proto.ZKError as e:
+            return self._reply(hdr.xid, e.code)
+        except ValueError:
+            return self._reply(hdr.xid, Err.BAD_ARGUMENTS)
+
+    def _exists_fast(
+        self, conn: "_Connection", sess: Session, hdr: proto.RequestHeader,
+        r: Reader,
+    ) -> bytes:
+        """EXISTS handled without a coroutine (the request loop calls
+        this directly) and without Stat/ExistsResponse intermediates —
+        the server half of the heartbeat sweep's hot path (ISSUE 11).
+        Replies are byte-identical to the general ``_dispatch`` path
+        (``encode_reply_payload(.., ExistsResponse(node.stat()))``),
+        pinned by tests/test_wire_golden.py; the error contract mirrors
+        ``_dispatch``'s except clauses.
+        """
+        try:
+            # Fields read inline (no ExistsRequest dataclass): this runs
+            # once per swept znode.
+            path = r.read_ustring()
+            watch = r.read_bool()
+            proto.check_path(path)
+            try:
+                node = self._resolve_read(path)
+            except KeyError:
+                if watch:
+                    self._add_watch(_WATCH_EXIST, path, conn, stale_view=True)
+                return self._reply(hdr.xid, Err.NO_NODE)
+            if watch:
+                self._add_watch(_WATCH_DATA, path, conn, stale_view=True)
+            return proto.pack_reply_header(
+                hdr.xid, self._view_zxid(), Err.OK
+            ) + node.stat_packed()
+        except proto.ZKError as e:
+            return self._reply(hdr.xid, e.code)
+        except ValueError:
+            return self._reply(hdr.xid, Err.BAD_ARGUMENTS)
+
+    def _get_data_fast(
+        self, conn: "_Connection", sess: Session, hdr: proto.RequestHeader,
+        r: Reader,
+    ):
+        """GET_DATA without a coroutine or dataclass intermediates (the
+        resolver's op).  Quota-stats reads — which may genuinely rewrite
+        the stats node — return :data:`_SLOW_PATH` so the request loop
+        routes them through the async ``_dispatch``.  Replies byte-
+        identical to the general path (tests/test_wire_golden.py)."""
+        try:
+            path = r.read_ustring()
+            watch = r.read_bool()
+            proto.check_path(path)
+            if path.startswith(_QUOTA_PREFIX):
+                return _SLOW_PATH
+            try:
+                node = self._resolve_read(path)
+            except KeyError:
+                return self._reply(hdr.xid, Err.NO_NODE)
+            self._check_acl(node.acls, proto.Perms.READ, sess)
+            if watch:
+                self._add_watch(_WATCH_DATA, path, conn, stale_view=True)
+            return (
+                proto.pack_reply_header(hdr.xid, self._view_zxid(), Err.OK)
+                + proto.pack_buffer(node.data)
+                + node.stat_packed()
+            )
+        except proto.ZKError as e:
+            return self._reply(hdr.xid, e.code)
+        except ValueError:
+            return self._reply(hdr.xid, Err.BAD_ARGUMENTS)
+
+    def _children_fast(
+        self, conn: "_Connection", sess: Session, hdr: proto.RequestHeader,
+        r: Reader,
+    ) -> bytes:
+        """GET_CHILDREN/GET_CHILDREN2 without a coroutine (sync by
+        construction); the vector body keeps the general record encoder.
+        Serves both the request loop's fast lane and ``_dispatch``."""
+        try:
+            req = proto.GetChildrenRequest.read(r)
+            proto.check_path(req.path)
+            try:
+                node = self._resolve_read(req.path)
+            except KeyError:
+                return self._reply(hdr.xid, Err.NO_NODE)
+            self._check_acl(node.acls, proto.Perms.READ, sess)
+            if req.watch:
+                self._add_watch(_WATCH_CHILD, req.path, conn, stale_view=True)
+            children = sorted(node.children)
+            if hdr.type == OpCode.GET_CHILDREN:
+                body = proto.GetChildrenResponse(children=children)
+            else:
+                body = proto.GetChildren2Response(
+                    children=children, stat=node.stat()
+                )
+            return self._reply(hdr.xid, Err.OK, body)
         except proto.ZKError as e:
             return self._reply(hdr.xid, e.code)
         except ValueError:

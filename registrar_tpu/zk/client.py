@@ -917,12 +917,16 @@ class ZKClient(EventEmitter):
     async def _read_loop(self) -> None:
         # Bulk-buffered framing (registrar_tpu/zk/framing.py): one
         # transport read per TCP burst, then dispatch every complete
-        # frame carved from the buffer.
+        # frame carved zero-copy out of the receive chunks.  Liveness is
+        # stamped once per burst, not per frame — the watchdog's
+        # granularity is seconds, and a 10k-reply sweep cost 10k clock
+        # reads.
         frames = FrameReader(self._reader)
         try:
             while True:
                 if not await frames.fill():
                     raise ConnectionError("connection closed by server")
+                self._last_response = time.monotonic()
                 for payload in frames.carve():
                     self._dispatch_frame(payload)
         except asyncio.CancelledError:
@@ -934,31 +938,35 @@ class ZKClient(EventEmitter):
             log.exception("protocol error on connection; tearing down")
             await self._teardown(expected=False)
 
-    def _dispatch_frame(self, payload: bytes) -> None:
-        self._last_response = time.monotonic()
-        r = Reader(payload)
-        reply = proto.ReplyHeader.read(r)
-        if reply.zxid > 0:
-            self.last_zxid = reply.zxid
-        if reply.xid == proto.XID_NOTIFICATION:
-            event = proto.WatcherEvent.read(r)
+    def _dispatch_frame(self, payload) -> None:
+        # Header unpacked in place (no ReplyHeader dataclass, no Reader
+        # for error/ping frames): this runs once per received frame.
+        xid, zxid, err = proto.unpack_reply_header(payload)
+        if zxid > 0:
+            self.last_zxid = zxid
+        if xid == proto.XID_NOTIFICATION:
+            event = proto.WatcherEvent.read(
+                Reader(payload, proto.REPLY_HDR_SIZE)
+            )
             self._on_watch_event(event)
             return
-        if reply.xid == proto.XID_PING:
+        if xid == proto.XID_PING:
             # Pings are fire-and-forget (no _pending entry); their replies
-            # matter only as liveness, recorded in _last_response above.
+            # matter only as liveness, recorded in _last_response by the
+            # read loop.
             return
-        sp = self._op_spans.pop(reply.xid, None)
-        if sp is not None:
-            if reply.err != Err.OK:
-                sp.finish("error", err=reply.err)
-            else:
-                sp.finish()
+        if self._op_spans:
+            sp = self._op_spans.pop(xid, None)
+            if sp is not None:
+                if err != Err.OK:
+                    sp.finish("error", err=err)
+                else:
+                    sp.finish()
         if not self._pending:
-            log.warning("unmatched reply xid=%d", reply.xid)
+            log.warning("unmatched reply xid=%d", xid)
             return
         expected_xid, fut = self._pending.popleft()
-        if expected_xid != reply.xid:
+        if expected_xid != xid:
             # FIFO pairing is broken: the connection is permanently
             # desynchronized.  Raise so _read_loop tears it down and the
             # reconnect machinery takes over (a fresh connection resets the
@@ -967,19 +975,19 @@ class ZKClient(EventEmitter):
             if not fut.done():
                 fut.set_exception(ZKError(Err.CONNECTION_LOSS))
             raise ConnectionError(
-                f"xid mismatch: expected {expected_xid} got {reply.xid}"
+                f"xid mismatch: expected {expected_xid} got {xid}"
             )
         if fut.done():
             return
-        if reply.err != Err.OK:
-            if reply.err == Err.NOT_READONLY:
+        if err != Err.OK:
+            if err == Err.NOT_READONLY:
                 # A write reached a read-only (minority) member: the
                 # caller gets the retryable error; observers (metrics:
                 # registrar_write_refusals_total) get the event.
                 self.emit("write_refused", "read_only")
-            fut.set_exception(ZKError(reply.err))
+            fut.set_exception(ZKError(err))
         else:
-            fut.set_result(r)
+            fut.set_result(Reader(payload, proto.REPLY_HDR_SIZE))
 
     #: which client-side watch registrations each event type consumes
     #: (matching real ZK: data/exist watches fire on created/deleted/
@@ -1006,18 +1014,21 @@ class ZKClient(EventEmitter):
         self.emit("watch", event)
         self._watch_emitter.emit(event.path, event)
 
-    def _post(self, xid: int, op: int, body) -> asyncio.Future:
+    def _post(self, xid: int, op: int, body, tr=None) -> asyncio.Future:
         """Queue one request on the wire without awaiting anything.
 
         The pipelining primitive: callers fan out many posts back to back
         (one buffered write each), drain once, then await the futures —
         avoiding a Task per operation for large fan-outs like the
-        heartbeat's stat sweep."""
+        heartbeat's stat sweep.  ``tr`` lets a pipelined burst resolve
+        the tracer once instead of per post (10k lookups per 10k-node
+        sweep otherwise)."""
         if not self._connected or self._writer is None:
             raise ZKError(Err.CONNECTION_LOSS)
         fut: asyncio.Future = asyncio.get_running_loop().create_future()
         self._pending.append((xid, fut))
-        tr = trace.tracer_for(self)
+        if tr is None:
+            tr = trace.tracer_for(self)
         if tr.enabled and xid > 0:
             # One span per request, split submit -> flushed -> reply
             # (queue time vs wire time).  Reserved xids (auth replay,
@@ -1076,11 +1087,12 @@ class ZKClient(EventEmitter):
         """
         futs: List[asyncio.Future] = []
         post_err: Optional[BaseException] = None
+        tr = trace.tracer_for(self)
         try:
             self._cork()
             try:
                 for op, body in requests:
-                    futs.append(self._post(self._next_xid(), op, body))
+                    futs.append(self._post(self._next_xid(), op, body, tr))
             finally:
                 self._uncork()
             if futs and self._writer is not None:
@@ -1131,13 +1143,36 @@ class ZKClient(EventEmitter):
             raise OperationTimeoutError() from None
 
     async def _gather_replies(self, futs: Sequence[asyncio.Future]) -> List:
-        """Deadline-bounded ``gather(..., return_exceptions=True)`` over a
-        pipelined burst's reply futures (one shared deadline for the whole
-        burst: the replies ride one FIFO connection, so the burst is one
-        wire operation from the deadline's point of view)."""
-        return await self._await_reply(
-            asyncio.gather(*futs, return_exceptions=True)
-        )
+        """Deadline-bounded collection of a pipelined burst's reply
+        futures (one shared deadline for the whole burst: the replies
+        ride one FIFO connection, so the burst is one wire operation
+        from the deadline's point of view).
+
+        FIFO makes the LAST future the barrier: replies resolve in
+        submission order, and a teardown fails every pending future at
+        once — so when ``futs[-1]`` is done, all are.  Waiting on that
+        one future costs one done-callback instead of the one-per-future
+        a ``gather`` would register (10k registrations per 10k-znode
+        sweep, ISSUE 11).  Exceptions are retrieved from every future on
+        every path, including the timeout teardown, so no "exception was
+        never retrieved" noise can escape."""
+        if not futs:
+            return []
+        try:
+            await self._await_reply(asyncio.wait([futs[-1]]))
+        except OperationTimeoutError:
+            # _await_reply already tore the connection down, which
+            # resolved every pending future to CONNECTION_LOSS; mark
+            # them retrieved before surfacing the deadline.
+            for fut in futs:
+                if fut.done() and not fut.cancelled():
+                    fut.exception()
+            raise
+        out: List = []
+        for fut in futs:
+            err = fut.exception()
+            out.append(fut.result() if err is None else err)
+        return out
 
     async def _ping_loop(self) -> None:
         """Session keepalive + server-liveness watchdog.
@@ -1613,60 +1648,150 @@ class ZKClient(EventEmitter):
         Note this is an *application-level* probe of the znodes; the session
         keepalive pings are handled inside the client (reference README:56-58
         makes the same distinction).
-        """
-        nodes = list(nodes)
-        for n in nodes:
-            self._check_path(n)
 
-        async def check() -> None:
-            # Pipelined: post every exists request (buffered writes), one
-            # drain, then collect replies in order — no per-node Task, so
-            # a 1000-znode sweep is one scheduling round, not a thousand.
+        One-group front of :meth:`heartbeat_many` — the per-group
+        contract (pipelined EXISTS flush, NO_NODE retried through the
+        bounded policy, :class:`OwnershipError`/SESSION_EXPIRED fatal)
+        lives there in ONE copy.
+        """
+        err = (await self.heartbeat_many([nodes], retry=retry))[0]
+        if err is not None:
+            raise err
+
+    async def heartbeat_many(
+        self,
+        groups: Sequence[Iterable[str]],
+        retry: Optional[RetryPolicy] = None,
+        on_outcome=None,
+    ) -> List[Optional[BaseException]]:
+        """Coalesced heartbeat: several services' owned-znode sweeps in
+        ONE pipelined EXISTS flush per attempt (ISSUE 11 tentpole).
+
+        ``groups`` is one node list per service; the return value is one
+        entry per group — None on success, or the exception a solo
+        :meth:`heartbeat` over that group would have raised.  Per-group
+        behavior is contract-identical to N independent heartbeat calls
+        sharing a deterministic retry schedule: a NO_NODE in group A
+        burns A's attempts only, group B's sweep neither waits for nor
+        fails with it; OwnershipError and SESSION_EXPIRED are final
+        immediately (non-retryable); transient wire errors retry every
+        still-undecided group together.  What coalescing changes is
+        only the wire shape: all groups' EXISTS requests ride one
+        corked write + one drain + one shared reply deadline
+        (:meth:`_gather_replies`) instead of one flush per service.
+
+        ``on_outcome(index, err_or_none)`` fires the moment a group's
+        verdict is final — so a healthy service is released after the
+        first attempt while a failing one is still riding the backoff
+        schedule (the agent's coalescer resolves per-service futures
+        from it).
+        """
+        groups = [list(g) for g in groups]
+        for g in groups:
+            for n in g:
+                self._check_path(n)
+        policy = retry or HEARTBEAT_RETRY
+        pending = object()  # sentinel: group not yet decided
+        outcomes: List[object] = [pending] * len(groups)
+
+        def settle(i: int, err: Optional[BaseException]) -> None:
+            outcomes[i] = err
+            if on_outcome is not None:
+                on_outcome(i, err)
+
+        delays = policy.schedule()
+        attempt = 0
+        while True:
+            live = [i for i, o in enumerate(outcomes) if o is pending]
+            if not live:
+                break
+            errs = await self._exists_sweep(groups, live)
+            retrying = False
+            for i in live:
+                err = errs[i]
+                if err is None:
+                    settle(i, None)
+                    continue
+                # An expired session cannot heartbeat its way back:
+                # retrying just burns the bounded attempts while the
+                # daemon should already be exiting for its supervisor
+                # restart.  A foreign-owned ephemeral is just as
+                # un-retryable — the other session holds it until IT
+                # dies.  Everything else keeps the reference's
+                # retry-all behavior.
+                fatal = isinstance(err, OwnershipError) or (
+                    isinstance(err, ZKError)
+                    and err.code == Err.SESSION_EXPIRED
+                )
+                if fatal or attempt + 1 >= policy.max_attempts:
+                    settle(i, err)
+                else:
+                    retrying = True
+            if not retrying:
+                break
+            await asyncio.sleep(next(delays))
+            attempt += 1
+        return list(outcomes)  # type: ignore[arg-type]
+
+    async def _exists_sweep(self, groups, idxs) -> dict:
+        """One corked EXISTS flush over ``groups[i] for i in idxs``;
+        returns ``{i: first error for that group, or None}``.
+
+        Pipelined: post every exists request (buffered writes), one
+        drain, then collect replies in order — no per-node Task, so a
+        10k-znode sweep is one scheduling round, not ten thousand.  The
+        ownership check (ISSUE 3 satellite) rides the same replies: the
+        EXISTS stats already carry each node's ``ephemeralOwner``, and a
+        bare existence probe passed forever on an ephemeral held by a
+        FOREIGN session — a zombie predecessor's stale znode, or a
+        hijacking duplicate registering our hostname.  Persistent nodes
+        (the service record, owner 0) are exempt.  Decoded via
+        :func:`protocol.stat_owner_from_reply` — one field, no Stat
+        dataclass per reply (docs/PERF.md round 8).
+        """
+        flat: List[str] = []
+        bounds = []  # (group index, start, end) into flat
+        for i in idxs:
+            start = len(flat)
+            flat.extend(groups[i])
+            bounds.append((i, start, len(flat)))
+        try:
             futs, post_err = await self._post_pipeline(
                 (
                     OpCode.EXISTS,
                     proto.ExistsRequest(path=self._abs(n), watch=False),
                 )
-                for n in nodes
+                for n in flat
             )
             results = await self._gather_replies(futs)
-            for res in results:
+        except asyncio.CancelledError:
+            raise
+        except Exception as sweep_err:  # noqa: BLE001 - timeout/conn loss
+            return {i: sweep_err for i in idxs}
+        if post_err is not None:
+            # Posts after the failure point never got futures; their
+            # groups fail with the posting error, ranked after any real
+            # replies the earlier posts collected.
+            results = results + [post_err] * (len(flat) - len(results))
+        out = {}
+        for i, start, end in bounds:
+            err: Optional[BaseException] = None
+            for res in results[start:end]:
                 if isinstance(res, BaseException):
-                    raise res
-            if post_err is not None:
-                raise post_err
-            # Ownership sweep (ISSUE 3 satellite): the EXISTS replies
-            # already carry each node's stat, and a bare existence probe
-            # passed forever on an ephemeral held by a FOREIGN session —
-            # a zombie predecessor's stale znode, or a hijacking
-            # duplicate registering our hostname.  Persistent nodes (the
-            # service record, ephemeralOwner 0) are exempt; the NO_NODE
-            # and transport-error paths above are byte-identical to the
-            # pre-check behavior.
-            for node, res in zip(nodes, results):
-                stat = proto.ExistsResponse.read(res).stat
-                if (
-                    stat.ephemeral_owner
-                    and stat.ephemeral_owner != self.session_id
-                ):
-                    raise OwnershipError(
-                        node, stat.ephemeral_owner, self.session_id
-                    )
-
-        await call_with_backoff(
-            check,
-            retry or HEARTBEAT_RETRY,
-            # An expired session cannot heartbeat its way back: retrying
-            # just burns the bounded attempts while the daemon should
-            # already be exiting for its supervisor restart.  A foreign-
-            # owned ephemeral is just as un-retryable — the other session
-            # holds it until IT dies.  Everything else keeps the
-            # reference's retry-all behavior.
-            retryable=lambda err: not (
-                isinstance(err, OwnershipError)
-                or (isinstance(err, ZKError) and err.code == Err.SESSION_EXPIRED)
-            ),
-        )
+                    err = res
+                    break
+            if err is None:
+                for node, res in zip(groups[i], results[start:end]):
+                    try:
+                        owner = proto.stat_owner_from_reply(res)
+                    except Exception as decode_err:  # noqa: BLE001
+                        err = decode_err  # malformed stat: same verdict
+                        break  # as the old full-decode path
+                    if owner and owner != self.session_id:
+                        err = OwnershipError(node, owner, self.session_id)
+                        break
+            out[i] = err
+        return out
 
 
 class Op:

@@ -94,11 +94,22 @@ class Writer:
 
 
 class Reader:
-    """Reads jute-encoded primitives from a byte buffer."""
+    """Reads jute-encoded primitives from any bytes-like buffer.
+
+    ``data`` may be ``bytes`` or a ``memoryview`` (ISSUE 11): the frame
+    layer hands replies over as zero-copy views into the transport's
+    receive chunks, and every fixed-width primitive decodes in place via
+    ``unpack_from`` — no per-field slice is ever materialized.  Variable
+    payloads materialize lazily, exactly once, at their read call:
+    :meth:`read_buffer` returns real ``bytes`` (payloads escape into
+    caches and comparisons, where a view pinning a 64 KB receive chunk
+    would be a leak) and :meth:`read_ustring` decodes straight from the
+    view without an intermediate ``bytes`` copy.
+    """
 
     __slots__ = ("_data", "_pos")
 
-    def __init__(self, data: bytes, pos: int = 0) -> None:
+    def __init__(self, data, pos: int = 0) -> None:
         self._data = data
         self._pos = pos
 
@@ -109,7 +120,10 @@ class Reader:
     def remaining(self) -> int:
         return len(self._data) - self._pos
 
-    def _take(self, n: int) -> bytes:
+    def _take(self, n: int):
+        """Consume ``n`` bytes as a slice of the underlying buffer — a
+        copy for ``bytes`` input, a zero-copy subview for ``memoryview``
+        input.  Internal: callers materialize or decode as needed."""
         if self.remaining() < n:
             raise JuteError(
                 f"truncated jute data: need {n} bytes at offset {self._pos}, "
@@ -148,17 +162,41 @@ class Reader:
     def read_bool(self) -> bool:
         return self._take(1) != b"\x00"
 
+    def long_at(self, offset: int) -> int:
+        """Peek one long at ``pos + offset`` WITHOUT consuming anything.
+
+        The scratch-free fast path for fixed-layout reply bodies that
+        only need one field (the heartbeat sweep reads a Stat's
+        ``ephemeralOwner`` and nothing else — see
+        :func:`registrar_tpu.zk.protocol.stat_owner_from_reply`)."""
+        pos = self._pos + offset
+        if offset < 0 or len(self._data) - pos < 8:
+            raise JuteError(
+                f"truncated jute data: need 8 bytes at offset {pos}, "
+                f"have {max(len(self._data) - pos, 0)}"
+            )
+        return _LONG.unpack_from(self._data, pos)[0]
+
     def read_buffer(self) -> Optional[bytes]:
         n = self.read_int()
         if n == -1:
             return None
         if n < -1:
             raise JuteError(f"negative buffer length: {n}")
-        return self._take(n)
+        out = self._take(n)
+        # Materialize exactly once: a view escaping here would pin the
+        # whole receive chunk for as long as a cached payload lives.
+        return out if type(out) is bytes else bytes(out)
 
     def read_ustring(self) -> Optional[str]:
-        buf = self.read_buffer()
-        return None if buf is None else buf.decode("utf-8")
+        n = self.read_int()
+        if n == -1:
+            return None
+        if n < -1:
+            raise JuteError(f"negative buffer length: {n}")
+        # Decode straight off the buffer slice (bytes or view): one
+        # string allocation, no intermediate bytes copy for views.
+        return str(self._take(n), "utf-8")
 
     def read_vector(self, read_item: Callable[["Reader"], T]) -> Optional[List[T]]:
         n = self.read_int()

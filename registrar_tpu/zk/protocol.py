@@ -307,24 +307,22 @@ class Stat:
     pzxid: int = 0
 
     def _packed(self) -> bytes:
-        """The wire bytes — the ONE copy of the Stat field order, shared
-        by the jute walk and the stat-only reply fast path."""
-        try:
-            return _STAT.pack(
-                self.czxid,
-                self.mzxid,
-                self.ctime,
-                self.mtime,
-                self.version,
-                self.cversion,
-                self.aversion,
-                self.ephemeral_owner,
-                self.data_length,
-                self.num_children,
-                self.pzxid,
-            )
-        except struct.error as e:
-            raise JuteError(str(e)) from None
+        """The wire bytes (delegates to :func:`pack_stat` — the ONE copy
+        of the Stat field order, shared by the jute walk, the stat-only
+        reply fast path, and the server's dataclass-free stat lane)."""
+        return pack_stat(
+            self.czxid,
+            self.mzxid,
+            self.ctime,
+            self.mtime,
+            self.version,
+            self.cversion,
+            self.aversion,
+            self.ephemeral_owner,
+            self.data_length,
+            self.num_children,
+            self.pzxid,
+        )
 
     def write(self, w: Writer) -> None:
         w.append_packed(self._packed())
@@ -357,6 +355,29 @@ class Stat:
             num_children=num_children,
             pzxid=pzxid,
         )
+
+
+#: byte offset of ``ephemeralOwner`` inside a wire Stat: czxid, mzxid,
+#: ctime, mtime (4 longs = 32) + version, cversion, aversion (3 ints =
+#: 12).  Used by the stat-only reply fast path below.
+STAT_OWNER_OFFSET = 44
+
+
+def stat_owner_from_reply(r: Reader) -> int:
+    """``ephemeralOwner`` out of a stat-only reply body (EXISTS — the
+    heartbeat sweep's op) WITHOUT materializing the 11-field Stat.
+
+    The ownership check (:meth:`registrar_tpu.zk.client.ZKClient.
+    heartbeat`) reads exactly one of a Stat's eleven fields, and at
+    1k–10k znodes per sweep the per-reply ``ExistsResponse``+``Stat``
+    construction dominated the decode profile (docs/PERF.md round 8).
+    The reader is NOT consumed (nothing reads a heartbeat reply after
+    the owner check).  Raises :class:`~registrar_tpu.zk.jute.JuteError`
+    on a truncated body, exactly like ``Stat.read`` would.
+    """
+    if r.remaining() < _STAT.size:
+        r.read_struct(_STAT)  # raises the canonical truncation error
+    return r.long_at(STAT_OWNER_OFFSET)
 
 
 @dataclass
@@ -835,6 +856,73 @@ class CheckResult:
 def frame(payload: bytes) -> bytes:
     """Prefix a payload with its 4-byte big-endian length."""
     return _LEN.pack(len(payload)) + payload
+
+
+# --- single-pack primitives (the dataclass-free reply lane, ISSUE 11) -------
+#
+# The server answers a 10k-znode heartbeat sweep with 10k stat-only
+# replies; building Stat + ExistsResponse dataclasses per reply just to
+# struct-pack them again dominated its encode profile.  These helpers
+# expose the precompiled packs directly so the hot server lanes (and any
+# other caller that already holds the raw fields) can emit wire bytes
+# with zero intermediates — byte-identity with the record encoders is
+# pinned by tests/test_wire_golden.py.
+
+def pack_reply_header(xid: int, zxid: int, err: int) -> bytes:
+    """One-struct ReplyHeader bytes (encode twin of ``read_struct``)."""
+    try:
+        return _REPLY_HDR.pack(xid, zxid, err)
+    except struct.error as e:
+        raise JuteError(str(e)) from None
+
+
+#: ReplyHeader wire size — a reply body starts at this offset
+REPLY_HDR_SIZE = _REPLY_HDR.size
+
+
+def unpack_reply_header(payload) -> "tuple":
+    """``(xid, zxid, err)`` straight off a reply frame (bytes or view),
+    no ReplyHeader dataclass — the client dispatches every received
+    frame through this."""
+    if len(payload) < _REPLY_HDR.size:
+        raise JuteError(
+            f"truncated reply header: {len(payload)} bytes"
+        )
+    return _REPLY_HDR.unpack_from(payload, 0)
+
+
+def pack_buffer(value: Optional[bytes]) -> bytes:
+    """A jute buffer (int length + raw bytes; -1 encodes null)."""
+    if value is None:
+        return _LEN.pack(-1)
+    try:
+        return _LEN.pack(len(value)) + value
+    except struct.error as e:  # pragma: no cover - >2GiB payload
+        raise JuteError(str(e)) from None
+
+
+def pack_stat(
+    czxid: int,
+    mzxid: int,
+    ctime: int,
+    mtime: int,
+    version: int,
+    cversion: int,
+    aversion: int,
+    ephemeral_owner: int,
+    data_length: int,
+    num_children: int,
+    pzxid: int,
+) -> bytes:
+    """The 68-byte wire Stat in one struct pack — the ONE copy of the
+    field order (``Stat._packed`` delegates here)."""
+    try:
+        return _STAT.pack(
+            czxid, mzxid, ctime, mtime, version, cversion, aversion,
+            ephemeral_owner, data_length, num_children, pzxid,
+        )
+    except struct.error as e:
+        raise JuteError(str(e)) from None
 
 
 def encode_request(xid: int, op: int, body=None) -> bytes:

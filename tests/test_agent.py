@@ -8,6 +8,7 @@ initial-registration-failure path never ran; SURVEY.md §4).
 
 import asyncio
 
+import pytest
 
 from registrar_tpu.agent import (
     DEFAULT_HEARTBEAT_INTERVAL_S,
@@ -1041,3 +1042,231 @@ class TestReload:
         finally:
             await client.close()
             await server.stop()
+
+
+class TestHeartbeatCoalescing:
+    """ISSUE 11 tentpole: services sharing one ZKClient cork their
+    heartbeat sweeps into one pipelined flush, while every per-service
+    contract (events, NO_NODE scoping, OwnershipError, repair) holds."""
+
+    def _two_services(self, client, **kw):
+        """Two register_plus services on ONE client, with first-register
+        futures subscribed synchronously (B registers while a test still
+        awaits A — a late ``wait_for`` would miss the event)."""
+        loop = asyncio.get_event_loop()
+        out = []
+        for name in ("a", "b"):
+            reg = {
+                "domain": f"svc-{name}.test.registrar",
+                "type": "load_balancer",
+            }
+            ee = _plus(client, registration=reg, hostname=f"host{name}",
+                       heartbeat_interval=0.05, **kw)
+            fut = loop.create_future()
+            ee.once(
+                "register",
+                lambda z, f=fut: None if f.done() else f.set_result(z),
+            )
+            out.append((ee, fut))
+        (ee_a, reg_a), (ee_b, reg_b) = out
+        return ee_a, ee_b, reg_a, reg_b
+
+    async def test_two_services_coalesce_into_one_flush(self):
+        from registrar_tpu.agent import _coalescer_for
+
+        server, client = await _pair()
+        try:
+            ee_a, ee_b, reg_a, reg_b = self._two_services(client)
+            await asyncio.wait_for(reg_a, 10)
+            await asyncio.wait_for(reg_b, 10)
+
+            calls = {"many": 0, "solo": 0}
+            orig_many = client.heartbeat_many
+            orig_solo = client.heartbeat
+
+            async def spy_many(groups, retry=None, on_outcome=None):
+                groups = [list(g) for g in groups]
+                if len(groups) > 1:
+                    calls["many"] += 1
+                return await orig_many(groups, retry=retry,
+                                       on_outcome=on_outcome)
+
+            async def spy_solo(nodes, retry=None):
+                calls["solo"] += 1
+                return await orig_solo(nodes, retry=retry)
+
+            client.heartbeat_many = spy_many
+            client.heartbeat = spy_solo
+            # Both loops beat within the coalescing window: multi-group
+            # sweeps must appear, and keep appearing.
+            await ee_a.wait_for("heartbeat", timeout=10)
+            await ee_b.wait_for("heartbeat", timeout=10)
+            for _ in range(30):
+                if calls["many"] >= 2:
+                    break
+                await asyncio.sleep(0.05)
+            assert calls["many"] >= 2, (
+                f"services never coalesced: {calls}"
+            )
+            co = _coalescer_for(client)
+            assert co._attached == 2
+            ee_a.stop()
+            ee_b.stop()
+            # detach on stop: the next single-service client is solo
+            await asyncio.sleep(0.06)
+            assert co._attached == 0
+        finally:
+            await client.close()
+            await server.stop()
+
+    async def test_sibling_failure_stays_scoped(self):
+        # Deleting service A's znodes fails A's sweep with NO_NODE while
+        # B keeps heartbeating — the per-group contract through the
+        # coalesced flush.
+        from registrar_tpu.retry import RetryPolicy
+
+        server, client = await _pair()
+        try:
+            ee_a, ee_b, reg_a, reg_b = self._two_services(
+                client,
+                heartbeat_retry=RetryPolicy(
+                    max_attempts=2, initial_delay=0.01, max_delay=0.01
+                ),
+            )
+            znodes_a = await asyncio.wait_for(reg_a, 10)
+            await asyncio.wait_for(reg_b, 10)
+            failures = []
+            ee_a.on("heartbeatFailure", failures.append)
+            b_failures = []
+            ee_b.on("heartbeatFailure", b_failures.append)
+            for p in znodes_a:
+                await client.unlink(p)
+            (err,) = await ee_a.wait_for("heartbeatFailure", timeout=10)
+            assert getattr(err, "name", None) == "NO_NODE"
+            # B's loop keeps succeeding afterwards, untouched by A
+            await ee_b.wait_for("heartbeat", timeout=10)
+            await ee_b.wait_for("heartbeat", timeout=10)
+            assert not b_failures
+            ee_a.stop()
+            ee_b.stop()
+        finally:
+            await client.close()
+            await server.stop()
+
+    async def test_solo_service_uses_plain_heartbeat(self):
+        # A single register_plus on a client must keep calling
+        # client.heartbeat directly (zero added latency, and tests that
+        # monkeypatch it keep intercepting the probe).
+        server, client = await _pair()
+        try:
+            seen = []
+            orig = client.heartbeat
+
+            async def spy(nodes, retry=None):
+                seen.append(list(nodes))
+                return await orig(nodes, retry=retry)
+
+            client.heartbeat = spy
+            ee = _plus(client, heartbeat_interval=0.05)
+            await ee.wait_for("register", timeout=10)
+            await ee.wait_for("heartbeat", timeout=10)
+            assert seen, "solo service did not route through heartbeat()"
+            ee.stop()
+        finally:
+            await client.close()
+            await server.stop()
+
+    async def test_coalesced_repair_contract_preserved(self):
+        # repair_heartbeat_miss through the coalesced path: deleting A's
+        # znodes repairs A (NO_NODE -> confirm -> pipeline) while B is
+        # never deregistered or repaired.
+        from registrar_tpu.retry import RetryPolicy
+
+        server, client = await _pair()
+        try:
+            fast = RetryPolicy(
+                max_attempts=2, initial_delay=0.01, max_delay=0.01
+            )
+            ee_a, ee_b, reg_a, reg_b = self._two_services(
+                client, heartbeat_retry=fast, repair_heartbeat_miss=True
+            )
+            znodes_a = await asyncio.wait_for(reg_a, 10)
+            await asyncio.wait_for(reg_b, 10)
+            b_registers = []
+            ee_b.on("register", b_registers.append)
+            for p in znodes_a:
+                await client.unlink(p)
+            await ee_a.wait_for("heartbeatFailure", timeout=10)
+            (reg_nodes,) = await ee_a.wait_for("register", timeout=10)
+            assert reg_nodes == znodes_a  # same desired paths, recreated
+            for p in reg_nodes:
+                st = await client.stat(p)
+                assert st.ephemeral_owner == client.session_id
+            assert not b_registers  # B untouched by A's repair
+            ee_a.stop()
+            ee_b.stop()
+        finally:
+            await client.close()
+            await server.stop()
+
+    async def test_cancelled_flush_window_releases_staged_sweeps(self):
+        # Review regression: a flush task cancelled mid-window must
+        # cancel the staged futures — not orphan service loops parked
+        # on them forever.
+        from registrar_tpu.agent import HeartbeatCoalescer
+
+        class _NeverZK:
+            async def heartbeat_many(self, groups, retry=None,
+                                     on_outcome=None):
+                raise AssertionError("flush must not run after cancel")
+
+        co = HeartbeatCoalescer(_NeverZK())
+        co.attach()
+        co.attach()  # >1 attached: sweeps stage behind the window
+        sweep = asyncio.ensure_future(co.sweep(["/x"], None, 10.0))
+        await asyncio.sleep(0.01)  # let it stage + start the window
+        assert co._flush_task is not None
+        co._flush_task.cancel()
+        with pytest.raises(asyncio.CancelledError):
+            await asyncio.wait_for(sweep, 1.0)
+        assert co._staged == []
+        co.detach()
+        co.detach()
+
+    async def test_divergent_policies_do_not_head_of_line_block(self):
+        # Review regression: per-policy rounds run CONCURRENTLY — a
+        # round riding a failing group's backoff must not stall another
+        # policy's healthy sweep behind it.
+        import time as _time
+
+        from registrar_tpu.agent import HeartbeatCoalescer
+        from registrar_tpu.retry import RetryPolicy
+
+        slowp = RetryPolicy(max_attempts=1)
+        fastp = RetryPolicy(max_attempts=2)
+
+        class _ZK:
+            async def heartbeat_many(self, groups, retry=None,
+                                     on_outcome=None):
+                if retry is slowp:
+                    await asyncio.sleep(0.4)  # a sibling's backoff
+                for i in range(len(groups)):
+                    if on_outcome:
+                        on_outcome(i, None)
+                return [None] * len(groups)
+
+        co = HeartbeatCoalescer(_ZK())
+        co.attach()
+        co.attach()
+        t0 = _time.monotonic()
+        slow = asyncio.ensure_future(co.sweep(["/slow"], slowp, 1.0))
+        fast = asyncio.ensure_future(co.sweep(["/fast"], fastp, 1.0))
+        await fast
+        fast_done = _time.monotonic() - t0
+        await slow
+        assert fast_done < 0.3, (
+            f"healthy policy's sweep took {fast_done:.2f}s — head-of-line "
+            "blocked behind the slow round"
+        )
+        co.detach()
+        co.detach()

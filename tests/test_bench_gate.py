@@ -272,3 +272,39 @@ sys.exit(bench.main())
         out = self._run(BASELINE, fake_value=9999.0,
                         extra_env={"BENCH_GATE": "0"})
         assert out.returncode == 0
+
+
+class TestBenchMetricsDeclaration:
+    """ISSUE 11: BENCH_METRICS is the declared metric-name registry —
+    the runtime twin of checklib's bench-metric-drift rule."""
+
+    def test_history_directions_match_declaration(self):
+        with open(os.path.join(REPO, "BENCH_HISTORY.json")) as fh:
+            directions = json.load(fh)["directions"]
+        for name, direction in directions.items():
+            assert bench.BENCH_METRICS.get(name) == direction, (
+                f"history pins {name!r} as {direction!r} but BENCH_METRICS "
+                f"declares {bench.BENCH_METRICS.get(name)!r}"
+            )
+
+    def test_baseline_metrics_are_declared(self):
+        baseline = bench.load_baseline()
+        assert baseline is not None
+        for name in baseline["metrics"]:
+            assert name in bench.BENCH_METRICS
+
+    def test_undeclared_emitted_metric_fails_gate(self):
+        res = _result()
+        res["extra"]["rogue_metric_ms"] = 1.0
+        failures = bench.gate(res, BASELINE, 10)
+        assert any("rogue_metric_ms" in f and "BENCH_METRICS" in f
+                   for f in failures)
+
+    def test_declared_metrics_pass_declaration_check(self):
+        # the canonical result shape emits only declared names
+        failures = bench.gate(_result(), BASELINE, 10)
+        assert not any("BENCH_METRICS" in f for f in failures)
+
+    def test_hist_quantile_names_are_declared_literals(self):
+        for _q, name in bench.HIST_QUANTILE_METRICS:
+            assert bench.BENCH_METRICS.get(name) == "lower"

@@ -341,6 +341,28 @@ PROGRAM_SEEDED_VIOLATIONS = {
             | `id: real-fault` | the documented one |
             """,
     },
+    "bench-metric-drift": {
+        "registrar_tpu/seeded.py": "x = 1\n",
+        "bench.py": """\
+            BENCH_METRICS = {
+                "ghost_metric_ms": "lower",
+                "shared_metric_ms": "lower",
+            }
+            """,
+        "BENCH_HISTORY.json": """\
+            {"directions": {"shared_metric_ms": "lower",
+                            "orphaned_metric_ms": "lower"},
+             "rounds": []}
+            """,
+        "docs/PERF.md": """\
+            # Perf
+
+            | metric | value |
+            |---|---|
+            | shared_metric_ms | fine |
+            | phantom_metric_ms | cited but nonexistent |
+            """,
+    },
     "span-name-drift": {
         "registrar_tpu/seeded.py": """\
             class _Recorder:
@@ -1263,6 +1285,26 @@ def test_program_seeded_violation_fails_gate(rule, tmp_path):
     proc = run_checker("registrar_tpu", "--no-baseline", cwd=tree)
     assert proc.returncode == 1, proc.stdout + proc.stderr
     assert program_rules_fired(proc) == [rule]
+
+
+def test_bench_metric_drift_fires_every_direction(tmp_path):
+    # The fixture seeds all three legs: a declared-pinned metric with no
+    # history entry, a history pin bench no longer declares, and a
+    # PERF.md table citing a name neither surface knows (its token
+    # contains the substring "metric" — a header/data-row confusion
+    # must not skip it).
+    tree = seed_program_tree(
+        tmp_path, PROGRAM_SEEDED_VIOLATIONS["bench-metric-drift"]
+    )
+    proc = run_checker(
+        "registrar_tpu", "--no-baseline", "--format", "json", cwd=tree
+    )
+    assert proc.returncode == 1
+    msgs = [p["message"] for p in json.loads(proc.stdout)["problems"]]
+    assert any("ghost_metric_ms" in m for m in msgs)  # declared, unpinned
+    assert any("orphaned_metric_ms" in m for m in msgs)  # pinned, undeclared
+    assert any("phantom_metric_ms" in m for m in msgs)  # doc cites unknown
+    assert not any("shared_metric_ms" in m for m in msgs)  # consistent
 
 
 def test_transitive_blocking_chain_in_json_report(tmp_path):

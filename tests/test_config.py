@@ -99,6 +99,23 @@ class TestParse:
         with pytest.raises(ConfigError):
             parse_config(base)
 
+    def test_event_loop_opt_in(self):
+        # eventLoop (ISSUE 11): absent = None (stdlib loop, no policy
+        # change); "asyncio"/"uvloop" accepted; anything else rejected.
+        base = {
+            "registration": {"domain": "a.b", "type": "host"},
+            "zookeeper": {"servers": [{"host": "h", "port": 1}]},
+        }
+        assert parse_config(base).zookeeper.event_loop is None
+        base["zookeeper"]["eventLoop"] = "asyncio"
+        assert parse_config(base).zookeeper.event_loop == "asyncio"
+        base["zookeeper"]["eventLoop"] = "uvloop"
+        assert parse_config(base).zookeeper.event_loop == "uvloop"
+        for bad in ("trio", "", 1, True):
+            base["zookeeper"]["eventLoop"] = bad
+            with pytest.raises(ConfigError):
+                parse_config(base)
+
     def test_unknown_top_level_keys_surfaced(self):
         cfg = parse_config(
             {

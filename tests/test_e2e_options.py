@@ -149,10 +149,19 @@ class TestAllOptionsTogether:
             await zk_server.corrupt_node(hostnode, b'{"evil":1}')
 
             async def contract_restored():
+                from registrar_tpu.zk.protocol import Err, ZKError
+
                 got = await observer.exists(hostnode)
                 if got is None:
                     return False
-                data, _ = await observer.get(hostnode)
+                try:
+                    data, _ = await observer.get(hostnode)
+                except ZKError as err:
+                    if err.code == Err.NO_NODE:
+                        # exists->get raced the repair pipeline's
+                        # delete+recreate window; poll again
+                        return False
+                    raise
                 return data == want
 
             await wait_for(contract_restored)

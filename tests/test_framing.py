@@ -143,6 +143,125 @@ class TestFill:
         assert run(fr.fill()) is False
 
 
+class TestZeroCopyGrowth:
+    """ISSUE 11: the chunk-deque buffer — zero-copy carving for
+    within-chunk frames, bounded retention, no re-copy on large bursts
+    (the old bytearray memmove-compacted the whole remaining burst on
+    every fill and copied each frame out of it)."""
+
+    def test_within_chunk_frames_are_views_into_the_receive_chunk(self):
+        chunk = _frame(b"one") + _frame(b"two")
+        fr = FrameReader(_FakeReader([chunk]))
+
+        async def go():
+            assert await fr.fill()
+            return fr.carve()
+
+        one, two = run(go())
+        # Zero-copy: both payloads alias the original receive chunk.
+        assert isinstance(one, memoryview) and one.obj is chunk
+        assert isinstance(two, memoryview) and two.obj is chunk
+        assert one == b"one" and two == b"two"
+
+    def test_whole_chunk_frame_is_the_chunk_tail_itself(self):
+        # A frame whose payload ends exactly at the chunk boundary
+        # consumes the chunk; the final take may hand back the chunk
+        # (or a view of it) but never a copy.
+        payload = b"x" * 1000
+        chunk = _frame(payload)
+        fr = FrameReader(_FakeReader([chunk]))
+
+        async def go():
+            assert await fr.fill()
+            return fr.carve()
+
+        (got,) = run(go())
+        assert isinstance(got, memoryview) and got.obj is chunk
+
+    def test_spanning_frame_joins_exactly_once(self):
+        whole = _frame(b"A" * 100)
+        fr = FrameReader(_FakeReader([whole[:40], whole[40:]]))
+
+        async def go():
+            assert await fr.fill()
+            first = fr.carve()
+            assert await fr.fill()
+            return first, fr.carve()
+
+        first, second = run(go())
+        assert first == []
+        assert second == [b"A" * 100]
+        assert type(second[0]) is bytes  # joined copy, boundary case
+
+    def test_burst_consumption_drops_chunks_as_it_goes(self):
+        # The 10k-znode-sweep regression (PR-1 burst test's big sibling):
+        # a >64 KB burst arriving as many chunks must not accumulate —
+        # consumed chunks are released at carve time, so the buffered
+        # residue after carving a huge burst is zero, not a re-copied
+        # prefix.
+        n_frames = 2000
+        burst = b"".join(_frame(b"p" * 84) for _ in range(n_frames))
+        chunk_size = 65536
+        chunks = [
+            burst[i : i + chunk_size]
+            for i in range(0, len(burst), chunk_size)
+        ]
+
+        async def go():
+            reader = asyncio.StreamReader()
+            for c in chunks:
+                reader.feed_data(c)
+            reader.feed_eof()
+            fr = FrameReader(reader)
+            assert await fr.fill()
+            frames = fr.carve()
+            return frames, len(fr._chunks), fr._size
+
+        frames, residual_chunks, residual_bytes = run(go())
+        assert len(frames) == n_frames
+        assert all(f == b"p" * 84 for f in frames)
+        # nothing retained once every frame is carved
+        assert residual_chunks == 0 and residual_bytes == 0
+
+    def test_max_frame_boundary_accepted(self):
+        payload = b"z" * MAX_FRAME
+        fr = FrameReader(_FakeReader([_frame(payload)]))
+
+        async def go():
+            while not fr.pending():
+                assert await fr.fill()
+            return fr.carve()
+
+        (got,) = run(go())
+        assert len(got) == MAX_FRAME
+
+    def test_frame_nowait_fast_path(self):
+        fr = FrameReader(_FakeReader([_frame(b"abc") + _frame(b"de")[:4]]))
+
+        async def go():
+            assert fr.frame_nowait() is None  # nothing buffered yet
+            assert await fr.fill()
+            first = fr.frame_nowait()
+            incomplete = fr.frame_nowait()
+            return first, incomplete
+
+        first, incomplete = run(go())
+        assert first == b"abc"
+        assert incomplete is None  # partial trailing frame: await path
+
+    def test_frame_nowait_defers_corrupt_length_to_frame(self):
+        fr = FrameReader(
+            _FakeReader([(-3).to_bytes(4, "big", signed=True) + b"xx"])
+        )
+
+        async def go():
+            assert await fr.fill()
+            assert fr.frame_nowait() is None  # deferred, not raised
+            return await fr.frame()
+
+        assert run(go()) is None  # the awaited path owns the verdict
+
+
 class TestHandshakeHelpers:
     def test_read4_then_frame_with_header(self):
         # The server peeks 4 bytes to detect 4lw commands, then hands the

@@ -268,6 +268,205 @@ class TestCheckPath:
             check_path(bad)
 
 
+class LegacyReader:
+    """The pre-ISSUE-11 Reader, verbatim: slicing ``_take`` (a bytes
+    copy per field), ``read_buffer`` returning the raw slice,
+    ``read_ustring`` decoding via an intermediate bytes copy.  The
+    differential oracle for the zero-copy decode path: on every golden
+    wire capture, the new Reader — over ``bytes`` AND over a
+    ``memoryview`` — must produce identical values, positions, and
+    failures."""
+
+    def __init__(self, data, pos=0):
+        self._data = data
+        self._pos = pos
+
+    @property
+    def pos(self):
+        return self._pos
+
+    def remaining(self):
+        return len(self._data) - self._pos
+
+    def _take(self, n):
+        if self.remaining() < n:
+            raise JuteError("truncated")
+        out = self._data[self._pos : self._pos + n]
+        self._pos += n
+        return out
+
+    def read_int(self):
+        import struct
+
+        return struct.unpack(">i", self._take(4))[0]
+
+    def read_long(self):
+        import struct
+
+        return struct.unpack(">q", self._take(8))[0]
+
+    def read_bool(self):
+        return self._take(1) != b"\x00"
+
+    def read_buffer(self):
+        n = self.read_int()
+        if n == -1:
+            return None
+        if n < -1:
+            raise JuteError(f"negative buffer length: {n}")
+        return self._take(n)
+
+    def read_ustring(self):
+        buf = self.read_buffer()
+        return None if buf is None else buf.decode("utf-8")
+
+    def read_vector(self, read_item):
+        n = self.read_int()
+        if n == -1:
+            return None
+        if n < -1:
+            raise JuteError(f"negative vector length: {n}")
+        if n > self.remaining():
+            raise JuteError(f"vector length {n} exceeds remaining data")
+        return [read_item(self) for _ in range(n)]
+
+
+def _wire_golden_corpus():
+    """Every hand-written golden frame from tests/test_wire_golden.py.
+
+    That module builds each golden through its ``hx(...)`` helper inside
+    the test bodies; re-running the (sync, self-contained) tests with a
+    capturing ``hx`` collects the full corpus — and re-asserts the
+    encode byte-identity pins along the way, so the sweep below always
+    runs against the captures as checked in, never a drifted copy.
+    """
+    import inspect
+
+    import test_wire_golden as golden
+
+    frames = []
+    orig_hx = golden.hx
+
+    def capture_hx(*parts):
+        b = orig_hx(*parts)
+        frames.append(b)
+        return b
+
+    golden.hx = capture_hx
+    try:
+        for name in sorted(dir(golden)):
+            fn = getattr(golden, name)
+            if (
+                name.startswith("test_")
+                and callable(fn)
+                and not inspect.iscoroutinefunction(fn)
+            ):
+                fn()
+    finally:
+        golden.hx = orig_hx
+    assert len(frames) >= 25, "golden corpus unexpectedly small"
+    return frames
+
+
+def _walk_ops(payload):
+    """A deterministic primitive-read schedule derived from the payload
+    bytes themselves, so every capture exercises a different mix."""
+    return [payload[i] % 5 for i in range(0, len(payload), 3)] or [0]
+
+
+def _run_walk(reader, ops):
+    """Execute a primitive-read schedule; returns (results, pos) where
+    a failure terminates the walk with a ("raise", step) marker."""
+    out = []
+    for step, op in enumerate(ops):
+        try:
+            if op == 0:
+                out.append(reader.read_int())
+            elif op == 1:
+                out.append(reader.read_long())
+            elif op == 2:
+                out.append(reader.read_bool())
+            elif op == 3:
+                out.append(reader.read_buffer())
+            else:
+                out.append(reader.read_ustring())
+        except JuteError:
+            out.append(("raise", step))
+            break
+        except UnicodeDecodeError:
+            out.append(("unicode", step))
+            break
+    return out, reader.pos
+
+
+class TestZeroCopyParity:
+    """ISSUE 11 satellite: the memoryview decode path against the old
+    implementation, on every golden wire capture."""
+
+    def test_parity_sweep_on_every_golden_capture(self):
+        # For each capture: the new Reader over bytes, the new Reader
+        # over a memoryview, and the legacy Reader must agree on every
+        # value, every cursor position, and every failure point — for a
+        # read schedule derived from the frame's own bytes, for the
+        # whole payload AND for truncated prefixes (the mid-frame
+        # corruption shape).
+        for frame_bytes in _wire_golden_corpus():
+            payload = frame_bytes[4:]  # strip the length prefix
+            views = (payload, len(payload) // 2, 7, 1, 0)
+            for cut in views:
+                blob = payload if cut is payload else payload[:cut]
+                ops = _walk_ops(blob)
+                legacy = _run_walk(LegacyReader(blob), ops)
+                new_bytes = _run_walk(Reader(blob), ops)
+                new_view = _run_walk(Reader(memoryview(blob)), ops)
+                assert new_bytes == legacy, (blob, ops)
+                assert new_view == legacy, (blob, ops)
+
+    def test_view_buffers_materialize_as_real_bytes(self):
+        # read_buffer over a memoryview must hand back honest bytes —
+        # a view escaping would be unhashable (binderview memoizes on
+        # payload bytes) and would pin the whole receive chunk.
+        w = Writer().write_buffer(b"payload").write_ustring("text")
+        r = Reader(memoryview(w.to_bytes()))
+        buf = r.read_buffer()
+        assert type(buf) is bytes and buf == b"payload"
+        assert r.read_ustring() == "text"
+
+    def test_zero_length_strings_and_buffers(self):
+        w = (
+            Writer()
+            .write_buffer(b"")
+            .write_ustring("")
+            .write_buffer(None)
+            .write_ustring(None)
+        )
+        for data in (w.to_bytes(), memoryview(w.to_bytes())):
+            r = Reader(data)
+            assert r.read_buffer() == b""
+            assert r.read_ustring() == ""
+            assert r.read_buffer() is None
+            assert r.read_ustring() is None
+            assert r.remaining() == 0
+
+    def test_truncated_view_raises_without_consuming(self):
+        r = Reader(memoryview(b"\x00\x00\x00\x05ab"))
+        with pytest.raises(JuteError):
+            r.read_buffer()
+        # the length int was consumed, the failed take was not
+        assert r.pos == 4
+
+    def test_long_at_peeks_without_consuming(self):
+        w = Writer().write_long(0xABCDEF).write_long(-7)
+        r = Reader(memoryview(w.to_bytes()))
+        assert r.long_at(8) == -7
+        assert r.pos == 0
+        assert r.read_long() == 0xABCDEF
+        with pytest.raises(JuteError):
+            r.long_at(9)  # past the end
+        with pytest.raises(JuteError):
+            r.long_at(-1)
+
+
 class TestCheckPathCache:
     def test_cache_bounded_with_fifo_eviction(self):
         # The validated-path cache must stay bounded past its cap AND

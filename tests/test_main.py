@@ -497,3 +497,91 @@ class TestGracefulStopOrdering:
                     pass
             await observer.close()
             await server.stop()
+
+
+class TestEventLoopInstall:
+    """zookeeper.eventLoop (ISSUE 11): uvloop opt-in, import-guarded,
+    default path untouched — parity pinned here."""
+
+    def _cfg(self, event_loop=None):
+        from registrar_tpu.config import parse_config
+
+        raw = {
+            "registration": {"domain": "a.b", "type": "host"},
+            "zookeeper": {"servers": [{"host": "h", "port": 1}]},
+        }
+        if event_loop is not None:
+            raw["zookeeper"]["eventLoop"] = event_loop
+        return parse_config(raw)
+
+    def test_default_changes_no_policy(self):
+        from registrar_tpu.main import install_event_loop
+
+        before = asyncio.get_event_loop_policy()
+        assert install_event_loop(self._cfg()) == "asyncio"
+        assert install_event_loop(self._cfg("asyncio")) == "asyncio"
+        assert asyncio.get_event_loop_policy() is before
+
+    def test_uvloop_missing_falls_back_with_warning(self, monkeypatch, caplog):
+        # The container has no uvloop: the import guard must fall back
+        # to the stdlib loop with one warning, never fail the start.
+        import builtins
+        import logging
+
+        from registrar_tpu.main import install_event_loop
+
+        real_import = builtins.__import__
+
+        def deny_uvloop(name, *a, **kw):
+            if name == "uvloop":
+                raise ImportError("No module named 'uvloop'")
+            return real_import(name, *a, **kw)
+
+        monkeypatch.setattr(builtins, "__import__", deny_uvloop)
+        before = asyncio.get_event_loop_policy()
+        with caplog.at_level(logging.WARNING, logger="registrar"):
+            assert install_event_loop(self._cfg("uvloop")) == "asyncio"
+        assert asyncio.get_event_loop_policy() is before
+        assert any("uvloop" in r.message for r in caplog.records)
+
+    def test_uvloop_present_installs_policy(self, monkeypatch):
+        # A stand-in uvloop module proves the happy path without the
+        # real dependency (which is deliberately not bundled).
+        import types
+
+        from registrar_tpu.main import install_event_loop
+
+        class _FakePolicy(asyncio.DefaultEventLoopPolicy):
+            pass
+
+        fake = types.ModuleType("uvloop")
+        fake.EventLoopPolicy = _FakePolicy
+        monkeypatch.setitem(sys.modules, "uvloop", fake)
+        before = asyncio.get_event_loop_policy()
+        try:
+            assert install_event_loop(self._cfg("uvloop")) == "uvloop"
+            assert isinstance(asyncio.get_event_loop_policy(), _FakePolicy)
+        finally:
+            asyncio.set_event_loop_policy(before)
+
+    async def test_wire_parity_is_loop_independent(self):
+        # The daemon's wire behavior must not depend on the loop choice:
+        # the same registration through the same server yields the same
+        # znodes + payload bytes whichever policy is installed (here:
+        # the stdlib one, the only loop shipped — uvloop itself is
+        # exercised only when an operator installs it).
+        from registrar_tpu.registration import register
+
+        server = await ZKServer().start()
+        client = await ZKClient([server.address]).connect()
+        try:
+            nodes = await register(
+                client, {"domain": "loop.parity.test", "type": "host"},
+                admin_ip="10.9.9.9", hostname="loophost", settle_delay=0,
+            )
+            (data, st) = await client.get(nodes[0])
+            assert st.ephemeral_owner == client.session_id
+            assert b'"type":"host"' in data.replace(b" ", b"")
+        finally:
+            await client.close()
+            await server.stop()

@@ -389,6 +389,118 @@ class TestHeartbeat:
             await server.stop()
 
 
+class TestHeartbeatMany:
+    """The coalesced sweep (ISSUE 11 tentpole): per-group contract
+    identical to N solo heartbeats, one pipelined flush per attempt."""
+
+    async def test_per_group_outcomes_are_independent(self):
+        # A NO_NODE in one service's group must neither fail nor delay
+        # another's; ownership failures stay scoped to their group too.
+        server, client = await _pair()
+        other = await ZKClient([server.address]).connect()
+        try:
+            await client.create("/sweep-ok", b"", CreateFlag.EPHEMERAL)
+            await client.create("/sweep-ok2", b"")
+            await other.create("/sweep-foreign", b"", CreateFlag.EPHEMERAL)
+            fast = RetryPolicy(
+                max_attempts=2, initial_delay=0.01, max_delay=0.02
+            )
+            outcomes = await client.heartbeat_many(
+                [
+                    ["/sweep-ok", "/sweep-ok2"],
+                    ["/sweep-ok", "/sweep-missing"],
+                    ["/sweep-foreign"],
+                    [],
+                ],
+                retry=fast,
+            )
+            healthy, missing, foreign, empty = outcomes
+            assert healthy is None and empty is None
+            assert isinstance(missing, ZKError) and missing.name == "NO_NODE"
+            assert isinstance(foreign, OwnershipError)
+            assert foreign.owner == other.session_id
+        finally:
+            await other.close()
+            await client.close()
+            await server.stop()
+
+    async def test_one_flush_per_attempt_across_groups(self):
+        # The wire shape claim: all groups' EXISTS requests ride ONE
+        # corked write + one drain per attempt.
+        server, client = await _pair()
+        try:
+            paths = []
+            for i in range(12):
+                p = f"/co{i}"
+                await client.create(p, b"", CreateFlag.EPHEMERAL)
+                paths.append(p)
+            groups = [paths[i * 3 : (i + 1) * 3] for i in range(4)]
+            drains = {"n": 0}
+            orig_drain = client._writer.drain
+
+            async def counting_drain():
+                drains["n"] += 1
+                return await orig_drain()
+
+            client._writer.drain = counting_drain
+            assert await client.heartbeat_many(groups) == [None] * 4
+            assert drains["n"] == 1, (
+                f"coalesced sweep drained {drains['n']} times — the "
+                "groups did not share one pipelined flush"
+            )
+        finally:
+            await client.close()
+            await server.stop()
+
+    async def test_healthy_group_released_before_failing_groups_retry(self):
+        # on_outcome fires the moment a group's verdict is final: a
+        # healthy service must not wait out a failing sibling's backoff.
+        server, client = await _pair()
+        try:
+            await client.create("/early-ok", b"", CreateFlag.EPHEMERAL)
+            order = []
+            slow = RetryPolicy(
+                max_attempts=3, initial_delay=0.05, max_delay=0.05
+            )
+            import time as _time
+
+            t0 = _time.monotonic()
+            outcomes = await client.heartbeat_many(
+                [["/early-ok"], ["/early-missing"]],
+                retry=slow,
+                on_outcome=lambda i, err: order.append(
+                    (i, err, _time.monotonic() - t0)
+                ),
+            )
+            assert outcomes[0] is None
+            assert isinstance(outcomes[1], ZKError)
+            by_group = dict((i, t) for i, _, t in order)
+            # group 0 settled on attempt 1 (before any backoff sleep);
+            # group 1 needed the full schedule (2 sleeps of 50 ms)
+            assert by_group[0] < 0.04
+            assert by_group[1] >= 0.08
+        finally:
+            await client.close()
+            await server.stop()
+
+    async def test_delegating_heartbeat_is_contract_identical(self):
+        # heartbeat() is the one-group front of heartbeat_many: the
+        # bounded-retry NO_NODE shape and the success shape both hold.
+        server, client = await _pair()
+        try:
+            await client.create("/hb-front", b"", CreateFlag.EPHEMERAL)
+            await client.heartbeat(["/hb-front"])
+            fast = RetryPolicy(
+                max_attempts=2, initial_delay=0.01, max_delay=0.01
+            )
+            with pytest.raises(ZKError) as ei:
+                await client.heartbeat(["/hb-front", "/gone"], retry=fast)
+            assert ei.value.name == "NO_NODE"
+        finally:
+            await client.close()
+            await server.stop()
+
+
 #: rebirth tests want convergence in milliseconds, not the 1-90 s
 #: production envelope
 _FAST_RECONNECT = RetryPolicy(

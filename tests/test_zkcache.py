@@ -69,9 +69,9 @@ def _count_posts(zk):
     counter = {"n": 0}
     orig = zk._post
 
-    def wrapper(xid, op, body):
+    def wrapper(xid, op, body, *args, **kwargs):
         counter["n"] += 1
-        return orig(xid, op, body)
+        return orig(xid, op, body, *args, **kwargs)
 
     zk._post = wrapper
     return counter

@@ -405,6 +405,183 @@ def fault_id_drift(model: ProgramModel) -> Iterator[Finding]:
             )
 
 
+# -- bench-metric-drift --------------------------------------------------------
+
+BENCH_PATH = "bench.py"
+BENCH_HISTORY = "BENCH_HISTORY.json"
+PERF_DOC = "docs/PERF.md"
+
+#: bench metric names are snake_case tokens with at least one underscore
+#: (``heartbeat_ms_1000_znodes``, ``live_resolve_qps``) — the underscore
+#: requirement keeps single-word table cells out of the diff
+_BENCH_METRIC = re.compile(r"^[a-z][a-z0-9]*(_[a-z0-9]+)+$")
+
+#: gate directions a BENCH_METRICS entry may carry (None = unpinned)
+_BENCH_DIRECTIONS = ("lower", "higher")
+
+
+def _bench_declared(path: str):
+    """bench.py's module-level ``BENCH_METRICS`` dict literal as
+    ``{name: (direction-or-None, lineno)}``; None when the file is
+    missing/unparseable, ``{}`` when the declaration is absent."""
+    try:
+        with open(path, "r", encoding="utf-8") as fh:
+            tree = ast.parse(fh.read())
+    except (OSError, SyntaxError):
+        return None
+    for node in tree.body:
+        if not isinstance(node, ast.Assign):
+            continue
+        if not any(
+            isinstance(t, ast.Name) and t.id == "BENCH_METRICS"
+            for t in node.targets
+        ):
+            continue
+        if not isinstance(node.value, ast.Dict):
+            continue
+        out = {}
+        for key, val in zip(node.value.keys, node.value.values):
+            if (
+                isinstance(key, ast.Constant)
+                and isinstance(key.value, str)
+                and isinstance(val, ast.Constant)
+                and (val.value is None or val.value in _BENCH_DIRECTIONS)
+            ):
+                out[key.value] = (val.value, key.lineno)
+        return out
+    return {}
+
+
+def _perf_doc_metric_cells(lines):
+    """Metric-name tokens from docs/PERF.md's metric tables, as
+    ``{name: lineno}``.  Only tables whose header's first cell contains
+    the word "metric" count — prose and code-identifier tables stay out
+    of the diff.  A first cell that IS a metric-shaped token is always a
+    data row, never a header — ``phantom_metric_ms`` contains the
+    substring "metric" but must be scanned, not skipped."""
+    out: dict = {}
+    in_metric_table = False
+    for i, line in enumerate(lines, start=1):
+        stripped = line.strip()
+        if not stripped.startswith("|"):
+            in_metric_table = False
+            continue
+        cells = [c.strip() for c in stripped.strip("|").split("|")]
+        if not cells:
+            continue
+        first = cells[0].strip("`").strip()
+        if _BENCH_METRIC.match(first):
+            if in_metric_table:
+                out.setdefault(first, i)
+            continue
+        if re.search(r"\bmetric\b", first.lower()):
+            in_metric_table = True  # a header labels, it never cites
+        # separator rows and prose-labeled data rows change nothing
+    return out
+
+
+@rule(
+    "bench-metric-drift",
+    "bench metric names drift between bench.py's BENCH_METRICS, "
+    "BENCH_HISTORY.json's directions, and docs/PERF.md's tables",
+    scope="program",
+)
+def bench_metric_drift(model: ProgramModel) -> Iterator[Finding]:
+    # Bench metric names are a contract exactly like fault ids and
+    # metric names: BENCH_HISTORY.json keys every round by them, the
+    # generated baseline gates by them, and docs/PERF.md's tables cite
+    # them.  A metric renamed in bench.py silently orphans its history
+    # pin (the gate's "missing from bench output" only fires at bench
+    # runtime, on the driver box) and its doc rows — so the three
+    # surfaces are diffed here, statically, on every `make check`.
+    # bench.py's declared map is the code-side truth (gate() enforces at
+    # runtime that every emitted metric is declared in it).
+    root = model.package_root()
+    if root is None:
+        return
+    import json as _json
+
+    bench_path = os.path.join(root, BENCH_PATH)
+    if not os.path.exists(bench_path):
+        return  # no bench in this program: nothing to diff
+    declared = _bench_declared(bench_path)
+    if declared is None:
+        return  # unparseable: the syntax-error finding owns this
+    history_path = os.path.join(root, BENCH_HISTORY)
+    directions: dict = {}
+    have_history = os.path.exists(history_path)
+    if have_history:
+        try:
+            with open(history_path, "r", encoding="utf-8") as fh:
+                directions = _json.load(fh).get("directions", {})
+        except (OSError, ValueError):
+            yield Finding(
+                "bench-metric-drift",
+                BENCH_HISTORY,
+                0,
+                f"{BENCH_HISTORY} exists but is not readable JSON — the "
+                "baseline gate is generated from it",
+            )
+            return
+    if not declared and (directions or have_history):
+        yield Finding(
+            "bench-metric-drift",
+            BENCH_PATH,
+            0,
+            f"{BENCH_PATH} declares no BENCH_METRICS literal map — the "
+            "metric-name contract cannot be checked",
+        )
+        return
+    for name, direction in sorted(directions.items()):
+        spec = declared.get(name)
+        if spec is None:
+            yield Finding(
+                "bench-metric-drift",
+                BENCH_HISTORY,
+                0,
+                f"metric '{name}' is pinned in {BENCH_HISTORY} but "
+                f"{BENCH_PATH}'s BENCH_METRICS does not declare it "
+                "(renamed or removed measurement? the gate would report "
+                "it missing on every run)",
+            )
+        elif spec[0] != direction:
+            yield Finding(
+                "bench-metric-drift",
+                BENCH_PATH,
+                spec[1],
+                f"metric '{name}' is declared '{spec[0]}' in "
+                f"BENCH_METRICS but {BENCH_HISTORY} pins direction "
+                f"'{direction}'",
+            )
+    for name, (direction, lineno) in sorted(declared.items()):
+        if direction is not None and name not in directions:
+            yield Finding(
+                "bench-metric-drift",
+                BENCH_PATH,
+                lineno,
+                f"metric '{name}' is declared gate-direction "
+                f"'{direction}' but {BENCH_HISTORY} has no directions "
+                "entry for it (record a round and repin, or declare it "
+                "None/unpinned)",
+            )
+    lines = read_doc_lines(os.path.join(root, *PERF_DOC.split("/")))
+    if lines is None:
+        return  # no perf doc: its leg just doesn't apply
+    known = set(declared) | set(directions)
+    if not known:
+        return
+    for name, lineno in sorted(_perf_doc_metric_cells(lines).items()):
+        if name not in known:
+            yield Finding(
+                "bench-metric-drift",
+                PERF_DOC,
+                lineno,
+                f"{PERF_DOC} metric table cites '{name}', which neither "
+                f"{BENCH_PATH}'s BENCH_METRICS nor {BENCH_HISTORY} "
+                "knows (renamed metric orphaning its doc row?)",
+            )
+
+
 # -- span-name-drift -----------------------------------------------------------
 
 OBSERVABILITY_DOC = "docs/OBSERVABILITY.md"
