@@ -7,7 +7,7 @@ NAME = registrar
 RELEASE_TARBALL = $(NAME)-release.tar.gz
 RELSTAGEDIR = /tmp/$(NAME)-release
 
-.PHONY: all check check-core test test-jax chaos restart-e2e bench bench-cached profile slo slo-quick release publish clean
+.PHONY: all check check-core test test-jax chaos restart-e2e bench bench-cached bench-sharded profile slo slo-quick release publish clean
 
 all: check test
 
@@ -34,7 +34,7 @@ check-core:
 	    registrar_tpu.testing.server, registrar_tpu.testing.netem, \
 	    registrar_tpu.config, \
 	    registrar_tpu.tools.zkcli, registrar_tpu.binderview, \
-	    registrar_tpu.zkcache, registrar_tpu.metrics"
+	    registrar_tpu.zkcache, registrar_tpu.metrics, registrar_tpu.shard"
 
 # Hermetic suite: jax-marked tests are deselected via pyproject addopts,
 # because jax backend init can take minutes in some environments.  (In the
@@ -110,6 +110,17 @@ slo-quick:
 bench-cached:
 	$(PYTHON) -m pytest tests/test_zkcache.py -x -q
 	$(PYTHON) bench.py --cached-only
+
+# Sharded serve tier slice (ISSUE 12): the shard suite (ring stability,
+# parity, resharding, crash supervision), then the scaling matrix +
+# warm-handoff measurement with its in-process zero-error assert (and,
+# on >=4 cores, the >=3x 4-vs-1 scaling bound).  The CI bench smoke leg
+# runs this under BENCH_SMOKE=1 (reduced scale) because the gated bench
+# run reports the sharded metrics as null there — multi-process scaling
+# on a shared CI core gates nothing real.
+bench-sharded:
+	$(PYTHON) -m pytest tests/test_shard.py -x -q
+	$(PYTHON) bench.py --sharded-only
 
 # Release tarball rooted at $(PREFIX) (the reference roots its tarball
 # at /opt/smartdc/registrar, Makefile:70-95).  The SMF manifest is

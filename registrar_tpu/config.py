@@ -50,7 +50,12 @@ etc/config.coal.json)::
         "stateFile": "/var/run/registrar/state.json",  # downtime restarts;
         "mode": "handoff",                     #  "handoff" hands the live ZK
         "drainGraceSeconds": 0                 #  session to the successor,
-      }                                        #  "drain" unregisters + waits
+      },                                       #  "drain" unregisters + waits
+      "serve": {                               # opt-in (ISSUE 12): the
+        "shards": 4,                           #  namespace-sharded resolve
+        "socketPath": "/var/run/registrar/resolve.sock",  # tier for `zkcli
+        "attachSpread": "spread"               #  serve-sharded`; the daemon
+      }                                        #  ignores the block entirely
     }
 
 All reference keys are camelCase and all durations are milliseconds; this
@@ -148,6 +153,24 @@ class RestartConfig:
 
 
 @dataclass
+class ServeConfig:
+    """The ``serve`` block (ISSUE 12): the namespace-sharded resolve
+    tier (:mod:`registrar_tpu.shard`), run standalone by ``zkcli
+    serve-sharded -f config``.  ``shards`` worker processes each own a
+    consistent-hash slice of the domain space; ``socketPath`` is the
+    router's front unix socket (worker sockets are suffixed onto it);
+    ``attachSpread`` is the watch-load placement hint handed to each
+    worker's ZK client (``"spread"`` → worker k of n gets
+    ``spread:k-of-n``; ``"follower"`` / ``"any"`` pass through).  The
+    daemon itself never resolves and ignores the block — absent block =
+    today's in-process behavior, reference parity untouched."""
+
+    shards: int
+    socket_path: str
+    attach_spread: str = "spread"
+
+
+@dataclass
 class ObservabilityConfig:
     """The ``observability`` block (ISSUE 8): operation tracing.
 
@@ -186,7 +209,7 @@ KNOWN_TOP_LEVEL_KEYS = frozenset(
         "adminIp", "zookeeper", "registration", "healthCheck", "logLevel",
         "maxAttempts", "repairHeartbeatMiss", "metrics",
         "surviveSessionExpiry", "maxSessionRebirths", "reconcile", "cache",
-        "restart", "observability",
+        "restart", "observability", "serve",
     }
 )
 
@@ -217,6 +240,9 @@ class Config:
     #: opt-in operation tracing (ISSUE 8; None = no spans, no flight
     #: recorder, no trace-correlated log fields — reference parity)
     observability: Optional[ObservabilityConfig] = None
+    #: opt-in namespace-sharded resolve tier for zkcli serve-sharded
+    #: (ISSUE 12; None = no tier — the daemon ignores it either way)
+    serve: Optional[ServeConfig] = None
     #: unrecognized top-level keys (ignored, like the reference — but
     #: surfaced so the daemon can warn about probable typos)
     unknown_keys: Tuple[str, ...] = ()
@@ -516,6 +542,37 @@ def parse_config(raw: Mapping[str, Any]) -> Config:
             dump_path=dump_path,
         )
 
+    serve = None
+    serve_raw = raw.get("serve")
+    if serve_raw is not None:
+        if not isinstance(serve_raw, Mapping):
+            raise ConfigError("config.serve must be an object")
+        shards = serve_raw.get("shards")
+        if (
+            not isinstance(shards, int)
+            or isinstance(shards, bool)
+            or shards < 1
+        ):
+            raise ConfigError(
+                "config.serve.shards must be a positive integer"
+            )
+        socket_path = serve_raw.get("socketPath")
+        if not isinstance(socket_path, str) or not socket_path:
+            raise ConfigError(
+                "config.serve.socketPath must be a non-empty path"
+            )
+        attach_spread = serve_raw.get("attachSpread", "spread")
+        if attach_spread not in ("any", "follower", "spread"):
+            raise ConfigError(
+                'config.serve.attachSpread must be "any", "follower", '
+                'or "spread"'
+            )
+        serve = ServeConfig(
+            shards=shards,
+            socket_path=socket_path,
+            attach_spread=attach_spread,
+        )
+
     metrics = None
     metrics_raw = raw.get("metrics")
     if metrics_raw is not None:
@@ -549,6 +606,7 @@ def parse_config(raw: Mapping[str, Any]) -> Config:
         cache=cache,
         restart=restart,
         observability=observability,
+        serve=serve,
         unknown_keys=tuple(
             sorted(set(raw) - KNOWN_TOP_LEVEL_KEYS)
         ),

@@ -129,6 +129,12 @@ class _Metric:
     def value(self, labels: Optional[Dict[str, str]] = None) -> float:
         return self._values.get(self._key(labels), 0.0)
 
+    def remove(self, labels: Optional[Dict[str, str]] = None) -> None:
+        """Drop one label set's series entirely (topology change: a
+        resharded-away shard id must stop rendering, not freeze at its
+        last value — a phantom ``up 1`` defeats the health signal)."""
+        self._values.pop(self._key(labels), None)
+
     def render(self) -> List[str]:
         lines = [
             f"# HELP {self.name} {_escape_help(self.help)}",
@@ -172,6 +178,20 @@ class Counter(_Metric):
 
     def set_function(self, fn: Callable[[], float]) -> None:
         self.fn = fn
+
+    def set_total(
+        self, value: float, labels: Optional[Dict[str, str]] = None
+    ) -> None:
+        """Install a polled cumulative total for one label set — the
+        labeled counterpart of :meth:`set_function`, for counters whose
+        truth lives in another process (the shard router polls each
+        worker's resolves_total).  The caller owns monotonicity (the
+        router banks a crashed worker's count before its successor
+        restarts from zero); a stale lower value is ignored rather than
+        rendered as a counter going backwards."""
+        key = self._key(labels)
+        if value >= self._values.get(key, 0.0):
+            self._values[key] = float(value)
 
     def render(self) -> List[str]:
         if self.fn is not None:
@@ -797,6 +817,100 @@ def instrument_slo(harness, registry: Optional[MetricsRegistry] = None) -> Metri
         "outage",
         lambda fault, seconds: outage.inc(seconds, labels={"fault": fault}),
     )
+    return reg
+
+
+def instrument_shards(
+    router, registry: Optional[MetricsRegistry] = None
+) -> MetricsRegistry:
+    """Expose the sharded serve tier's rollup (ISSUE 12).
+
+    ``router`` is a :class:`registrar_tpu.shard.ShardRouter`: its
+    ``poll`` event carries each worker's polled status (resolves, cache
+    entries), ``respawn`` fires when a crashed worker is detected, and
+    ``reshard`` when the ring changes shape.  Per-shard label sets are
+    pre-seeded for the router's current shard ids; counters stay
+    monotonic across worker crashes because the router banks a dead
+    incarnation's totals (``Counter.set_total``).
+    """
+    reg = registry if registry is not None else MetricsRegistry()
+    resolves = reg.counter(
+        "registrar_shard_resolves_total",
+        "Resolves served, by shard (rolled up from worker status polls; "
+        "monotonic across worker respawns)",
+    )
+    entries = reg.gauge(
+        "registrar_shard_entries",
+        "Watch-coherent cache entries currently held, by shard",
+    )
+    up = reg.gauge(
+        "registrar_shard_up",
+        "1 while the shard's worker process is serving, 0 while it is "
+        "dead or respawning",
+    )
+    respawns = reg.counter(
+        "registrar_shard_respawns_total",
+        "Worker crashes detected (each is followed by a respawn while "
+        "sibling shards keep serving), by shard",
+    )
+    reshards = reg.counter(
+        "registrar_shard_reshards_total",
+        "Ring shape changes (SIGHUP shard-count change with warm "
+        "handoff)",
+    )
+    reshards.inc(0)
+    seeded: set = set()
+
+    def seed(sid) -> None:
+        labels = {"shard": str(sid)}
+        resolves.inc(0, labels=labels)
+        entries.set(0.0, labels=labels)
+        up.set(0.0, labels=labels)
+        respawns.inc(0, labels=labels)
+        seeded.add(sid)
+
+    for sid in getattr(router.ring, "shard_ids", ()):
+        seed(sid)
+
+    def resync_shards(*_args) -> None:
+        # A reshard changes the label-set topology: new shards get
+        # pre-seeded series, and a resharded-away shard's GAUGES are
+        # dropped (a phantom up/entries frozen at its last value would
+        # misreport a nonexistent shard as healthy forever).  Its
+        # counters stay — they are history, not health.
+        current = set(router.ring.shard_ids)
+        for sid in current - seeded:
+            seed(sid)
+        for sid in seeded - current:
+            entries.remove({"shard": str(sid)})
+            up.remove({"shard": str(sid)})
+            seeded.discard(sid)
+
+    def on_poll(statuses) -> None:
+        down = set(router.shards_down())
+        for sid in router.ring.shard_ids:
+            up.set(0.0 if sid in down else 1.0,
+                   labels={"shard": str(sid)})
+        for sid, status in statuses:
+            labels = {"shard": str(sid)}
+            resolves.set_total(
+                router.shard_resolves_total(sid), labels=labels
+            )
+            entries.set(float(status.get("entries", 0)), labels=labels)
+
+    router.on("poll", on_poll)
+    router.on(
+        "respawn",
+        lambda sid: (
+            respawns.inc(labels={"shard": str(sid)}),
+            up.set(0.0, labels={"shard": str(sid)}),
+        ),
+    )
+    def on_reshard(_old, _new, _moved) -> None:
+        reshards.inc()
+        resync_shards()
+
+    router.on("reshard", on_reshard)
     return reg
 
 

@@ -86,6 +86,9 @@ FAULT_IDS = (
     "quorum-loss",
     "rolling-upgrade",
     "partition-minority",
+    # sharded serve tier fault classes (ISSUE 12; need shards= > 0)
+    "shard-kill",
+    "reshard-wave",
 )
 
 #: nines(1.0) would be infinite; the cap keeps a flawless short trace
@@ -393,6 +396,7 @@ class SLOHarness(EventEmitter):
         tracer: Optional[trace_mod.Tracer] = None,
         ensemble: int = 1,
         election_ms: float = 150.0,
+        shards: int = 0,
     ):
         """``ensemble`` (ISSUE 10): > 1 runs the fleet against an
         N-member :class:`ZKEnsemble` with a real leader/quorum protocol
@@ -401,7 +405,18 @@ class SLOHarness(EventEmitter):
         order, and the ensemble fault classes (leader-kill, quorum-loss,
         rolling-upgrade, partition-minority) become injectable.
         ``election_ms`` sizes the leader-election window the failover
-        MTTR must ride through."""
+        MTTR must ride through.
+
+        ``shards`` (ISSUE 12): > 0 additionally runs a sharded serve
+        tier (:class:`registrar_tpu.shard.ShardRouter` + worker
+        processes) against the same backends and gives the prober a
+        third leg: a set of static **slice-probe domains** chosen to
+        cover every shard's slice is polled through the tier each
+        sample, so a shard's outage (and only that shard's) shows up in
+        the availability math.  The shard fault classes (shard-kill,
+        reshard-wave) become injectable; with ``repair=False`` the
+        router's crash→respawn supervision is withheld (the recovery
+        action under test)."""
         super().__init__()
         if members < 2:
             raise ValueError("a fleet needs at least 2 members")
@@ -432,6 +447,19 @@ class SLOHarness(EventEmitter):
         self.live_client: Optional[ZKClient] = None
         self.cache_client: Optional[ZKClient] = None
         self.cache: Optional[ZKCache] = None
+        #: sharded serve tier (ISSUE 12; shards > 0)
+        self.n_shards = shards
+        self.router = None
+        self.shard_client = None
+        self._slice_client: Optional[ZKClient] = None
+        self._shard_dir: Optional[str] = None
+        #: slice-probe domain -> its single host's admin ip (the
+        #: expected A answer; static, never touched by fleet scenarios)
+        self.slice_expected: Dict[str, str] = {}
+        #: per-slice-domain shard-leg probe failures (the sibling-
+        #: never-blips assertions diff snapshots of this)
+        self.slice_errors: Dict[str, int] = {}
+        self.shard_probes = 0
 
         self.probes: List[Probe] = []
         self.faults: List[FaultEvent] = []
@@ -530,9 +558,87 @@ class SLOHarness(EventEmitter):
         self.live_client.tracer = self.tracer
         self.cache = ZKCache(self.cache_client)
         self.cache.tracer = self.tracer
+        if self.n_shards > 0:
+            await self._start_shard_tier()
         self._started_at = self.now()
         spawn_owned(self._probe_loop(), self._tasks)
         return self
+
+    async def _start_shard_tier(self) -> None:
+        """Stand up the ISSUE-12 serve tier: a router + worker
+        processes against the (unproxied) backends, plus one static
+        single-host slice-probe domain per shard slice — chosen off the
+        deterministic ring, so every shard's slice is observable and a
+        killed shard's outage is attributable to exactly its slice."""
+        import os
+        import tempfile
+
+        from registrar_tpu.shard import (
+            HashRing, ShardClient, ShardRouter,
+        )
+
+        # Deterministic slice coverage: walk candidate names until every
+        # shard owns at least one (the ring is a pure function of the
+        # shard ids, so this converges the same way in every run).
+        ring = HashRing(range(self.n_shards))
+        chosen: List[str] = []
+        covered: set = set()
+        for i in range(256):
+            name = f"slice{i}.shard.slo.us"
+            owner = ring.owner(name)
+            if owner not in covered or len(chosen) < self.n_shards + 1:
+                chosen.append(name)
+                covered.add(owner)
+            if len(covered) == self.n_shards and len(chosen) >= (
+                self.n_shards + 1
+            ):
+                break
+        # This client OWNS the slice hosts' ephemerals, so it must
+        # outlive the whole run (closing it would delete them).
+        self._slice_client = await self._probe_client().connect()
+        for i, name in enumerate(chosen):
+            ip = f"10.8.0.{i}"
+            await register(
+                self._slice_client,
+                {
+                    "domain": name,
+                    "type": "load_balancer",
+                    "service": {
+                        "type": "service",
+                        "service": {
+                            "srvce": "_http", "proto": "_tcp",
+                            "port": 80,
+                        },
+                    },
+                },
+                admin_ip=ip, hostname=f"slice{i}", settle_delay=0,
+            )
+            self.slice_expected[name] = ip
+            self.slice_errors[name] = 0
+        self._shard_dir = tempfile.mkdtemp(prefix="sloshard")
+        self.router = ShardRouter(
+            self._zk_addresses(),
+            self.n_shards,
+            os.path.join(self._shard_dir, "resolve.sock"),
+            attach_spread="spread" if self.ensemble is not None else "any",
+            timeout_ms=self.session_timeout_ms,
+            poll_interval_s=0.5,
+            # Worker disconnect/degrade warnings are the simulator
+            # working as intended, same stance as tools/slo.py takes
+            # for the fleet's own clients (SLO_VERBOSE restores them).
+            worker_log_level=(
+                None if os.environ.get("SLO_VERBOSE") == "1" else "ERROR"
+            ),
+        )
+        # With repair withheld, a crashed worker stays dead — the
+        # respawn IS the recovery action the detection proof disables.
+        self.router.respawn_enabled = self.repair
+        await self.router.start()
+        self.shard_client = await ShardClient(
+            self.router.socket_path
+        ).connect()
+        for name in self.slice_expected:
+            await self.shard_client.resolve(name, "A")
 
     async def stop(self) -> None:
         self._stop_probing.set()
@@ -543,7 +649,17 @@ class SLOHarness(EventEmitter):
         self._tasks.clear()
         if self.cache is not None:
             self.cache.close()
-        for client in (self.live_client, self.cache_client):
+        if self.shard_client is not None:
+            await self.shard_client.close()
+        if self.router is not None:
+            await self.router.stop()
+        if self._shard_dir is not None:
+            import shutil
+
+            shutil.rmtree(self._shard_dir, ignore_errors=True)
+        for client in (
+            self.live_client, self.cache_client, self._slice_client
+        ):
             if client is not None and not client.closed:
                 await client.close()
         for member in self.members:
@@ -640,10 +756,44 @@ class SLOHarness(EventEmitter):
                 except Exception:  # noqa: BLE001 - cached failure counts as stale
                     self.cached_probes += 1
                     self.stale_probes += 1
+                if self.shard_client is not None:
+                    shard_ok = await self._probe_shards()
+                    span.set_attr("shard_ok", shard_ok)
+                    ok = ok and shard_ok
         self.probes.append(
             Probe(t, ok, len(expected - live_set), span.trace_id)
         )
         self.emit("probe", "ok" if ok else "fail")
+
+    async def _probe_shards(self) -> bool:
+        """The sharded-tier probe leg: every slice-probe domain must
+        answer its static host through the tier.  Per-domain failures
+        are counted (the sibling-never-blips assertions), and any
+        failure fails the sample — a shard's slice being down IS fleet
+        downtime once real DNS fronts this tier."""
+        async def one(name: str, expected_ip: str) -> bool:
+            try:
+                res = await self.shard_client.resolve(name, "A")
+                return {a.data for a in res.answers} == {expected_ip}
+            except asyncio.CancelledError:
+                raise
+            except Exception:  # noqa: BLE001 - a failed read IS a failed probe
+                return False
+
+        # Concurrently — the slices are independent by construction, and
+        # a sequential sweep would quantize every outage window up by
+        # the whole sweep's latency.
+        names = list(self.slice_expected)
+        results = await asyncio.gather(
+            *(one(n, self.slice_expected[n]) for n in names)
+        )
+        shard_ok = True
+        for name, good in zip(names, results):
+            if not good:
+                self.slice_errors[name] += 1
+                shard_ok = False
+        self.shard_probes += 1
+        return shard_ok
 
     async def wait_healthy(self, timeout: float = 8.0) -> None:
         """Block until the prober sees a full fleet again (scenario
@@ -727,16 +877,23 @@ class SLOHarness(EventEmitter):
             "quorum-loss": self._scenario_quorum_loss,
             "rolling-upgrade": self._scenario_rolling_upgrade,
             "partition-minority": self._scenario_partition_minority,
+            "shard-kill": self._scenario_shard_kill,
+            "reshard-wave": self._scenario_reshard_wave,
         }
         ensemble_only = {
             "leader-kill", "quorum-loss", "rolling-upgrade",
             "partition-minority",
         }
+        sharded_only = {"shard-kill", "reshard-wave"}
         if fault_id not in methods:
             raise ValueError(f"unknown scenario {fault_id!r}")
         if fault_id in ensemble_only and self.ensemble is None:
             raise ValueError(
                 f"scenario {fault_id!r} needs ensemble= > 1 (ISSUE 10)"
+            )
+        if fault_id in sharded_only and self.router is None:
+            raise ValueError(
+                f"scenario {fault_id!r} needs shards= > 0 (ISSUE 12)"
             )
         self.scenario = fault_id
         started = self.now()
@@ -967,6 +1124,124 @@ class SLOHarness(EventEmitter):
         self.clear(event)
         await self.wait_healthy()
 
+    # -- sharded serve tier scenarios (ISSUE 12; need shards= > 0) -----------
+
+    def _slice_domains_of(self, shard_id: int) -> List[str]:
+        return [
+            name
+            for name in self.slice_expected
+            if self.router.ring.owner(name) == shard_id
+        ]
+
+    async def _wait_slices_healthy(
+        self,
+        domains: List[str],
+        respawns_before: Optional[int] = None,
+        timeout: float = 12.0,
+    ) -> None:
+        """Block until ``domains`` answer through the tier again — the
+        shard scenarios' reconvergence barrier.  With
+        ``respawns_before`` given, first wait for the router's respawn
+        to land (a kill propagates asynchronously: clearing the fault
+        on a probe round that simply raced ahead of the supervisor
+        would close the attribution window before the outage even
+        started)."""
+        deadline = self.now() + timeout
+        while (
+            respawns_before is not None
+            and self.router.respawns_total() <= respawns_before
+        ):
+            if self.now() >= deadline:
+                raise RuntimeError("shard respawn never happened")
+            await asyncio.sleep(0.01)
+        while True:
+            healthy = True
+            for name in domains:
+                try:
+                    res = await self.shard_client.resolve(name, "A")
+                    if {a.data for a in res.answers} != {
+                        self.slice_expected[name]
+                    }:
+                        healthy = False
+                except Exception:  # noqa: BLE001 - still recovering
+                    healthy = False
+            if healthy:
+                return
+            if self.now() >= deadline:
+                raise RuntimeError(
+                    "sharded tier never reconverged "
+                    f"(slice errors: {self.slice_errors})"
+                )
+            await asyncio.sleep(self.probe_interval)
+
+    async def _scenario_shard_kill(self, kills: int = 1) -> None:
+        """SIGKILL one shard worker: its slice fails until the router's
+        respawn + warm refill lands (the MTTR the probes measure), and —
+        asserted, not just hoped — sibling shards' slices never blip.
+        With repair withheld the respawn never comes and the slice
+        stays dark (the detection proof's nines drop)."""
+        for _ in range(kills):
+            victim = self.router.ring.owner(
+                next(iter(self.slice_expected))
+            )
+            victims = self._slice_domains_of(victim)
+            siblings = [
+                name
+                for name in self.slice_expected
+                if name not in victims
+            ]
+            sibling_errs = {
+                name: self.slice_errors[name] for name in siblings
+            }
+            respawns_before = self.router.respawns_total()
+            event = self.inject("shard-kill", member=victim)
+            self.router.kill_worker(victim)
+            if not self.repair:
+                return  # the worker stays dead (respawn withheld)
+            await self._wait_slices_healthy(victims, respawns_before)
+            self.clear(event)
+            await self.wait_healthy()
+            blipped = {
+                name: self.slice_errors[name] - before
+                for name, before in sibling_errs.items()
+                if self.slice_errors[name] != before
+            }
+            if blipped:
+                raise RuntimeError(
+                    f"sibling slices blipped during shard-kill: {blipped}"
+                )
+
+    async def _scenario_reshard_wave(self, hold_s: float = 0.15) -> None:
+        """Reshard the tier up one shard and back down mid-traffic: the
+        warm handoff + ring-flip ordering must keep every slice
+        answering — ZERO shard-probe errors across the whole wave is
+        asserted (this is the zero-downtime scenario; it never shows up
+        in MTTD/MTTR because a correct reshard is never detected as an
+        outage)."""
+        errs_before = dict(self.slice_errors)
+        event = self.inject("reshard-wave")
+        if not self.repair:
+            # The broken run: earlier withheld recoveries leave dead
+            # slices whose steady-state errors are not this wave's —
+            # there is nothing honest to reshard or assert here.
+            await asyncio.sleep(hold_s)
+            return
+        await self.router.reshard(self.n_shards + 1)
+        await asyncio.sleep(hold_s)
+        await self.router.reshard(self.n_shards)
+        await asyncio.sleep(hold_s)
+        self.clear(event)
+        await self.wait_healthy()
+        blipped = {
+            name: self.slice_errors[name] - before
+            for name, before in errs_before.items()
+            if self.slice_errors[name] != before
+        }
+        if blipped:
+            raise RuntimeError(
+                f"reshard-wave was not zero-error: {blipped}"
+            )
+
     # -- the report ---------------------------------------------------------
 
     async def settle(self, seconds: float = 0.2) -> None:
@@ -1072,6 +1347,22 @@ class SLOHarness(EventEmitter):
                     else 0
                 ),
             },
+            "shards": {
+                "shards": self.n_shards,
+                "slice_domains": len(self.slice_expected),
+                "slice_probes": self.shard_probes,
+                "slice_errors": sum(self.slice_errors.values()),
+                "respawns": (
+                    self.router.respawns_total()
+                    if self.router is not None
+                    else 0
+                ),
+                "reshards": (
+                    self.router.reshards
+                    if self.router is not None
+                    else 0
+                ),
+            },
             "probe_interval_ms": round(self.probe_interval * 1000.0, 1),
             "duration_s": round(end - self._started_at, 3),
             "probes": {
@@ -1111,6 +1402,12 @@ TRACES: Dict[str, Dict[str, Any]] = {
         # scenario's envelope lands in SLO_HISTORY.json.
         "ensemble": 3,
         "election_ms": 120.0,
+        # The quick trace also fronts the backends with a 2-shard serve
+        # tier (ISSUE 12): every scenario's probes now include the
+        # sharded resolve path, and the shard fault classes land in the
+        # gated envelope (shard-kill measured; reshard-wave asserted
+        # zero-error, so it never owns an outage window).
+        "shards": 2,
         "scenarios": (
             ("deploy-wave", {"wave": 2, "down_s": 0.1}),
             ("crash-loop", {"crashes": 2, "restart_delay": 0.12}),
@@ -1121,6 +1418,8 @@ TRACES: Dict[str, Dict[str, Any]] = {
             ("rolling-upgrade", {"pause_s": 0.15}),
             ("partition-minority", {"hold_s": 0.4}),
             ("quorum-loss", {"hold_s": 0.4}),
+            ("shard-kill", {"kills": 1}),
+            ("reshard-wave", {"hold_s": 0.15}),
         ),
     },
     "full": {
@@ -1130,6 +1429,7 @@ TRACES: Dict[str, Dict[str, Any]] = {
         "pause_s": 1.5,
         "ensemble": 3,
         "election_ms": 150.0,
+        "shards": 3,
         "scenarios": (
             ("deploy-wave", {"wave": 6, "down_s": 0.15}),
             ("crash-loop", {"crashes": 4, "restart_delay": 0.2}),
@@ -1140,6 +1440,8 @@ TRACES: Dict[str, Dict[str, Any]] = {
             ("rolling-upgrade", {"pause_s": 0.3}),
             ("partition-minority", {"hold_s": 0.8}),
             ("quorum-loss", {"hold_s": 0.8}),
+            ("shard-kill", {"kills": 2}),
+            ("reshard-wave", {"hold_s": 0.3}),
             ("deploy-wave", {"wave": 6, "down_s": 0.15}),
             ("expiry-storm", {"victims": 5, "restart_delay": 0.2}),
         ),
@@ -1167,6 +1469,7 @@ async def run_trace(
         repair=repair,
         ensemble=params.get("ensemble", 1),
         election_ms=params.get("election_ms", 150.0),
+        shards=params.get("shards", 0),
     )
     await harness.start()
     try:

@@ -792,12 +792,42 @@ async def _cmd_status(args) -> int:
         bits = []
         if uptime is not None:
             bits.append(f"up {uptime}s")
-        for kind in ("session", "registration", "health"):
+        for kind in ("session", "registration", "health", "serve"):
             entry = transitions.get(kind)
             if entry and entry.get("at") is not None:
                 age = max(0.0, round(time_mod.time() - entry["at"], 1))
                 bits.append(f"{kind} -> {entry.get('state')} {age}s ago")
         print(f"zkcli: status: {'; '.join(bits)}", file=sys.stderr)
+    if "shards" in snapshot:
+        # The sharded serve tier's router (ISSUE 12): the snapshot is a
+        # per-shard rollup, not a single daemon's session — degraded is
+        # any shard down (its slice is failing until the respawn lands).
+        shards = snapshot.get("shards") or {}
+        for sid, info in sorted(shards.items(), key=lambda kv: int(kv[0])):
+            sess = info.get("session") or {}
+            state = "up" if info.get("up") else "DOWN"
+            ro = " ro" if sess.get("readOnly") else ""
+            print(
+                f"zkcli: status: shard {sid} {state} "
+                f"session={sess.get('id')}@{sess.get('server')}{ro} "
+                f"entries={info.get('entries')} "
+                f"resolves={info.get('resolves_total')} "
+                f"lagMs={info.get('coherence_lag_ms_last')} "
+                f"respawns={info.get('respawns')}",
+                file=sys.stderr,
+            )
+        problems = []
+        for sid in snapshot.get("shards_down") or []:
+            problems.append(f"shard {sid} down")
+        for sid, info in sorted(shards.items(), key=lambda kv: int(kv[0])):
+            if info.get("up") and not info.get("authoritative"):
+                problems.append(f"shard {sid} degraded (live reads)")
+        if problems:
+            print(f"zkcli: status: DEGRADED: {'; '.join(problems)}",
+                  file=sys.stderr)
+            return 1
+        print("zkcli: status: healthy", file=sys.stderr)
+        return 0
     session = snapshot.get("session") or {}
     registration = snapshot.get("registration") or {}
     health = snapshot.get("health") or {}
@@ -1093,6 +1123,153 @@ async def _cmd_serve_view(args) -> int:
         await zk.close()
 
 
+async def _cmd_serve_sharded(args) -> int:
+    """Run the namespace-sharded resolve tier standalone (ISSUE 12).
+
+    Per the config's ``serve`` block: spawns ``serve.shards`` worker
+    processes (each its own event loop + ZooKeeper session + watch-
+    coherent cache, watch load spread per ``serve.attachSpread``),
+    supervises them (crash → respawn, siblings keep serving), and
+    answers the length-prefixed resolve protocol on
+    ``serve.socketPath``.  SIGHUP re-reads the config and **reshards
+    in place** — a shard-count change moves only ~K/N warm domains
+    (consistent hashing) and every moving domain is pre-warmed by its
+    new owner before the ring flips, so resolves never error and the
+    tier never cold-starts.  With a ``metrics`` block, serves
+    ``GET /metrics`` (``registrar_shard_*``) and the per-shard
+    ``GET /status`` rollup on the configured listener.  ``--duration``
+    bounds the run (0 = until SIGTERM/^C).
+    """
+    import signal as signal_mod
+
+    from registrar_tpu import metrics as metrics_mod
+    from registrar_tpu.config import ConfigError, load_config
+    from registrar_tpu.shard import ShardRouter
+
+    try:
+        cfg = load_config(args.file)
+    except ConfigError as e:
+        print(f"zkcli: serve-sharded: {e}", file=sys.stderr)
+        return 2
+    if cfg.serve is None:
+        print(
+            f"zkcli: serve-sharded: {args.file} has no `serve` block "
+            "(serve: {shards, socketPath, attachSpread})",
+            file=sys.stderr,
+        )
+        return 2
+    router = ShardRouter(
+        cfg.zookeeper.servers,
+        cfg.serve.shards,
+        cfg.serve.socket_path,
+        attach_spread=cfg.serve.attach_spread,
+        chroot=cfg.zookeeper.chroot,
+        max_entries=cfg.cache.max_entries if cfg.cache is not None else None,
+        timeout_ms=cfg.zookeeper.timeout_ms,
+        connect_timeout_ms=cfg.zookeeper.connect_timeout_ms,
+        request_timeout_ms=cfg.zookeeper.request_timeout_ms,
+    )
+    try:
+        await router.start()
+    except Exception as e:  # noqa: BLE001 - startup failure, not a bug
+        print(f"zkcli: serve-sharded: cannot start tier: {e!r}",
+              file=sys.stderr)
+        await router.stop()
+        return 1
+
+    metrics_server = None
+    if cfg.metrics is not None:
+        registry = metrics_mod.instrument_shards(router)
+        metrics_server = metrics_mod.MetricsServer(
+            registry, host=cfg.metrics.host, port=cfg.metrics.port,
+            status_provider=router.status,
+        )
+        try:
+            await metrics_server.start()
+        except OSError as e:
+            # Same stance as the daemon: a busy metrics port must not
+            # block the tier from serving.
+            print(f"zkcli: serve-sharded: metrics listener failed: {e}",
+                  file=sys.stderr)
+            metrics_server = None
+
+    stop = asyncio.Event()
+    reload_requested = asyncio.Event()
+    loop = asyncio.get_running_loop()
+    for sig in (signal_mod.SIGTERM, signal_mod.SIGINT):
+        loop.add_signal_handler(sig, stop.set)
+    loop.add_signal_handler(signal_mod.SIGHUP, reload_requested.set)
+    print(
+        f"zkcli: serve-sharded: {cfg.serve.shards} shards on "
+        f"{cfg.serve.socket_path} (SIGHUP reshards)", file=sys.stderr,
+    )
+    deadline = (
+        loop.time() + args.duration if args.duration else None
+    )
+    try:
+        while not stop.is_set():
+            timeout = 0.2
+            if deadline is not None:
+                timeout = min(timeout, max(deadline - loop.time(), 0))
+            try:
+                await asyncio.wait_for(stop.wait(), timeout=timeout)
+            except asyncio.TimeoutError:
+                pass
+            if deadline is not None and loop.time() >= deadline:
+                break
+            if reload_requested.is_set():
+                reload_requested.clear()
+                try:
+                    fresh = load_config(args.file)
+                except ConfigError as e:
+                    print(f"zkcli: serve-sharded: reload failed: {e}",
+                          file=sys.stderr)
+                    continue
+                if fresh.serve is None:
+                    print(
+                        "zkcli: serve-sharded: reload dropped the "
+                        "`serve` block; keeping the running shape",
+                        file=sys.stderr,
+                    )
+                    continue
+                if fresh.serve.shards != router.shards:
+                    try:
+                        outcome = await router.reshard(fresh.serve.shards)
+                    except Exception as e:  # noqa: BLE001 - keep serving
+                        # A failed reshard (a new worker missed its
+                        # readiness window, the ensemble is slow) must
+                        # NOT take down the healthy tier — the old ring
+                        # is untouched and keeps serving; the operator
+                        # retries the SIGHUP.
+                        print(
+                            "zkcli: serve-sharded: reshard to "
+                            f"{fresh.serve.shards} failed ({e!r}); "
+                            "keeping the running shape — fix and "
+                            "SIGHUP again", file=sys.stderr,
+                        )
+                        continue
+                    print(
+                        "zkcli: serve-sharded: resharded to "
+                        f"{outcome['shards']} shards "
+                        f"({outcome['moved']} warm domains handed off "
+                        f"in {outcome['duration_ms']:.0f} ms)",
+                        file=sys.stderr,
+                    )
+                else:
+                    print(
+                        "zkcli: serve-sharded: reload: shard count "
+                        "unchanged; nothing to do", file=sys.stderr,
+                    )
+    finally:
+        for sig in (signal_mod.SIGTERM, signal_mod.SIGINT,
+                    signal_mod.SIGHUP):
+            loop.remove_signal_handler(sig)
+        if metrics_server is not None:
+            await metrics_server.stop()
+        await router.stop()
+    return 0
+
+
 def build_parser() -> argparse.ArgumentParser:
     parser = argparse.ArgumentParser(
         prog="zkcli",
@@ -1379,6 +1556,24 @@ def _register_commands(sub) -> None:
         "(and honor its cache block) instead of -s",
     )
     p.set_defaults(fn=_cmd_serve_view, raw=True)
+
+    p = sub.add_parser(
+        "serve-sharded",
+        help="run the namespace-sharded resolve tier per the config's "
+        "`serve` block: N worker processes (own session + watch-coherent "
+        "cache each) behind a consistent-hash router on a unix socket; "
+        "SIGHUP reshards in place with a warm handoff",
+    )
+    p.add_argument(
+        "-f", "--file", required=True, metavar="CONFIG",
+        help="registrar config file with a `serve` block (its zookeeper/"
+        "cache/metrics blocks are honored too)",
+    )
+    p.add_argument(
+        "--duration", type=float, default=0.0, metavar="SECONDS",
+        help="stop after this many seconds (default: run until SIGTERM)",
+    )
+    p.set_defaults(fn=_cmd_serve_sharded, raw=True)
 
     p = sub.add_parser(
         "setquota", help="set a soft quota on a subtree (zkCli.sh setquota)"

@@ -63,6 +63,7 @@ from __future__ import annotations
 import asyncio
 import logging
 import random
+import re
 import time
 from collections import deque
 from typing import Deque, Iterable, List, Optional, Sequence, Tuple
@@ -141,6 +142,64 @@ async def four_letter_word(
         writer.close()
 
 
+def _parse_attach_preference(pref: str) -> Optional[Tuple[int, int]]:
+    """Validate an ``attach_preference`` and extract the spread slot.
+
+    Returns ``(k, n)`` for ``"spread:<k>-of-<n>"`` (k in [0, n)), None
+    for ``"any"`` / ``"follower"``; raises ValueError on anything else —
+    a typo'd hint must fail at construction, not silently mean "any".
+    """
+    if pref in ("any", "follower"):
+        return None
+    m = re.fullmatch(r"spread:(\d+)-of-(\d+)", pref or "")
+    if m is None:
+        raise ValueError(
+            f"attach_preference must be 'any', 'follower', or "
+            f"'spread:<k>-of-<n>' (got {pref!r})"
+        )
+    k, n = int(m.group(1)), int(m.group(2))
+    if n < 1 or not 0 <= k < n:
+        raise ValueError(
+            f"attach_preference spread slot out of range: {pref!r}"
+        )
+    return (k, n)
+
+
+_ROLE_RANK = {"follower": 0, "standalone": 1, "leader": 3}
+
+
+async def _probe_roles(
+    order: "List[Tuple[str, int]]", timeout: float
+) -> "List[Tuple[str, int]]":
+    """Stable-partition a candidate order by replication role, probed
+    off each member's ``srvr`` 4lw concurrently: followers first, the
+    leader last, unknown/unreachable members in place (rank 2 — ahead
+    of the leader: an unanswered probe usually means a member mid-
+    restart, still a better watch host than the leader).  Never raises:
+    the hint must not make an unreachable ensemble less reachable."""
+
+    async def role_rank(host: str, port: int) -> int:
+        try:
+            raw = await four_letter_word(host, port, b"srvr", timeout)
+        except (OSError, ValueError, asyncio.TimeoutError):
+            return 2
+        for line in raw.decode("latin-1", "replace").splitlines():
+            if line.startswith("Mode: "):
+                return _ROLE_RANK.get(line[len("Mode: "):].strip(), 2)
+        return 2
+
+    ranks = await asyncio.gather(
+        *(role_rank(h, p) for h, p in order)
+    )
+    return [
+        server
+        for _rank, _i, server in sorted(
+            (rank, i, server)
+            for i, (rank, server) in enumerate(zip(ranks, order))
+        )
+    ]
+
+
 class ZKClient(EventEmitter):
     """One logical ZooKeeper session over a sequence of TCP connections.
 
@@ -163,6 +222,7 @@ class ZKClient(EventEmitter):
         max_session_rebirths: Optional[int] = None,
         can_be_read_only: bool = False,
         rng: Optional[random.Random] = None,
+        attach_preference: str = "any",
     ):
         """``request_timeout_ms``: per-operation deadline.  When set, every
         awaited reply is bounded; on expiry the connection is torn down
@@ -204,7 +264,29 @@ class ZKClient(EventEmitter):
 
         ``rng`` seeds the connect-order shuffle (and nothing else), so
         ensemble failover tests and chaos storms are deterministic per
-        CHAOS_SEED; default is the module RNG (reference behavior)."""
+        CHAOS_SEED; default is the module RNG (reference behavior).
+
+        ``attach_preference`` (ISSUE 12): a connect-ORDER hint so a
+        fleet of read-heavy clients (the sharded serve tier's workers)
+        spreads its watch load across ensemble members instead of
+        piling onto whichever member the shuffle favors:
+
+          * ``"any"`` — the default: seeded shuffle, reference-exact
+            behavior;
+          * ``"follower"`` — shuffle first (``rng`` still honored),
+            then probe each candidate's ``srvr`` 4lw concurrently and
+            stable-partition the order so followers come first and the
+            leader last (watch fan-out belongs on followers; the leader
+            has writes to order).  Probe failures leave a candidate in
+            place — the hint never makes an unreachable ensemble less
+            reachable;
+          * ``"spread:<k>-of-<n>"`` — worker k of n starts its pass at
+            a deterministic rotation of the CONFIGURED server order
+            (``rng`` is deliberately ignored: distinct workers must
+            land on distinct members, which a per-process shuffle would
+            undo).  Later candidates still serve as failover targets.
+
+        It is a *hint*: reachability always wins over preference."""
         super().__init__()
         servers = list(servers)
         if not servers:
@@ -241,6 +323,9 @@ class ZKClient(EventEmitter):
         self.can_be_read_only = can_be_read_only
         #: seeds the connect-order shuffle only (None = module RNG)
         self._rng = rng if rng is not None else random
+        #: connect-order hint ("any" | "follower" | "spread:<k>-of-<n>")
+        self.attach_preference = attach_preference
+        self._attach_spread = _parse_attach_preference(attach_preference)
         #: True while the session is attached to a read-only member
         #: (ConnectResponse read_only flag); reads serve, writes refuse
         self.read_only = False
@@ -379,6 +464,26 @@ class ZKClient(EventEmitter):
         self._abort_failover_span()
         await self._teardown(expected=True)
 
+    async def _connect_order(self) -> List[Tuple[str, int]]:
+        """Candidate order for one connect pass, per
+        ``attach_preference`` (constructor docstring): seeded shuffle
+        ("any"), shuffle + role-probed follower-first ("follower"), or
+        a deterministic rotation of the configured order ("spread") so
+        worker k of n starts at a distinct member."""
+        order = list(self.servers)
+        if self._attach_spread is not None:
+            k, n = self._attach_spread
+            # No shuffle: determinism IS the feature (two workers with
+            # different slots must not converge by shuffle luck).
+            start = (k * len(order)) // n % len(order)
+            return order[start:] + order[:start]
+        self._rng.shuffle(order)
+        if self.attach_preference == "follower" and len(order) > 1:
+            order = await _probe_roles(
+                order, timeout=min(0.5, self.connect_timeout_ms / 1000.0)
+            )
+        return order
+
     async def connect(self) -> "ZKClient":
         """Connect (or reconnect) to the first reachable server.
 
@@ -400,8 +505,7 @@ class ZKClient(EventEmitter):
         if self._closed:
             raise ZKError(Err.SESSION_EXPIRED, None)
         last_err: Optional[Exception] = None
-        order = list(self.servers)
-        self._rng.shuffle(order)
+        order = await self._connect_order()
         prefer, self._prefer_rw = self._prefer_rw, None
         if prefer is not None and prefer in order:
             # The rw-probe found a read-write member: leave read-only
@@ -1914,6 +2018,7 @@ async def create_zk_client(
     max_session_rebirths: Optional[int] = None,
     can_be_read_only: bool = False,
     rng: Optional[random.Random] = None,
+    attach_preference: str = "any",
 ) -> ZKClient:
     """Create and connect a client, retrying forever (reference lib/zk.js:62-127).
 
@@ -1934,6 +2039,7 @@ async def create_zk_client(
         max_session_rebirths=max_session_rebirths,
         can_be_read_only=can_be_read_only,
         rng=rng,
+        attach_preference=attach_preference,
     )
     return await connect_with_backoff(
         client, on_attempt=on_attempt, retry_policy=retry_policy

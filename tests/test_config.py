@@ -443,3 +443,65 @@ class TestRestartBlock:
         cfg = load_config(str(path))
         assert cfg.source_path == str(path)
         assert parse_config(self.BASE).source_path is None
+
+
+class TestServeBlock:
+    """ISSUE 12: the `serve` block (namespace-sharded resolve tier for
+    zkcli serve-sharded; absent = no tier, daemon behavior untouched)."""
+
+    BASE = {
+        "registration": {"domain": "a.b.c", "type": "host"},
+        "zookeeper": {"servers": [{"host": "h", "port": 2181}]},
+    }
+
+    def test_absent_block_is_none(self):
+        from registrar_tpu.config import parse_config
+
+        assert parse_config(self.BASE).serve is None
+
+    def test_parsed_with_defaults_and_override(self):
+        from registrar_tpu.config import parse_config
+
+        cfg = parse_config({
+            **self.BASE,
+            "serve": {"shards": 4, "socketPath": "/run/r.sock"},
+        })
+        assert cfg.serve.shards == 4
+        assert cfg.serve.socket_path == "/run/r.sock"
+        assert cfg.serve.attach_spread == "spread"
+        cfg = parse_config({
+            **self.BASE,
+            "serve": {"shards": 1, "socketPath": "/run/r.sock",
+                      "attachSpread": "follower"},
+        })
+        assert cfg.serve.attach_spread == "follower"
+
+    def test_validation_errors(self):
+        import pytest
+
+        from registrar_tpu.config import ConfigError, parse_config
+
+        for bad in (
+            [1],
+            {},  # shards required
+            {"shards": 0, "socketPath": "/s"},
+            {"shards": True, "socketPath": "/s"},
+            {"shards": "4", "socketPath": "/s"},
+            {"shards": 2},  # socketPath required
+            {"shards": 2, "socketPath": ""},
+            {"shards": 2, "socketPath": 7},
+            {"shards": 2, "socketPath": "/s", "attachSpread": "leader"},
+            {"shards": 2, "socketPath": "/s",
+             "attachSpread": "spread:0-of-2"},  # per-worker form is internal
+        ):
+            with pytest.raises(ConfigError):
+                parse_config({**self.BASE, "serve": bad})
+
+    def test_serve_is_a_known_key(self):
+        from registrar_tpu.config import parse_config
+
+        cfg = parse_config({
+            **self.BASE,
+            "serve": {"shards": 2, "socketPath": "/run/r.sock"},
+        })
+        assert "serve" not in cfg.unknown_keys

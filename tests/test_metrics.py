@@ -79,6 +79,19 @@ class TestRegistry:
         out = "\n".join(c.render())
         assert '{cmd="say \\"hi\\"\\nplease"}' in out
 
+    def test_counter_set_total_is_monotonic_per_labelset(self):
+        # The shard router's rollup path (ISSUE 12): polled cumulative
+        # totals install directly, but a stale LOWER value (a poll that
+        # raced a respawn's banked counter) is ignored — a counter can
+        # never be seen going backwards.
+        c = Counter("polled_total", "h")
+        c.set_total(5, labels={"shard": "0"})
+        c.set_total(9, labels={"shard": "0"})
+        c.set_total(7, labels={"shard": "0"})  # stale: ignored
+        c.set_total(3, labels={"shard": "1"})  # independent label set
+        assert c.value({"shard": "0"}) == 9
+        assert c.value({"shard": "1"}) == 3
+
 
 class TestHttp:
     async def test_metrics_endpoint_and_404(self):

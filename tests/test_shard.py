@@ -1,0 +1,619 @@
+"""Namespace-sharded serve tier (ISSUE 12): ring, protocol, worker,
+router, resharding, crash supervision, stale-while-unreachable.
+
+Process-spawning tests are deliberately consolidated (a worker costs an
+interpreter start); the pure pieces — the hash ring's stability and
+movement bounds, the frame codecs — are exercised exhaustively because
+they are the contracts everything else rides on.
+"""
+
+import asyncio
+import json
+import os
+import subprocess
+import sys
+import time
+
+import pytest
+
+from registrar_tpu import binderview
+from registrar_tpu.registration import register
+from registrar_tpu.shard import (
+    OP_RESOLVE,
+    OP_STATUS,
+    STATUS_ERR,
+    STATUS_OK,
+    Channel,
+    HashRing,
+    ShardClient,
+    ShardDirectClient,
+    ShardError,
+    ShardRouter,
+    ShardWorker,
+    decode_resolution,
+    encode_resolution,
+    pack_frame,
+    pack_resolve,
+    resolve_name,
+)
+from registrar_tpu.testing.server import ZKServer
+from registrar_tpu.zk.client import ZKClient
+
+
+# ---------------------------------------------------------------------------
+# HashRing: the contract every other piece rides on
+# ---------------------------------------------------------------------------
+
+
+def _sample_domains(k: int):
+    return [f"svc{i}.shardtest.joyent.us" for i in range(k)]
+
+
+class TestHashRing:
+    def test_deterministic_within_process(self):
+        a = HashRing(range(4))
+        b = HashRing(range(4))
+        for dom in _sample_domains(100):
+            assert a.owner(dom) == b.owner(dom)
+
+    def test_stable_across_process_restarts(self):
+        # The reason for BLAKE2 over hash(): Python string hashing is
+        # salted per process, and a restarted router must re-derive the
+        # EXACT ring or every worker's warm slice is orphaned.  A fresh
+        # interpreter (its own hash salt) must agree on every owner.
+        domains = _sample_domains(24)
+        local = {d: HashRing(range(4)).owner(d) for d in domains}
+        script = (
+            "import json,sys;"
+            "from registrar_tpu.shard import HashRing;"
+            "r=HashRing(range(4));"
+            "print(json.dumps({d: r.owner(d) for d in json.load(sys.stdin)}))"
+        )
+        env = dict(os.environ)
+        env["PYTHONPATH"] = os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__))
+        )
+        out = subprocess.run(
+            [sys.executable, "-c", script],
+            input=json.dumps(domains), capture_output=True, text=True,
+            env=env, check=True,
+        )
+        assert json.loads(out.stdout) == local
+
+    def test_every_shard_owns_a_slice(self):
+        ring = HashRing(range(8))
+        owners = {ring.owner(d) for d in _sample_domains(400)}
+        assert owners == set(range(8))
+
+    @pytest.mark.parametrize("n", [2, 4, 8])
+    def test_reshard_movement_bounded(self, n):
+        # Consistent hashing's whole point: growing N -> N+1 moves only
+        # ~K/(N+1) domains.  The ring is deterministic, so this is a
+        # fact being pinned, not a distribution being sampled.  Bound:
+        # ceil(K/N) + slack (the acceptance criterion's shape).
+        k = 240
+        domains = _sample_domains(k)
+        old = HashRing(range(n))
+        new = HashRing(range(n + 1))
+        moved = old.moved(new, domains)
+        bound = -(-k // n) + k // 10 + 2
+        assert len(moved) <= bound, (len(moved), bound)
+        # ...and every moved domain landed on the NEW shard or a
+        # rebalanced slot; domains that didn't move keep their owner.
+        for dom in domains:
+            if dom not in moved:
+                assert old.owner(dom) == new.owner(dom)
+
+    def test_shrink_movement_bounded(self):
+        k = 240
+        domains = _sample_domains(k)
+        old = HashRing(range(5))
+        new = HashRing(range(4))
+        moved = old.moved(new, domains)
+        # Removing one of five shards strands ~K/5 domains; everything
+        # else must stay put.
+        assert len(moved) <= -(-k // 5) + k // 10 + 2
+        for dom in domains:
+            if old.owner(dom) in range(4):
+                assert new.owner(dom) == old.owner(dom)
+
+    def test_empty_ring_refused(self):
+        with pytest.raises(ValueError):
+            HashRing([])
+
+
+# ---------------------------------------------------------------------------
+# Frame + resolution codecs
+# ---------------------------------------------------------------------------
+
+
+class TestCodecs:
+    def test_resolution_roundtrip(self):
+        res = binderview.Resolution(
+            answers=[binderview.Answer("a.b.us", "A", 30, "10.0.0.1")],
+            additionals=[
+                binderview.Answer("h.a.b.us", "A", 60, "10.0.0.2")
+            ],
+        )
+        out = decode_resolution(encode_resolution(res))
+        assert [str(a) for a in out.answers] == [str(a) for a in res.answers]
+        assert [str(a) for a in out.additionals] == [
+            str(a) for a in res.additionals
+        ]
+
+    def test_resolve_request_name_extraction(self):
+        body = pack_resolve("MyDomain.Example.US", "SRV", live=True)
+        assert resolve_name(body) == "MyDomain.Example.US"
+        assert body[0] & 1  # live flag
+        frame = pack_frame(7, OP_RESOLVE, body)
+        assert int.from_bytes(frame[:4], "big") == len(frame) - 4
+
+
+# ---------------------------------------------------------------------------
+# In-process worker: protocol ops, warm set, stale-while-unreachable
+# ---------------------------------------------------------------------------
+
+
+REG = {
+    "domain": "one.shardtest.joyent.us",
+    "type": "load_balancer",
+    "service": {
+        "type": "service",
+        "service": {"srvce": "_http", "proto": "_tcp", "port": 80},
+    },
+}
+
+
+def _worker_spec(server, path, shard=0):
+    return {
+        "socket": path,
+        "shard": shard,
+        "shards": 1,
+        "servers": [[server.host, server.port]],
+        "timeoutMs": 4000,
+    }
+
+
+async def test_worker_protocol_and_warm_set(tmp_path):
+    server = await ZKServer().start()
+    client = await ZKClient([server.address]).connect()
+    worker = None
+    chan = None
+    try:
+        await register(client, REG, admin_ip="10.6.0.1", hostname="h1",
+                       settle_delay=0)
+        worker = ShardWorker(
+            _worker_spec(server, str(tmp_path / "w.sock"))
+        )
+        await worker.start()
+        chan = await Channel.open(worker.socket_path)
+
+        status, body = await chan.request(
+            OP_RESOLVE, pack_resolve(REG["domain"], "A")
+        )
+        assert status == STATUS_OK
+        res = decode_resolution(body)
+        assert [a.data for a in res.answers] == ["10.6.0.1"]
+        assert (REG["domain"], "A") in worker.warm
+
+        # A second resolve is a cache hit in the worker's ZKCache.
+        hits_before = worker.cache.stats["hits"]
+        await chan.request(OP_RESOLVE, pack_resolve(REG["domain"], "A"))
+        assert worker.cache.stats["hits"] > hits_before
+
+        # STATUS carries the rollup fields the router aggregates.
+        status, body = await chan.request(OP_STATUS, b"")
+        st = json.loads(bytes(body).decode())
+        assert st["resolves_total"] == 2
+        assert st["session"]["connected"] is True
+        assert st["authoritative"] is True
+
+        # Unknown op answers an error frame, not a dead connection.
+        status, body = await chan.request(99, b"")
+        assert status == STATUS_ERR
+        assert b"unknown op" in bytes(body)
+
+        # The warm set is LRU-bounded by maxEntries.
+        worker.max_entries = 2
+        for i in range(4):
+            worker._touch(f"d{i}.x.us", "A", b"{}")
+        assert len(worker.warm) == 2
+        assert ("d3.x.us", "A") in worker.warm
+    finally:
+        if chan is not None:
+            await chan.close()
+        if worker is not None:
+            await worker.close()
+        await client.close()
+        await server.stop()
+
+
+async def test_worker_stale_while_unreachable(tmp_path):
+    """A transient backend outage serves the bounded-age last-known-good
+    answer instead of failing the slice; an explicit live read still
+    fails truthfully, and an expired record is not served."""
+    server = await ZKServer().start()
+    client = await ZKClient([server.address]).connect()
+    worker = None
+    chan = None
+    try:
+        await register(client, REG, admin_ip="10.6.0.1", hostname="h1",
+                       settle_delay=0)
+        worker = ShardWorker(
+            _worker_spec(server, str(tmp_path / "w.sock"))
+        )
+        await worker.start()
+        chan = await Channel.open(worker.socket_path)
+        status, body = await chan.request(
+            OP_RESOLVE, pack_resolve(REG["domain"], "A")
+        )
+        assert status == STATUS_OK
+        warm_answer = bytes(body)
+
+        await client.close()
+        await server.stop()  # the whole backend goes away
+        # Cached resolves fall back to the last-known-good bytes.
+        deadline = time.monotonic() + 5
+        while True:
+            status, body = await chan.request(
+                OP_RESOLVE, pack_resolve(REG["domain"], "A")
+            )
+            if status == STATUS_OK:
+                break
+            # The worker may still have been flushing its cache when the
+            # first post-outage resolve arrived; it must settle into
+            # stale serving, not erroring.
+            assert time.monotonic() < deadline, bytes(body)
+            await asyncio.sleep(0.05)
+        assert bytes(body) == warm_answer
+        assert worker.stale_serves >= 1
+
+        # An explicit live read never serves stale.
+        status, body = await chan.request(
+            OP_RESOLVE, pack_resolve(REG["domain"], "A", live=True)
+        )
+        assert status == STATUS_ERR
+
+        # Past the bound, the record is too old to lie about.
+        worker.max_stale_s = 0.0
+        await asyncio.sleep(0.01)
+        status, body = await chan.request(
+            OP_RESOLVE, pack_resolve(REG["domain"], "A")
+        )
+        assert status == STATUS_ERR
+    finally:
+        if chan is not None:
+            await chan.close()
+        if worker is not None:
+            await worker.close()
+
+
+# ---------------------------------------------------------------------------
+# The full tier: parity, resharding, crash supervision
+# ---------------------------------------------------------------------------
+
+
+#: README-derived resolve scenarios (the test_binderview shapes): a
+#: service fleet (A + SRV), a direct host record, an alias, an absent
+#: domain — sharded-vs-single parity must hold across all of them
+def _parity_registrations():
+    return [
+        (
+            {
+                "domain": "web.parity.joyent.us",
+                "type": "load_balancer",
+                "aliases": ["alias.web.parity.joyent.us"],
+                "service": {
+                    "type": "service",
+                    "service": {
+                        "srvce": "_http", "proto": "_tcp", "port": 80,
+                    },
+                },
+            },
+            "10.77.0.%d",
+            3,
+        ),
+        (
+            {"domain": "lonely.parity.joyent.us", "type": "host"},
+            "10.78.0.%d",
+            1,
+        ),
+    ]
+
+
+_PARITY_QUERIES = (
+    ("web.parity.joyent.us", "A"),
+    ("_http._tcp.web.parity.joyent.us", "SRV"),
+    ("alias.web.parity.joyent.us", "A"),
+    ("lonely.parity.joyent.us", "A"),
+    ("absent.parity.joyent.us", "A"),
+)
+
+
+async def test_sharded_vs_single_cache_parity(tmp_path):
+    """The tier must answer byte-for-byte what an in-process resolve
+    over a plain client answers, for every README scenario shape —
+    through the router relay AND the direct data plane."""
+    server = await ZKServer().start()
+    clients = []
+    router = None
+    sc = dc = None
+    try:
+        for reg, ip_fmt, instances in _parity_registrations():
+            for i in range(instances):
+                cl = await ZKClient([server.address]).connect()
+                clients.append(cl)
+                await register(
+                    cl, reg, admin_ip=ip_fmt % i, hostname=f"i{i}",
+                    settle_delay=0,
+                )
+        observer = await ZKClient([server.address]).connect()
+        clients.append(observer)
+        router = await ShardRouter(
+            [server.address], 2, str(tmp_path / "parity.sock"),
+            attach_spread="any",
+        ).start()
+        sc = await ShardClient(router.socket_path).connect()
+        dc = await ShardDirectClient(router.socket_path).connect()
+        for name, qtype in _PARITY_QUERIES:
+            expected = await binderview.resolve(observer, name, qtype)
+            for res in (
+                await sc.resolve(name, qtype),
+                await dc.resolve(name, qtype),
+                await sc.resolve(name, qtype, live=True),
+            ):
+                assert [str(a) for a in res.answers] == [
+                    str(a) for a in expected.answers
+                ], (name, qtype)
+                assert [str(a) for a in res.additionals] == [
+                    str(a) for a in expected.additionals
+                ], (name, qtype)
+    finally:
+        if sc is not None:
+            await sc.close()
+        if dc is not None:
+            await dc.close()
+        if router is not None:
+            await router.stop()
+        for cl in clients:
+            await cl.close()
+        await server.stop()
+
+
+async def test_reshard_bounded_movement_zero_errors(tmp_path):
+    """Resharding 2 -> 3 mid-traffic: a 10 ms-poll resolver sees ZERO
+    errors, the warm handoff moves only domains whose owner changed
+    (<= ceil(K/N) + slack of the K warm domains), and the moved slice
+    answers warm from its new owner."""
+    server = await ZKServer().start()
+    client = await ZKClient([server.address]).connect()
+    router = None
+    sc = None
+    try:
+        domains = []
+        for i in range(12):
+            dom = f"svc{i}.reshard.joyent.us"
+            await register(
+                client,
+                {
+                    "domain": dom,
+                    "type": "load_balancer",
+                    "service": {
+                        "type": "service",
+                        "service": {
+                            "srvce": "_http", "proto": "_tcp", "port": 80,
+                        },
+                    },
+                },
+                admin_ip=f"10.9.0.{i}", hostname="h0", settle_delay=0,
+            )
+            domains.append(dom)
+        router = await ShardRouter(
+            [server.address], 2, str(tmp_path / "reshard.sock"),
+            attach_spread="any",
+        ).start()
+        sc = await ShardClient(router.socket_path).connect()
+        for dom in domains:  # warm every domain into the tier
+            res = await sc.resolve(dom, "A")
+            assert res.answers
+
+        old_ring = router.ring
+        polling = True
+        errors = []
+
+        async def poll():
+            polled = 0
+            while polling:
+                for dom in domains:
+                    try:
+                        res = await sc.resolve(dom, "A")
+                        if not res.answers:
+                            errors.append(f"{dom}: empty")
+                    except Exception as err:  # noqa: BLE001 - the tally IS the assertion
+                        errors.append(f"{dom}: {err!r}")
+                    polled += 1
+                await asyncio.sleep(0.01)
+            return polled
+
+        poller = asyncio.ensure_future(poll())
+        outcome = await router.reshard(3)
+        await asyncio.sleep(0.05)
+        polling = False
+        polled = await poller
+        assert polled > 0
+        assert errors == [], errors[:5]
+
+        # Movement bound over the tier's warm set (12 domains + the
+        # negative/odd paths the warm set may carry).
+        k = len(domains)
+        moved_domains = old_ring.moved(router.ring, domains)
+        assert len(moved_domains) <= -(-k // 2) + k // 4 + 1
+        assert outcome["moved"] >= len(moved_domains)
+        assert outcome["shards"] == 3
+        assert router.generation == 1
+
+        # The moved domains answer from their NEW owner's warm set: its
+        # worker pre-resolved them before the flip.
+        st = await router.status()
+        warm_total = sum(
+            info["warm"] for info in st["shards"].values()
+        )
+        assert warm_total >= k
+
+        # No-op reshard moves nothing.
+        assert (await router.reshard(3))["moved"] == 0
+    finally:
+        if sc is not None:
+            await sc.close()
+        if router is not None:
+            await router.stop()
+        await client.close()
+        await server.stop()
+
+
+async def test_worker_crash_respawn_e2e(tmp_path):
+    """SIGKILL one worker under a 10 ms-poll resolver: the surviving
+    shards' slices answer with ZERO errors throughout, the dead slice
+    recovers within the respawn bound, and the router's status/metrics
+    record the crash."""
+    from registrar_tpu import metrics as metrics_mod
+
+    server = await ZKServer().start()
+    client = await ZKClient([server.address]).connect()
+    router = None
+    sc = None
+    try:
+        domains = []
+        for i in range(8):
+            dom = f"svc{i}.crash.joyent.us"
+            await register(
+                client,
+                {
+                    "domain": dom,
+                    "type": "load_balancer",
+                    "service": {
+                        "type": "service",
+                        "service": {
+                            "srvce": "_http", "proto": "_tcp", "port": 80,
+                        },
+                    },
+                },
+                admin_ip=f"10.10.0.{i}", hostname="h0", settle_delay=0,
+            )
+            domains.append(dom)
+        router = await ShardRouter(
+            [server.address], 2, str(tmp_path / "crash.sock"),
+            attach_spread="any", poll_interval_s=0.2,
+        ).start()
+        registry = metrics_mod.instrument_shards(router)
+        sc = await ShardClient(router.socket_path).connect()
+        for dom in domains:
+            assert (await sc.resolve(dom, "A")).answers
+
+        victim = router.ring.owner(domains[0])
+        victim_doms = [
+            d for d in domains if router.ring.owner(d) == victim
+        ]
+        surviving = [d for d in domains if d not in victim_doms]
+        assert surviving, "sample too small to cover both shards"
+
+        surviving_errors = []
+        victim_recovered_at = None
+        polling = True
+
+        async def poll():
+            nonlocal victim_recovered_at
+            while polling:
+                for dom in surviving:
+                    try:
+                        res = await sc.resolve(dom, "A")
+                        if not res.answers:
+                            surviving_errors.append(f"{dom}: empty")
+                    except Exception as err:  # noqa: BLE001 - the tally IS the assertion
+                        surviving_errors.append(f"{dom}: {err!r}")
+                if victim_recovered_at is None:
+                    try:
+                        if (await sc.resolve(victim_doms[0], "A")).answers:
+                            victim_recovered_at = time.monotonic()
+                    except Exception:  # noqa: BLE001 - still down
+                        pass
+                await asyncio.sleep(0.01)
+
+        poller = asyncio.ensure_future(poll())
+        await asyncio.sleep(0.1)  # healthy polls on both slices first
+        killed_at = time.monotonic()
+        router.kill_worker(victim)
+        victim_recovered_at = None  # only post-kill recovery counts
+        deadline = killed_at + 20
+        while victim_recovered_at is None and time.monotonic() < deadline:
+            await asyncio.sleep(0.02)
+        polling = False
+        await poller
+
+        assert victim_recovered_at is not None, "victim slice never recovered"
+        assert surviving_errors == [], surviving_errors[:5]
+
+        st = await router.status()
+        assert st["serve"]["respawns_total"] == 1
+        assert st["shards"][str(victim)]["respawns"] == 1
+        assert not st["degraded"]
+        # metrics rollup saw the respawn; resolves_total stayed monotonic
+        respawns = registry.get("registrar_shard_respawns_total")
+        assert respawns.value({"shard": str(victim)}) == 1.0
+    finally:
+        if sc is not None:
+            await sc.close()
+        if router is not None:
+            await router.stop()
+        await client.close()
+        await server.stop()
+
+
+async def test_router_degraded_without_respawn(tmp_path):
+    """respawn_enabled=False (the SLO harness's repair-off mode): the
+    dead shard stays down, status reports degraded, siblings keep
+    serving."""
+    server = await ZKServer().start()
+    client = await ZKClient([server.address]).connect()
+    router = None
+    sc = None
+    try:
+        await register(client, REG, admin_ip="10.6.0.1", hostname="h1",
+                       settle_delay=0)
+        router = await ShardRouter(
+            [server.address], 2, str(tmp_path / "down.sock"),
+            attach_spread="any",
+        ).start()
+        router.respawn_enabled = False
+        sc = await ShardClient(router.socket_path).connect()
+        victim = router.ring.owner(REG["domain"])
+        sibling = 1 - victim
+        router.kill_worker(victim)
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            st = await router.status()
+            if st["degraded"]:
+                break
+            await asyncio.sleep(0.05)
+        st = await router.status()
+        assert st["degraded"] and st["shards_down"] == [victim]
+        with pytest.raises(ShardError):
+            await sc.resolve(REG["domain"], "A")
+        # the sibling's slice still answers (any warm/fillable domain
+        # it owns — ownership is a hint, workers answer anything)
+        ring = router.ring
+        for i in range(64):
+            name = f"probe{i}.crash.joyent.us"
+            if ring.owner(name) == sibling:
+                res = await sc.resolve(name, "A")
+                assert res.empty  # absent domain: clean empty, no error
+                break
+        else:
+            pytest.fail("no sibling-owned probe name found")
+    finally:
+        if sc is not None:
+            await sc.close()
+        if router is not None:
+            await router.stop()
+        await client.close()
+        await server.stop()

@@ -404,3 +404,87 @@ class TestEnsembleScenarios:
                 await harness.run_scenario("leader-kill")
         finally:
             await harness.stop()
+
+
+class TestShardScenarios:
+    """ISSUE 12: the sharded-serve-tier fault classes against a real
+    2-shard worker-process tier (shards= wires the tier + the
+    slice-probe leg into the prober)."""
+
+    async def test_shard_kill_measured_and_siblings_never_blip(self):
+        harness = slo.SLOHarness(
+            members=2, seed=21, probe_interval=0.02,
+            session_timeout_ms=800, shards=2,
+        )
+        await harness.start()
+        try:
+            assert len(harness.slice_expected) >= 3
+            await harness.settle(0.2)
+            await harness.run_scenario("shard-kill", kills=1)
+            await harness.settle(0.3)
+            report = harness.report(trace_name="unit")
+            entry = report["faults"]["shard-kill"]
+            assert entry["injected"] == 1
+            assert entry["detected"] == 1
+            # MTTR covers kill -> supervisor detection -> respawn ->
+            # slice answering again (the respawn+warm bound).
+            assert entry["mttr_s_mean"] is not None
+            assert 0.0 < entry["mttr_s_mean"] < 10.0
+            assert report["shards"]["respawns"] == 1
+            # The scenario itself asserts zero sibling errors (it
+            # raises otherwise); the report carries the evidence.
+            assert report["shards"]["slice_errors"] > 0
+        finally:
+            await harness.stop()
+
+    async def test_reshard_wave_is_zero_error(self):
+        harness = slo.SLOHarness(
+            members=2, seed=22, probe_interval=0.02,
+            session_timeout_ms=800, shards=2,
+        )
+        await harness.start()
+        try:
+            await harness.settle(0.2)
+            await harness.run_scenario("reshard-wave", hold_s=0.1)
+            await harness.settle(0.2)
+            report = harness.report(trace_name="unit")
+            entry = report["faults"]["reshard-wave"]
+            assert entry["injected"] == 1
+            # zero-downtime by construction (the scenario raises on any
+            # slice error): never detected as an outage
+            assert entry["detected"] == 0
+            assert report["shards"]["slice_errors"] == 0
+            assert report["shards"]["reshards"] == 2  # up and back down
+        finally:
+            await harness.stop()
+
+    async def test_shard_scenarios_need_a_sharded_tier(self):
+        harness = slo.SLOHarness(members=2, seed=23)
+        await harness.start()
+        try:
+            with pytest.raises(ValueError):
+                await harness.run_scenario("shard-kill")
+            with pytest.raises(ValueError):
+                await harness.run_scenario("reshard-wave")
+        finally:
+            await harness.stop()
+
+    async def test_repair_disabled_withholds_the_respawn(self):
+        harness = slo.SLOHarness(
+            members=2, seed=24, probe_interval=0.02,
+            session_timeout_ms=800, shards=2, repair=False,
+        )
+        await harness.start()
+        try:
+            assert harness.router.respawn_enabled is False
+            await harness.settle(0.2)
+            await harness.run_scenario("shard-kill", kills=1)
+            await harness.settle(0.5)
+            report = harness.report(trace_name="unit")
+            # the slice stays dark: errors keep accumulating and no
+            # respawn ever lands
+            assert report["shards"]["respawns"] == 0
+            assert report["shards"]["slice_errors"] > 0
+            assert report["availability"] < 1.0
+        finally:
+            await harness.stop()

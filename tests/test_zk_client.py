@@ -677,6 +677,113 @@ class TestConstructorValidation:
             await server.stop()
 
 
+class TestAttachPreference:
+    """The connect-order hint (ISSUE 12): spread/follower placement for
+    read-heavy fleets, with 'any' staying reference-exact."""
+
+    SERVERS = [("10.0.0.1", 2181), ("10.0.0.2", 2181), ("10.0.0.3", 2181)]
+
+    def test_invalid_preference_rejected_at_construction(self):
+        for bad in ("spread", "spread:1-of-", "spread:3-of-3",
+                    "spread:-1-of-2", "leader", ""):
+            with pytest.raises(ValueError):
+                ZKClient(self.SERVERS, attach_preference=bad)
+
+    async def test_spread_rotation_is_deterministic(self):
+        import random as random_mod
+
+        # Worker k of n starts its pass at a distinct rotation of the
+        # CONFIGURED order — and the seeded shuffle is deliberately NOT
+        # applied (the documented rng interaction: two workers with
+        # different slots must not converge by shuffle luck).
+        starts = set()
+        for k in range(3):
+            orders = []
+            for seed in (1, 2):  # different rngs, same order expected
+                client = ZKClient(
+                    self.SERVERS,
+                    attach_preference=f"spread:{k}-of-3",
+                    rng=random_mod.Random(seed),
+                )
+                orders.append(await client._connect_order())
+            assert orders[0] == orders[1]
+            assert sorted(orders[0]) == sorted(self.SERVERS)
+            starts.add(orders[0][0])
+        assert starts == set(self.SERVERS)  # all three slots distinct
+
+    async def test_any_keeps_the_seeded_shuffle(self):
+        import random as random_mod
+
+        client = ZKClient(
+            self.SERVERS, attach_preference="any",
+            rng=random_mod.Random(42),
+        )
+        expected = list(self.SERVERS)
+        random_mod.Random(42).shuffle(expected)
+        assert await client._connect_order() == expected
+
+    async def test_follower_preference_avoids_the_leader(self):
+        from registrar_tpu.testing.server import ZKEnsemble
+
+        ens = await ZKEnsemble(3).start()
+        try:
+            leader_addr = ens.servers[ens.leader_index].address
+            # The probe-ordered pass puts the leader LAST, whatever the
+            # shuffle said — across several seeds, so this is the
+            # probe's doing, not shuffle luck.
+            import random as random_mod
+
+            for seed in (1, 2, 3):
+                client = ZKClient(
+                    ens.addresses, attach_preference="follower",
+                    rng=random_mod.Random(seed), reconnect=False,
+                )
+                order = await client._connect_order()
+                assert order[-1] == leader_addr
+            # ...and a real connect lands on a follower.
+            client = ZKClient(
+                ens.addresses, attach_preference="follower",
+                reconnect=False,
+            )
+            await client.connect()
+            try:
+                assert client.connected_server != leader_addr
+            finally:
+                await client.close()
+        finally:
+            await ens.stop()
+
+    async def test_follower_probe_failure_leaves_order_alone(self):
+        import random as random_mod
+
+        # Nothing answers srvr on these ports: the hint must not make
+        # an unreachable ensemble less reachable — order falls back to
+        # the plain seeded shuffle.
+        client = ZKClient(
+            [("127.0.0.1", 1), ("127.0.0.1", 2), ("127.0.0.1", 3)],
+            attach_preference="follower",
+            rng=random_mod.Random(7),
+            connect_timeout_ms=200,
+        )
+        expected = [("127.0.0.1", 1), ("127.0.0.1", 2), ("127.0.0.1", 3)]
+        random_mod.Random(7).shuffle(expected)
+        assert await client._connect_order() == expected
+
+    async def test_create_zk_client_passes_the_hint_through(self):
+        server = await ZKServer().start()
+        try:
+            client = await create_zk_client(
+                [server.address], attach_preference="spread:0-of-2",
+            )
+            try:
+                assert client.attach_preference == "spread:0-of-2"
+                assert client.connected
+            finally:
+                await client.close()
+        finally:
+            await server.stop()
+
+
 class TestBurstInterruption:
     async def test_replies_before_malformed_frame_are_delivered(self):
         # A burst of [valid request, malformed frame]: the server kills

@@ -949,3 +949,198 @@ class TestDrainCommand:
         )
         assert out.returncode == 2
         assert "cannot connect" in out.stderr
+
+
+class TestServeSharded:
+    """ISSUE 12: zkcli serve-sharded runs the sharded tier standalone
+    per the config's serve block, SIGHUP reshards it in place, and the
+    metrics listener serves the per-shard /status rollup."""
+
+    async def test_serve_sharded_e2e_with_sighup_reshard(self, tmp_path):
+        import signal as signal_mod
+        import socket
+        import urllib.request
+
+        server = await ZKServer().start()
+        client = await _seed(server)
+        proc = None
+        try:
+            with socket.socket() as s:
+                s.bind(("127.0.0.1", 0))
+                port = s.getsockname()[1]
+            cfg = tmp_path / "cfg.json"
+
+            def write_cfg(shards):
+                cfg.write_text(json.dumps({
+                    "registration": {"domain": "cli.test.us",
+                                     "type": "host"},
+                    "zookeeper": {
+                        "servers": [
+                            {"host": server.host, "port": server.port}
+                        ],
+                    },
+                    "serve": {
+                        "shards": shards,
+                        "socketPath": str(tmp_path / "resolve.sock"),
+                        "attachSpread": "any",
+                    },
+                    "metrics": {"port": port},
+                }))
+
+            write_cfg(2)
+            proc = subprocess.Popen(
+                [sys.executable, "-m", "registrar_tpu.tools.zkcli",
+                 "serve-sharded", "-f", str(cfg), "--duration", "30"],
+                cwd=REPO, text=True,
+                stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+                env={**os.environ, "PYTHONPATH": REPO},
+            )
+
+            def fetch_status():
+                with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/status", timeout=5
+                ) as resp:
+                    return json.loads(resp.read())
+
+            async def poll_status(pred, what, timeout=25.0):
+                loop = asyncio.get_running_loop()
+                deadline = loop.time() + timeout
+                while True:
+                    assert proc.poll() is None, proc.stderr.read()
+                    try:
+                        snapshot = await asyncio.to_thread(fetch_status)
+                        if pred(snapshot):
+                            return snapshot
+                    except OSError:
+                        pass
+                    assert loop.time() < deadline, f"timed out: {what}"
+                    await asyncio.sleep(0.1)
+
+            snapshot = await poll_status(
+                lambda s: s.get("serve", {}).get("shards") == 2
+                and not s.get("degraded"),
+                "tier up with 2 shards",
+            )
+            assert set(snapshot["shards"]) == {"0", "1"}
+            assert snapshot["uptime_s"] is not None
+            assert "serve" in snapshot["last_transition"]
+
+            # The tier answers through its front socket.
+            from registrar_tpu.shard import ShardClient
+
+            sc = await ShardClient(
+                str(tmp_path / "resolve.sock")
+            ).connect()
+            try:
+                res = await sc.resolve("cli.test.us", "A")
+                assert [a.data for a in res.answers] == ["10.5.5.5"]
+            finally:
+                await sc.close()
+
+            # zkcli status understands the sharded shape: healthy -> 0.
+            out = _run_tool("status", "-f", str(cfg))
+            assert out.returncode == 0, out.stderr
+            assert "shard 0 up" in out.stderr and "shard 1 up" in out.stderr
+            assert "healthy" in out.stderr
+
+            # SIGHUP with a changed shard count reshards in place.
+            write_cfg(3)
+            proc.send_signal(signal_mod.SIGHUP)
+            snapshot = await poll_status(
+                lambda s: s.get("serve", {}).get("shards") == 3
+                and not s.get("degraded"),
+                "reshard to 3 shards",
+            )
+            assert snapshot["serve"]["generation"] == 1
+            assert set(snapshot["shards"]) == {"0", "1", "2"}
+        finally:
+            if proc is not None and proc.poll() is None:
+                proc.terminate()
+                try:
+                    proc.wait(10)
+                except subprocess.TimeoutExpired:
+                    proc.kill()
+                    proc.wait()
+            await client.close()
+            await server.stop()
+
+    def test_serve_sharded_requires_serve_block(self, tmp_path):
+        cfg = tmp_path / "cfg.json"
+        cfg.write_text(json.dumps({
+            "registration": {"domain": "cli.test.us", "type": "host"},
+            "zookeeper": {"servers": [{"host": "127.0.0.1", "port": 1}]},
+        }))
+        out = _run_tool("serve-sharded", "-f", str(cfg),
+                        "--duration", "1")
+        assert out.returncode == 2
+        assert "serve" in out.stderr
+
+
+class TestShardedStatus:
+    """zkcli status against a sharded /status snapshot: per-shard lines,
+    degraded exit when any shard is down (the PR-9 status contract's
+    sharded shape)."""
+
+    async def _status_against(self, snapshot, tmp_path):
+        from registrar_tpu import metrics as metrics_mod
+        from registrar_tpu.tools import zkcli as zkcli_mod
+
+        async def provider():
+            return snapshot
+
+        server = metrics_mod.MetricsServer(
+            metrics_mod.MetricsRegistry(), status_provider=provider,
+        )
+        await server.start()
+        try:
+            cfg = tmp_path / "cfg.json"
+            cfg.write_text(json.dumps({
+                "registration": {"domain": "a.b.c", "type": "host"},
+                "zookeeper": {
+                    "servers": [{"host": "127.0.0.1", "port": 1}]
+                },
+                "metrics": {"port": server.port},
+            }))
+
+            class Args:
+                file = str(cfg)
+                timeout = 5.0
+
+            return await zkcli_mod._cmd_status(Args())
+        finally:
+            await server.stop()
+
+    def _snapshot(self, *, down=()):
+        shards = {}
+        for sid in ("0", "1"):
+            shards[sid] = {
+                "up": sid not in down,
+                "respawns": 0,
+                "resolves_total": 10,
+                "entries": 4,
+                "authoritative": sid not in down,
+                "coherence_lag_ms_last": 0.5,
+                "session": {"id": "0xabc", "connected": True,
+                            "readOnly": False,
+                            "server": "127.0.0.1:2181"},
+            }
+        return {
+            "serve": {"shards": 2, "generation": 0, "reshards": 0,
+                      "respawns_total": 0},
+            "degraded": bool(down),
+            "shards_down": [int(s) for s in down],
+            "shards": shards,
+            "uptime_s": 12.0,
+            "last_transition": {},
+        }
+
+    async def test_healthy_sharded_snapshot_exits_zero(self, tmp_path, capsys):
+        assert await self._status_against(self._snapshot(), tmp_path) == 0
+
+    async def test_down_shard_is_degraded(self, tmp_path, capsys):
+        rc = await self._status_against(
+            self._snapshot(down=("1",)), tmp_path
+        )
+        assert rc == 1
+        err = capsys.readouterr().err
+        assert "shard 1 down" in err and "DEGRADED" in err

@@ -256,7 +256,13 @@ def main(argv=None) -> int:
     )
     if gate_on:
         tolerance = _tolerance(baseline)
-        gate_failures = bench.gate(_gate_result(report), baseline, tolerance)
+        gate_failures = bench.gate(
+            _gate_result(report), baseline, tolerance,
+            # SLO metric names live in SLO_HISTORY's directions map,
+            # not bench.BENCH_METRICS — skip the bench-namespace
+            # declaration check (it would fail every SLO metric).
+            declared_metrics=None,
+        )
         if gate_failures:
             # One retry absorbs scheduler noise; the gate judges the
             # per-metric best of both runs (bench.py's exact policy).
@@ -278,7 +284,9 @@ def main(argv=None) -> int:
                 ),
                 "extra": {k: v for k, v in merged.items() if v is not None},
             }
-            gate_failures = bench.gate(best_view, baseline, tolerance)
+            gate_failures = bench.gate(
+                best_view, baseline, tolerance, declared_metrics=None,
+            )
         failures.extend(gate_failures)
 
     if args.prove_detection and repair:
