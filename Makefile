@@ -94,7 +94,10 @@ profile:
 # (nines, per-fault MTTD/MTTR, worst outage + trace ids) and gates the
 # quick trace against SLO_BASELINE.json like the perf benches.
 # slo-quick additionally reruns the same seed with repair disabled and
-# fails unless the nines measurably drop (the detection proof).
+# fails unless the nines measurably drop (the detection proof).  Both
+# targets also write the worst outage's ASSEMBLED cross-process trace
+# tree (ISSUE 13) next to the report: slo-report.worst-trace.{json,txt}
+# — probe span -> router relay -> worker resolve subtree, one trace id.
 # SLO_SEED=<n> pins a schedule; SLO_TOLERANCE_PCT widens the gate on
 # slow hardware; SLO_GATE=0 disables it.
 slo:

@@ -107,6 +107,8 @@ BENCH_METRICS = {
     "sharded_resolve_qps_2_shards": "higher",
     "sharded_resolve_qps_4_shards": "higher",
     "sharded_live_resolve_qps_4_shards": "higher",
+    "sharded_resolve_qps_4_shards_traced": "higher",
+    "sharded_trace_overhead_pct": None,
     "reshard_warm_handoff_ms": "lower",
 }
 
@@ -533,6 +535,147 @@ async def _sharded_qps(
         await router.stop()
 
 
+#: synthetic root context stamped on every traced-bench request: the
+#: cost under test is the wire block + the worker's adopt + span work,
+#: not the bench's own id minting
+TRACE_BENCH_CTX = (0x13C0FFEE00000001, 0x13C0FFEE00000002, 1)
+
+
+async def _sharded_trace_overhead(
+    server, sock_dir: str, domains: list,
+    *, shards: int = 4, per_shard: int = 1200, attempts: int = 6,
+    assert_bound: bool = True,
+) -> tuple:
+    """The PR-8 <10% tracing-overhead bound extended to the sharded
+    wire path (ISSUE 13): ``sharded_resolve_qps_4_shards`` measured
+    traced-at-100%-sampling vs off.  Traced means the FULL cross-
+    process cost per request: trace-context block on the wire, the
+    worker's adopt + resolve.query span at sample_rate=1.0, and the
+    worker_us reply block back.
+
+    Noise discipline: two long-lived tiers (workers spawned ONCE each),
+    driven in alternating base/traced rounds so scheduler drift hits
+    both sides of a pair, and the verdict is the best pair of
+    ``attempts`` — a real per-request cost shows up in every pair, a
+    frequency-scaling episode does not (the PR-8 gate's policy, paired
+    tighter because multi-process runs drift more than in-process
+    bursts).  Returns ``(overhead_pct, traced_qps)`` or raises when the
+    best pair still exceeds the bound (BENCH_TRACE_OVERHEAD_PCT to
+    widen on noisy boxes).
+    """
+    from registrar_tpu.shard import (
+        OP_RESOLVE, OP_TRACE, STATUS_OK, ShardDirectClient, ShardRouter,
+        pack_resolve,
+    )
+
+    limit_pct = float(os.environ.get("BENCH_TRACE_OVERHEAD_PCT", "10"))
+    routers = []
+    directs = {}
+    try:
+        for kind, worker_trace in (
+            ("off", None), ("on", {"sampleRate": 1.0}),
+        ):
+            router = ShardRouter(
+                [server.address], shards,
+                os.path.join(sock_dir, f"benchtrace-{kind}.sock"),
+                attach_spread="any", poll_interval_s=30.0,
+                worker_trace=worker_trace,
+            )
+            await router.start()
+            # Tracked the moment it has worker subprocesses to reap —
+            # a failed client connect below must not orphan them.
+            routers.append(router)
+            direct = await ShardDirectClient(router.socket_path).connect()
+            directs[kind] = direct
+            for dom in domains:
+                if not (await direct.resolve(dom, "A")).answers:
+                    raise RuntimeError(
+                        f"trace-overhead warm resolve empty for {dom}"
+                    )
+
+        async def one_round(direct, ctx) -> float:
+            by_owner = {}
+            for dom in domains:
+                by_owner.setdefault(direct.owner(dom), []).append(dom)
+
+            async def drive(shard_id: int, doms: list) -> None:
+                chan = await direct.channel(shard_id)
+                reqs = [pack_resolve(d, "A") for d in doms]
+                batch = 64
+                done = 0
+                while done < per_shard:
+                    n = min(batch, per_shard - done)
+                    replies = await asyncio.gather(
+                        *(
+                            chan.request(
+                                OP_RESOLVE,
+                                reqs[(done + k) % len(reqs)],
+                                trace_ctx=ctx,
+                            )
+                            for k in range(n)
+                        )
+                    )
+                    done += n
+                    for status, body in replies:
+                        if status != STATUS_OK:
+                            raise RuntimeError(
+                                "trace-overhead resolve errored: "
+                                f"{bytes(body)!r}"
+                            )
+
+            t0 = time.perf_counter()
+            await asyncio.gather(
+                *(drive(sid, doms) for sid, doms in by_owner.items())
+            )
+            return per_shard * len(by_owner) / (time.perf_counter() - t0)
+
+        # warmup both tiers (unmeasured)
+        await one_round(directs["off"], None)
+        await one_round(directs["on"], TRACE_BENCH_CTX)
+        overhead_pct = traced_qps = None
+        for _attempt in range(attempts):
+            base = await one_round(directs["off"], None)
+            traced = await one_round(directs["on"], TRACE_BENCH_CTX)
+            pct = (base / traced - 1.0) * 100.0
+            if overhead_pct is None or pct < overhead_pct:
+                overhead_pct = pct
+                traced_qps = traced
+            if overhead_pct <= limit_pct * 0.7:
+                break  # comfortably under the bound; stop burning rounds
+        # Like the >=3x scaling bound: never asserted under SMOKE —
+        # contended CI vCPUs would gate scheduler luck, not code (the
+        # values are still reported).
+        if assert_bound and overhead_pct > limit_pct:
+            raise RuntimeError(
+                "cross-process tracing overhead on the sharded resolve "
+                f"path exceeds {limit_pct}%: best of {attempts} pairs "
+                f"{overhead_pct:.1f}% (traced {traced_qps:.1f} qps)"
+            )
+        # Same honesty check as the PR-8 gate: the traced figure is only
+        # a tracing figure if the workers actually recorded the spans (a
+        # silently-disabled tracer would "win" by doing nothing).
+        direct_on = directs["on"]
+        probe = json.dumps(
+            {"trace_id": f"{TRACE_BENCH_CTX[0]:016x}"}
+        ).encode()
+        for sid in range(shards):
+            chan = await direct_on.channel(sid)
+            status, body = await chan.request(OP_TRACE, probe)
+            if status != STATUS_OK or not json.loads(
+                bytes(body).decode()
+            ).get("entries"):
+                raise RuntimeError(
+                    f"traced sharded bench: worker {sid} recorded no "
+                    "spans — the timed path was not the traced path"
+                )
+        return overhead_pct, traced_qps
+    finally:
+        for direct in directs.values():
+            await direct.close()
+        for router in routers:
+            await router.stop()
+
+
 async def _reshard_handoff(
     server, sock_dir: str, domains: list, shards: int = 4,
 ) -> float:
@@ -612,6 +755,10 @@ async def _sharded_metrics(server, client, sock_dir: str,
         server, sock_dir, domains, 4, live=True,
         per_shard=per_shard // 4,
     )
+    overhead_pct, traced_qps = await _sharded_trace_overhead(
+        server, sock_dir, domains, per_shard=per_shard,
+        attempts=3 if smoke else 6, assert_bound=not smoke,
+    )
     handoff_ms = await _reshard_handoff(server, sock_dir, domains)
     cores = os.cpu_count() or 1
     ratio = (
@@ -630,6 +777,8 @@ async def _sharded_metrics(server, client, sock_dir: str,
     return {
         **{name: round(value, 1) for name, value in qps.items()},
         "sharded_live_resolve_qps_4_shards": round(live_qps, 1),
+        "sharded_resolve_qps_4_shards_traced": round(traced_qps, 1),
+        "sharded_trace_overhead_pct": round(overhead_pct, 2),
         "reshard_warm_handoff_ms": round(handoff_ms, 1),
     }
 
@@ -946,6 +1095,8 @@ async def _bench() -> dict:
                 "sharded_resolve_qps_2_shards": None,
                 "sharded_resolve_qps_4_shards": None,
                 "sharded_live_resolve_qps_4_shards": None,
+                "sharded_resolve_qps_4_shards_traced": None,
+                "sharded_trace_overhead_pct": None,
                 "reshard_warm_handoff_ms": None,
             }
         else:

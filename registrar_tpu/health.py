@@ -195,7 +195,20 @@ class HealthCheck(EventEmitter):
         with trace.tracer_for(self).span(
             "health.exec", command=self.command
         ) as sp:
-            err = await self._run_command()
+            env = None
+            if sp.trace_id is not None:
+                # Stamp the subprocess with the active trace (ISSUE 13):
+                # a check command that logs $REGISTRAR_TRACE_ID makes
+                # its own shell output joinable to the health.exec span
+                # — the same ids the shard wire extension carries, so a
+                # health-driven deregistration's whole causal chain
+                # greps by one token.  With tracing off, env is None
+                # and the child inherits the parent environment
+                # untouched (parity).
+                env = dict(os.environ)
+                env["REGISTRAR_TRACE_ID"] = sp.trace_id
+                env["REGISTRAR_SPAN_ID"] = sp.span_id
+            err = await self._run_command(env)
             if err is not None:
                 sp.set_attr("failed", str(err))
         if err is None:
@@ -205,11 +218,14 @@ class HealthCheck(EventEmitter):
         self.emit("data", record)
         return record
 
-    async def _run_command(self) -> Optional[HealthCheckError]:
+    async def _run_command(
+        self, env: Optional[Dict[str, str]] = None
+    ) -> Optional[HealthCheckError]:
         log.debug("check: running %s", self.command)
         try:
             proc = await asyncio.create_subprocess_shell(
                 self.command,
+                env=env,
                 stdout=asyncio.subprocess.PIPE,
                 stderr=asyncio.subprocess.PIPE,
                 # Own process group: the shell routinely spawns

@@ -653,6 +653,21 @@ async def _run_traced(cfg: Config, log, tracer, *, _exit=sys.exit) -> None:
             # registrar_reconcile_sweep_seconds family when enabled.
             instrument_tracing(tracer, registry)
         instrument(ee, zk, registry)
+        async def _trace_tree(trace_id: str):
+            # GET /debug/trace?id= (ISSUE 13): the daemon is one
+            # process, so "assembly" is just its own recorder — but
+            # the payload shape (and the orphan convention) is the
+            # same one the sharded tier's cross-process fan-out
+            # serves, so dashboards and zkcli trace --id read both.
+            from registrar_tpu import traceview
+
+            return traceview.assemble(
+                trace_mod.get_tracer().dump(trace_id=trace_id).get(
+                    "entries", []
+                ),
+                trace_id,
+            )
+
         try:
             metrics_server = await MetricsServer(
                 registry,
@@ -662,6 +677,7 @@ async def _run_traced(cfg: Config, log, tracer, *, _exit=sys.exit) -> None:
                     cfg, zk, ee, status_note
                 ),
                 trace_provider=lambda n: trace_mod.get_tracer().dump(n),
+                trace_tree_provider=_trace_tree,
             ).start()
         except OSError as err:
             # A busy/forbidden port must not take down registration —

@@ -97,6 +97,7 @@ import time
 from typing import Callable, Dict, List, Optional, Tuple
 
 from registrar_tpu import reconcile as reconcile_mod
+from registrar_tpu import trace as trace_mod
 
 log = logging.getLogger("registrar_tpu.metrics")
 
@@ -387,8 +388,12 @@ class MetricsServer:
 
     ``status_provider`` is an async callable returning the /status dict;
     ``trace_provider`` a sync callable ``(n: Optional[int]) -> dict``
-    returning the /debug/trace payload.  An unwired endpoint answers
-    404, exactly like any unknown path.
+    returning the /debug/trace payload; ``trace_tree_provider`` an
+    async callable ``(trace_id: str) -> dict`` returning the ASSEMBLED
+    cross-process tree for ``GET /debug/trace?id=<trace_id>`` (ISSUE
+    13 — the shard router's OP_TRACE fan-out, or the daemon's own
+    single-recorder assembly).  An unwired endpoint answers 404,
+    exactly like any unknown path.
     """
 
     def __init__(
@@ -398,11 +403,13 @@ class MetricsServer:
         port: int = 0,
         status_provider=None,
         trace_provider=None,
+        trace_tree_provider=None,
     ):
         self.registry = registry
         self.host = host
         self.status_provider = status_provider
         self.trace_provider = trace_provider
+        self.trace_tree_provider = trace_tree_provider
         self._requested_port = port
         self.port: Optional[int] = None
         self._server: Optional[asyncio.AbstractServer] = None
@@ -476,7 +483,13 @@ class MetricsServer:
         path, _, query = target.partition("?")
         known = path == "/metrics" or (
             path == "/status" and self.status_provider is not None
-        ) or (path == "/debug/trace" and self.trace_provider is not None)
+        ) or (
+            path == "/debug/trace"
+            and (
+                self.trace_provider is not None
+                or self.trace_tree_provider is not None
+            )
+        )
         if known and method != "GET":
             # The path exists; the method is wrong.  405 with Allow is
             # the contract clients (and security scanners) expect —
@@ -505,6 +518,7 @@ class MetricsServer:
             return ("200 OK", "application/json; charset=utf-8", body, "")
         if known and path == "/debug/trace":
             n = None
+            trace_id = None
             for kv in query.split("&"):
                 key, _, value = kv.partition("=")
                 if key == "n":
@@ -512,8 +526,32 @@ class MetricsServer:
                         n = int(value)
                     except ValueError:
                         pass
+                elif key == "id" and value:
+                    trace_id = value
+            if trace_id is not None:
+                # One ASSEMBLED tree (ISSUE 13) instead of the raw ring
+                # — cross-process when the provider is the shard
+                # router's OP_TRACE fan-out.  No provider = an explicit
+                # error, never a silent fallback to the ring dump (the
+                # shapes differ; zkcli trace --id would choke on it).
+                if self.trace_tree_provider is None:
+                    payload = {
+                        "error": "trace assembly (?id=) is not wired "
+                        "on this listener",
+                        "trace_id": trace_id,
+                    }
+                else:
+                    try:
+                        payload = await self.trace_tree_provider(trace_id)
+                    except Exception as err:  # noqa: BLE001 - introspection must answer
+                        log.exception("trace tree provider raised")
+                        payload = {"error": repr(err), "trace_id": trace_id}
+            elif self.trace_provider is not None:
+                payload = self.trace_provider(n)
+            else:
+                payload = {"error": "no flight recorder wired"}
             body = json.dumps(
-                self.trace_provider(n), indent=2, default=str
+                payload, indent=2, default=str
             ).encode() + b"\n"
             return ("200 OK", "application/json; charset=utf-8", body, "")
         return (
@@ -859,6 +897,13 @@ def instrument_shards(
         "handoff)",
     )
     reshards.inc(0)
+    relay = reg.histogram(
+        "registrar_shard_relay_seconds",
+        "Router relay latency by shard (ISSUE 13): one observation per "
+        "shard.relay span, client frame in to worker reply out; the "
+        "span's forwarded/worker marks split it into router-queue, "
+        "socket, and worker time",
+    )
     seeded: set = set()
 
     def seed(sid) -> None:
@@ -867,6 +912,7 @@ def instrument_shards(
         entries.set(0.0, labels=labels)
         up.set(0.0, labels=labels)
         respawns.inc(0, labels=labels)
+        relay.preseed(labels)
         seeded.add(sid)
 
     for sid in getattr(router.ring, "shard_ids", ()):
@@ -911,6 +957,25 @@ def instrument_shards(
         resync_shards()
 
     router.on("reshard", on_reshard)
+
+    # Feed the relay histogram from the router's shard.relay spans
+    # (ISSUE 13).  Resolved once, at instrument time: with tracing off
+    # the family still exists pre-seeded (alerts see zero series), it
+    # just never observes — the registry's parity stance.
+    tracer = trace_mod.tracer_for(router)
+    if tracer.enabled:
+        shard_labels: Dict[str, Dict[str, str]] = {}
+
+        def on_relay_span(span) -> None:
+            if span.name != "shard.relay" or span.duration_s is None:
+                return
+            sid = str(span.attrs.get("shard"))
+            labels = shard_labels.get(sid)
+            if labels is None:
+                labels = shard_labels[sid] = {"shard": sid}
+            relay.observe(span.duration_s, labels=labels)
+
+        tracer.on_span(on_relay_span)
     return reg
 
 

@@ -56,8 +56,9 @@ fresh watches with the read, exactly like any other fill.
 Wire protocol (all integers big-endian)::
 
     frame   := len:u32  payload
-    request := req_id:u32  op:u8  body
-    reply   := req_id:u32  status:u8  body      # status 0 = OK, 1 = error
+    request := req_id:u32  op:u8  [trace_ctx]  body
+    reply   := req_id:u32  status:u8  [worker_us:u32]  body
+                                                # status 0 = OK, 1 = error
 
     OP_RESOLVE  body = flags:u8 (bit0: live read)  qlen:u8  qtype  name
                 reply body = compact JSON {"a": [[name, rtype, ttl,
@@ -68,6 +69,28 @@ Wire protocol (all integers big-endian)::
     OP_DUMP     (worker) reply = {"warm": [[name, qtype], ...]}
     OP_WARM     (worker) body = {"names": [[name, qtype], ...]};
                 pre-resolves each, reply = {"warmed": N}
+    OP_TRACE    body = {"trace_id": hex, "n"?: int}; worker reply = its
+                flight recorder filtered to that trace (+ shard, pid);
+                router reply = the ASSEMBLED cross-process tree
+                (:mod:`registrar_tpu.traceview`)
+
+**Trace-context extension (ISSUE 13).**  The :data:`TRACE_FLAG` bit on
+the op byte gates a fixed ``trace_id:u64 + parent_span_id:u64 +
+sampled:u8`` block between header and body — with tracing off not a
+bit moves and every frame is byte-identical to the PR-12 format (pinned
+by the golden parity test).  Clients inject the ambient span's context
+(:func:`registrar_tpu.trace.current_context`), the router adopts it as
+the parent of its ``shard.relay`` span and re-injects THAT span's
+context toward the owning worker, and the worker adopts in turn so its
+``resolve.query``/``cache.fill``/``zk.op`` subtree chains under the
+relay — one trace id from resolver to znode.  A reply to a traced
+request carries the same flag bit on the status byte gating a
+``worker_us:u32`` block: the REMOTE PEER's self-reported handling time
+(a worker reports its dispatch; the router, answering its own front
+socket, reports the whole relay window), stamped on the requester's
+span as the ``worker`` mark — so the router's relay span splits into
+router-queue (the ``forwarded`` mark), socket, and worker time, the
+sharded analog of PR 8's zk.op queue-vs-wire split.
 
 Used by ``zkcli serve-sharded -f config`` (config block ``serve:
 {shards, socketPath, attachSpread}``; absent block = today's in-process
@@ -90,9 +113,10 @@ import struct
 import subprocess
 import sys
 import time
+from contextlib import nullcontext
 from typing import Dict, Iterable, List, Optional, Sequence, Tuple
 
-from registrar_tpu import binderview
+from registrar_tpu import binderview, trace, traceview
 from registrar_tpu.binderview import Answer, Resolution
 from registrar_tpu.events import EventEmitter, spawn_owned
 from registrar_tpu.retry import RetryPolicy, is_transient
@@ -101,17 +125,34 @@ from registrar_tpu.zkcache import DEFAULT_MAX_ENTRIES, ZKCache
 
 log = logging.getLogger("registrar_tpu.shard")
 
+#: shared reusable no-op context manager (nullcontext is reentrant and
+#: stateless) — the untraced request path pays no per-request allocation
+NULLCTX = nullcontext()
+
 OP_RESOLVE = 1
 OP_STATUS = 2
 OP_RING = 3
 OP_DUMP = 4
 OP_WARM = 5
+OP_TRACE = 6
 
 STATUS_OK = 0
 STATUS_ERR = 1
 
+#: high bit of the op byte (request) / status byte (reply): a
+#: trace-context block (request) or worker_us block (reply) follows the
+#: header.  A bit, not a new frame layout, so tracing-off frames stay
+#: byte-identical to the PR-12 wire format (module docstring).
+TRACE_FLAG = 0x80
+
 #: request/reply fixed header past the length prefix: req_id:u32 + op/status:u8
 _HDR = struct.Struct(">IB")
+
+#: the optional trace-context block: trace_id:u64 parent_span_id:u64 sampled:u8
+_TRACE_CTX = struct.Struct(">QQB")
+
+#: the optional traced-reply block: the worker's handling time in µs
+_WORKER_US = struct.Struct(">I")
 
 #: frame size bound — an answer set is a few KiB; anything bigger is a
 #: protocol error, not a legitimate resolution (guards readexactly from
@@ -201,6 +242,62 @@ def pack_frame(req_id: int, code: int, body) -> bytes:
     return (
         struct.pack(">I", _HDR.size + len(body))
         + _HDR.pack(req_id, code)
+        + bytes(body)
+    )
+
+
+def split_traced(frame, op: int):
+    """Split an incoming request's optional trace-context block:
+    ``(op, ctx, body)``.  A flagged frame too short for the block is a
+    protocol error raised as :class:`ShardError` — the caller answers
+    STATUS_ERR; it must never become a dead handler task that leaves
+    the requester waiting forever."""
+    if not op & TRACE_FLAG:
+        return op, None, memoryview(frame)[_HDR.size:]
+    if len(frame) < _HDR.size + _TRACE_CTX.size:
+        raise ShardError(
+            f"traced frame too short for context block ({len(frame)})"
+        )
+    ctx = _TRACE_CTX.unpack_from(frame, _HDR.size)
+    body = memoryview(frame)[_HDR.size + _TRACE_CTX.size:]
+    return op & ~TRACE_FLAG & 0xFF, ctx, body
+
+
+async def _answer_protocol_error(writer, req_id: int, err: Exception) -> None:
+    """Answer a malformed frame with STATUS_ERR — shared by the worker
+    and the router so the two peers' protocol-error behavior can never
+    drift (a dead handler task would leave the requester, whose future
+    has no timeout, waiting forever)."""
+    try:
+        writer.write(pack_frame(req_id, STATUS_ERR, repr(err).encode()))
+        await writer.drain()
+    except (ConnectionError, OSError):
+        pass
+
+
+def stamp_traced_reply(status: int, reply, t0: float) -> Tuple[int, bytes]:
+    """The traced-reply extension, one copy for every hop: flag the
+    status byte and prepend this peer's self-reported handling time
+    (µs since ``t0``).  Gated by the caller on the REQUEST having
+    carried context, so untraced peers never see the flag."""
+    us = min(int((time.monotonic() - t0) * 1e6), 0xFFFFFFFF)
+    return status | TRACE_FLAG, _WORKER_US.pack(us) + bytes(reply)
+
+
+def pack_request(
+    req_id: int, op: int, body, trace_ctx: Optional[Tuple] = None
+) -> bytes:
+    """One request frame.  Without ``trace_ctx`` this is byte-for-byte
+    :func:`pack_frame` — the tracing-off parity the golden wire test
+    pins; with a ``(trace_id, parent_span_id, sampled)`` int triple the
+    op byte's :data:`TRACE_FLAG` bit gates the fixed context block
+    between header and body."""
+    if trace_ctx is None:
+        return pack_frame(req_id, op, body)
+    return (
+        struct.pack(">I", _HDR.size + _TRACE_CTX.size + len(body))
+        + _HDR.pack(req_id, op | TRACE_FLAG)
+        + _TRACE_CTX.pack(*trace_ctx)
         + bytes(body)
     )
 
@@ -314,8 +411,22 @@ class Channel:
                 # and the GC warning would point at the wrong culprit.
                 fut.exception()
 
-    async def request(self, op: int, body) -> Tuple[int, memoryview]:
-        """Send one request; await ``(status, body_view)``."""
+    async def request(
+        self,
+        op: int,
+        body,
+        trace_ctx: Optional[Tuple] = None,
+        span=None,
+    ) -> Tuple[int, memoryview]:
+        """Send one request; await ``(status, body_view)``.
+
+        ``trace_ctx`` (a :func:`registrar_tpu.trace.current_context`
+        triple) rides the op byte's trace extension; ``span`` (the
+        caller's relay span) gets the ``forwarded`` mark when the frame
+        clears our buffer and the ``worker`` mark from the traced
+        reply's worker_us block.  The block is stripped here either
+        way, so callers always see the plain PR-12 ``(status, body)``.
+        """
         if self._closed:
             raise ShardError("shard connection closed")
         self._next_id = (self._next_id + 1) & 0xFFFFFFFF
@@ -323,15 +434,33 @@ class Channel:
         fut = asyncio.get_running_loop().create_future()
         self._pending[req_id] = fut
         try:
-            self._writer.write(pack_frame(req_id, op, body))
+            self._writer.write(pack_request(req_id, op, body, trace_ctx))
             await self._writer.drain()
         except (ConnectionError, OSError) as err:
             self._pending.pop(req_id, None)
             raise ShardError(f"shard write failed: {err!r}") from err
+        if span is not None:
+            span.mark("forwarded")
         try:
-            return await fut
+            status, reply = await fut
         finally:
             self._pending.pop(req_id, None)
+        if status & TRACE_FLAG:
+            if len(reply) < _WORKER_US.size:
+                # Same hazard split_traced guards on the request side:
+                # a malformed peer must surface as the documented
+                # ShardError, never a stray struct.error (which the
+                # relay path would not catch — a dead handler task).
+                raise ShardError(
+                    f"traced reply too short for worker_us block "
+                    f"({len(reply)})"
+                )
+            (worker_us,) = _WORKER_US.unpack_from(reply)
+            reply = reply[_WORKER_US.size:]
+            status &= ~TRACE_FLAG & 0xFF
+            if span is not None:
+                span.set_mark("worker", worker_us / 1e6)
+        return status, reply
 
     async def drain_pending(self, timeout: float = 2.0) -> None:
         """Wait (bounded) for in-flight requests to finish — the reshard
@@ -420,6 +549,9 @@ class ShardWorker:
         #: LRU warm set: (name, qtype) -> (last-good serialized answer,
         #: monotonic stamp); dict order = recency
         self.warm: Dict[Tuple[str, str], Tuple[bytes, float]] = {}
+        #: per-instance tracer override (ISSUE 13); None = the process
+        #: default — the spawned worker installs one from spec["trace"]
+        self.tracer = None
 
     def _make_client(self) -> ZKClient:
         spec = self.spec
@@ -501,9 +633,26 @@ class ShardWorker:
 
     async def _handle(self, frame: bytes, writer) -> None:
         req_id, op = _HDR.unpack_from(frame)
-        body = memoryview(frame)[_HDR.size:]
         try:
-            reply = await self._dispatch(op, body)
+            op, ctx, body = split_traced(frame, op)
+        except ShardError as err:
+            self.errors_total += 1
+            await _answer_protocol_error(writer, req_id, err)
+            return
+        t0 = time.monotonic() if ctx is not None else 0.0
+        try:
+            # Adopt the wire context (ISSUE 13): this request's
+            # resolve.query/cache.fill/zk.op subtree chains under the
+            # router's relay span (or the direct caller's span) across
+            # the process boundary.  A disabled tracer's adopt() is the
+            # shared no-op span; the untraced path never even resolves
+            # the tracer.
+            with (
+                trace.tracer_for(self).adopt(*ctx)
+                if ctx is not None
+                else NULLCTX
+            ):
+                reply = await self._dispatch(op, body)
             status = STATUS_OK
         except asyncio.CancelledError:
             raise
@@ -511,6 +660,10 @@ class ShardWorker:
             self.errors_total += 1
             reply = repr(err).encode()
             status = STATUS_ERR
+        if ctx is not None:
+            # Traced reply extension: this worker's handling time, the
+            # relay span's "worker" mark.
+            status, reply = stamp_traced_reply(status, reply, t0)
         try:
             writer.write(pack_frame(req_id, status, reply))
             await writer.drain()
@@ -535,6 +688,16 @@ class ShardWorker:
                 except Exception:  # noqa: BLE001 - warming is best-effort
                     log.warning("warm fill failed for %s (%s)", name, qtype)
             return json.dumps({"warmed": len(names)}).encode()
+        if op == OP_TRACE:
+            req = json.loads(bytes(body).decode()) if len(body) else {}
+            dump = trace.tracer_for(self).dump(
+                req.get("n"), trace_id=req.get("trace_id")
+            )
+            # Stamp the fragment's origin: the assembler labels each
+            # span with the process it came from.
+            dump["shard"] = self.shard_id
+            dump["pid"] = os.getpid()
+            return json.dumps(dump, default=str).encode()
         raise ShardError(f"unknown op {op}")
 
     async def _resolve(self, body: memoryview) -> bytes:
@@ -614,6 +777,21 @@ class ShardWorker:
 
 
 async def _worker_main(spec: Dict) -> int:
+    tcfg = spec.get("trace")
+    if tcfg:
+        # The router's observability config rides the spec: the worker
+        # installs its own process-wide tracer so the instrumented
+        # cache/client paths (resolve.query, cache.fill, zk.op) record
+        # into a per-process flight recorder OP_TRACE can hand back.
+        trace.set_tracer(
+            trace.Tracer(
+                sample_rate=float(tcfg.get("sampleRate", 1.0)),
+                slow_span_ms=tcfg.get("slowSpanMs"),
+                max_spans=int(
+                    tcfg.get("maxSpans") or trace.DEFAULT_MAX_SPANS
+                ),
+            )
+        )
     worker = ShardWorker(spec)
     loop = asyncio.get_running_loop()
     for sig in (signal.SIGTERM, signal.SIGINT):
@@ -699,6 +877,7 @@ class ShardRouter(EventEmitter):
         poll_interval_s: float = 1.0,
         python: Optional[str] = None,
         worker_log_level: Optional[str] = None,
+        worker_trace: Optional[Dict] = None,
     ):
         super().__init__()
         if shards < 1:
@@ -722,6 +901,13 @@ class ShardRouter(EventEmitter):
         #: stderr log level for spawned workers (SHARD_LOG_LEVEL env;
         #: None = inherit — the SLO harness quiets its workers with it)
         self.worker_log_level = worker_log_level
+        #: spec["trace"] block for spawned workers (ISSUE 13): e.g.
+        #: {"sampleRate": 1.0, "maxSpans": 2048}; None = workers trace
+        #: nothing, exactly the pre-13 behavior
+        self.worker_trace = worker_trace
+        #: per-instance tracer override for the router's OWN spans
+        #: (shard.relay, shard.trace_collect); None = process default
+        self.tracer = None
         #: crash → respawn supervision; the SLO harness's repair-disabled
         #: runs turn this off (a withheld recovery action)
         self.respawn_enabled = True
@@ -754,6 +940,7 @@ class ShardRouter(EventEmitter):
             "timeoutMs": self.timeout_ms,
             "connectTimeoutMs": self.connect_timeout_ms,
             "requestTimeoutMs": self.request_timeout_ms,
+            "trace": self.worker_trace,
         }
 
     def _spawn_proc(self, spec: Dict) -> subprocess.Popen:
@@ -1153,37 +1340,99 @@ class ShardRouter(EventEmitter):
 
     async def _serve_frame(self, frame: bytes, writer) -> None:
         req_id, op = _HDR.unpack_from(frame)
-        body = memoryview(frame)[_HDR.size:]
+        try:
+            op, ctx, body = split_traced(frame, op)
+        except ShardError as err:
+            await _answer_protocol_error(writer, req_id, err)
+            return
+        t0 = time.monotonic() if ctx is not None else 0.0
         if op == OP_RESOLVE:
-            status, reply = await self._relay_resolve(body)
+            status, reply = await self._relay_resolve(body, ctx)
         elif op == OP_RING:
             status, reply = STATUS_OK, json.dumps(self.ring_info()).encode()
         elif op == OP_STATUS:
             status, reply = STATUS_OK, json.dumps(
                 await self.status()
             ).encode()
+        elif op == OP_TRACE:
+            status, reply = await self._serve_trace(body)
         else:
             status, reply = STATUS_ERR, f"unknown op {op}".encode()
+        if ctx is not None:
+            # The traced-reply contract holds on EVERY hop: each peer
+            # reports ITS handling time (for the router that spans
+            # queue + socket + worker — the relay span's whole window),
+            # so a traced client of the front socket gets its "worker"
+            # mark exactly as a direct client of a worker does.
+            status, reply = stamp_traced_reply(status, reply, t0)
         try:
             writer.write(pack_frame(req_id, status, reply))
             await writer.drain()
         except (ConnectionError, OSError):
             pass
 
-    async def _relay_resolve(self, body: memoryview):
+    async def _serve_trace(self, body: memoryview):
+        """OP_TRACE on the front socket: the ASSEMBLED cross-process
+        tree (the same view GET /debug/trace?id= serves, reachable
+        without a metrics listener)."""
+        try:
+            req = json.loads(bytes(body).decode()) if len(body) else {}
+            trace_id = req.get("trace_id")
+            if not trace_id:
+                return STATUS_ERR, b"trace_id required"
+            tree = await self.collect_trace(trace_id)
+        except (ValueError, ShardError) as err:
+            return STATUS_ERR, repr(err).encode()
+        return STATUS_OK, json.dumps(tree, default=str).encode()
+
+    async def _relay_resolve(self, body: memoryview, ctx=None):
         """Forward one resolve to its owner and hand back the worker's
         reply bytes untouched (the router never copies answers — the
-        body view below is a slice of the worker's reply frame)."""
+        body view below is a slice of the worker's reply frame).
+
+        With tracing on, the hop is a ``shard.relay`` span: adopted
+        from the client's wire context (``ctx``), re-injected toward
+        the worker so its subtree chains under the relay, and marked
+        with the router-queue/socket/worker split (module docstring).
+        """
         try:
             name = resolve_name(body).rstrip(".").lower()
         except (IndexError, UnicodeDecodeError) as err:
             return STATUS_ERR, f"bad resolve request: {err!r}".encode()
-        handle = self._workers.get(self.ring.owner(name))
+        owner = self.ring.owner(name)
+        handle = self._workers.get(owner)
+        tracer = trace.tracer_for(self)
+        if not tracer.enabled:
+            # Tracing off here: forward the peer's context untouched —
+            # a traced client still joins the worker's fragments even
+            # through an untraced router (pass-through, no relay span).
+            return await self._relay_to(handle, body, ctx, None)
+        with tracer.adopt(*ctx) if ctx is not None else NULLCTX:
+            with tracer.span("shard.relay", shard=owner, domain=name) as sp:
+                fwd = (
+                    int(sp.trace_id, 16),
+                    int(sp.span_id, 16),
+                    1 if sp.sampled else 0,
+                )
+                return await self._relay_to(handle, body, fwd, sp)
+
+    async def _relay_to(self, handle, body, ctx, span):
+        """THE one copy of the relay's error contract (down shard /
+        dead channel → STATUS_ERR), shared by the traced and untraced
+        paths.  A failing hop is evidence: the errored relay span says
+        exactly which shard's slice refused, even when no worker
+        fragment exists."""
         if handle is None or handle.chan is None:
+            if span is not None:
+                span.finish("error", err="shard down")
             return STATUS_ERR, b"shard down"
         try:
-            return await handle.chan.request(OP_RESOLVE, body)
+            return await handle.chan.request(
+                OP_RESOLVE, body, trace_ctx=ctx, span=span
+            )
         except ShardError as err:
+            if span is not None:
+                span.finish("error", err=repr(err))
             return STATUS_ERR, repr(err).encode()
 
     def ring_info(self) -> Dict:
@@ -1271,6 +1520,85 @@ class ShardRouter(EventEmitter):
             "last_transition": dict(self.last_transition),
         }
 
+    async def collect_trace(self, trace_id: str) -> Dict:
+        """Assemble ONE cross-process tree for ``trace_id`` (ISSUE 13).
+
+        Fans an ``OP_TRACE`` query to every worker, merges the
+        fragments with the router's own flight recorder (which in-
+        process callers like the SLO harness share, so their spans fold
+        in automatically), and reconstructs the parent tree via
+        :mod:`registrar_tpu.traceview`.  A dead or frozen worker cannot
+        hand over its fragment; its absence is recorded in ``sources``
+        and any span whose parent lived there surfaces under the
+        ``<missing parent>`` node — a crashed worker must not silently
+        erase its subtree.  ``GET /debug/trace?id=`` and ``zkcli trace
+        --id`` ride this.
+        """
+        tracer = trace.tracer_for(self)
+        entries: List[Dict] = []
+        sources: List[Dict] = []
+
+        def take(raw_entries, proc: str) -> int:
+            count = 0
+            for raw in raw_entries:
+                entry = dict(raw)
+                entry.setdefault("proc", proc)
+                entries.append(entry)
+                count += 1
+            return count
+
+        with tracer.span("shard.trace_collect", trace_id=trace_id) as sp:
+            own = tracer.dump(trace_id=trace_id)
+            sources.append(
+                {
+                    "proc": "router",
+                    "pid": os.getpid(),
+                    "entries": take(own.get("entries", ()), "router"),
+                }
+            )
+            req = json.dumps({"trace_id": trace_id}).encode()
+
+            async def query(handle) -> Dict:
+                proc = f"shard{handle.shard_id}"
+                if handle.chan is None:
+                    return {"proc": proc, "error": "worker down"}
+                try:
+                    status, reply = await asyncio.wait_for(
+                        handle.chan.request(OP_TRACE, req), timeout=2.0
+                    )
+                except (ShardError, asyncio.TimeoutError) as err:
+                    return {"proc": proc, "error": repr(err)}
+                if status != STATUS_OK:
+                    return {
+                        "proc": proc,
+                        "error": bytes(reply).decode("utf-8", "replace"),
+                    }
+                return {"proc": proc, "dump": json.loads(bytes(reply))}
+
+            # Concurrent fan-out: the per-worker queries are independent
+            # pipelined channel requests, so N frozen workers cost ONE
+            # 2 s window, not 2 s × N of serialized /debug/trace stall.
+            answers = await asyncio.gather(
+                *(
+                    query(handle)
+                    for handle in sorted(
+                        self._workers.values(), key=lambda h: h.shard_id
+                    )
+                )
+            )
+            for answer in answers:
+                dump = answer.pop("dump", None)
+                if dump is not None:
+                    answer["pid"] = dump.get("pid")
+                    answer["entries"] = take(
+                        dump.get("entries", ()), answer["proc"]
+                    )
+                sources.append(answer)
+            sp.set_attr("entries", len(entries))
+        tree = traceview.assemble(entries, trace_id)
+        tree["sources"] = sources
+        return tree
+
 
 # ---------------------------------------------------------------------------
 # Clients
@@ -1305,14 +1633,18 @@ class ShardClient:
     async def __aexit__(self, *_exc) -> None:
         await self.close()
 
-    async def _request(self, op: int, body) -> memoryview:
+    async def _request(
+        self, op: int, body, trace_ctx: Optional[Tuple] = None
+    ) -> memoryview:
         if self._chan is None or self._chan.closed:
             if self._reopen_lock is None:
                 self._reopen_lock = asyncio.Lock()
             async with self._reopen_lock:
                 if self._chan is None or self._chan.closed:
                     self._chan = await Channel.open(self.socket_path)
-        status, reply = await self._chan.request(op, body)
+        status, reply = await self._chan.request(
+            op, body, trace_ctx=trace_ctx
+        )
         if status != STATUS_OK:
             raise ShardError(bytes(reply).decode("utf-8", "replace"))
         return reply
@@ -1320,8 +1652,28 @@ class ShardClient:
     async def resolve(
         self, name: str, qtype: str = "A", live: bool = False
     ) -> Resolution:
+        # Inject the ambient span's context (ISSUE 13): a traced caller
+        # (the SLO prober, a future DNS frontend) joins its span tree
+        # to the router's relay and the worker's resolve subtree.  With
+        # no active span this is None and the frame is byte-identical
+        # to the PR-12 format.
         return decode_resolution(
-            await self._request(OP_RESOLVE, pack_resolve(name, qtype, live))
+            await self._request(
+                OP_RESOLVE,
+                pack_resolve(name, qtype, live),
+                trace_ctx=trace.current_context(),
+            )
+        )
+
+    async def trace_tree(self, trace_id: str) -> Dict:
+        """The assembled cross-process tree for ``trace_id`` (the
+        router's OP_TRACE fan-out)."""
+        return json.loads(
+            bytes(
+                await self._request(
+                    OP_TRACE, json.dumps({"trace_id": trace_id}).encode()
+                )
+            ).decode()
         )
 
     async def ring(self) -> Dict:
@@ -1391,8 +1743,14 @@ class ShardDirectClient:
         self, name: str, qtype: str = "A", live: bool = False
     ) -> Resolution:
         chan = await self.channel(self.owner(name))
+        # Same injection rule as ShardClient: the direct data plane
+        # skips the router, so the worker's subtree parents straight
+        # under the caller's ambient span (what the DNS frontend will
+        # do — its query id maps onto this trace id).
         status, reply = await chan.request(
-            OP_RESOLVE, pack_resolve(name, qtype, live)
+            OP_RESOLVE,
+            pack_resolve(name, qtype, live),
+            trace_ctx=trace.current_context(),
         )
         if status != STATUS_OK:
             raise ShardError(bytes(reply).decode("utf-8", "replace"))

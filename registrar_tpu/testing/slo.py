@@ -58,7 +58,7 @@ import math
 import random
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
-from registrar_tpu import binderview
+from registrar_tpu import binderview, traceview
 from registrar_tpu import metrics as metrics_mod
 from registrar_tpu import trace as trace_mod
 from registrar_tpu.events import EventEmitter, spawn_owned
@@ -629,7 +629,13 @@ class SLOHarness(EventEmitter):
             worker_log_level=(
                 None if os.environ.get("SLO_VERBOSE") == "1" else "ERROR"
             ),
+            # Cross-process tracing (ISSUE 13): workers record at 100%
+            # so a failing slice probe's trace id resolves to a FULL
+            # tree — probe span → shard.relay → the owning worker's
+            # resolve subtree — in the worst-outage report.
+            worker_trace={"sampleRate": 1.0, "maxSpans": 4096},
         )
+        self.router.tracer = self.tracer
         # With repair withheld, a crashed worker stays dead — the
         # respawn IS the recovery action the detection proof disables.
         self.router.respawn_enabled = self.repair
@@ -1382,6 +1388,36 @@ class SLOHarness(EventEmitter):
             "gate_metrics": gate_metrics,
         }
 
+    async def collect_worst_trace(self, report: Dict[str, Any]) -> None:
+        """Upgrade the report's worst-outage entry from trace IDS to
+        the assembled cross-process trace TREE (ISSUE 13).
+
+        Picks the first failing probe's trace id inside the worst
+        window and assembles one tree across every process that saw it
+        — the harness's own recorder (probe spans, fleet zk.ops) plus,
+        in shards mode, the router's relay spans and each worker's
+        resolve subtree via ``OP_TRACE``.  Call between :meth:`report`
+        and :meth:`stop` (the workers must still be alive to hand over
+        their fragments; spans a dead worker took with it surface under
+        ``<missing parent>``, which is the point).  No-op when the run
+        had no outage.
+        """
+        worst = (report.get("outages") or {}).get("worst")
+        if not worst or not worst.get("trace_ids"):
+            return
+        trace_id = worst["trace_ids"][0]
+        if self.router is not None:
+            # The router shares this harness's tracer (and process), so
+            # the fan-out already folds the probe spans in alongside
+            # every worker's fragment.
+            tree = await self.router.collect_trace(trace_id)
+        else:
+            tree = traceview.assemble(
+                self.tracer.dump(trace_id=trace_id).get("entries", []),
+                trace_id,
+            )
+        worst["trace_tree"] = tree
+
 
 # ---------------------------------------------------------------------------
 # Named traces
@@ -1483,6 +1519,11 @@ async def run_trace(
             # and the next scenario's windows start from health.
             await harness.settle(params.get("pause_s", 0.5))
         await harness.settle(max(0.2, 5 * params["probe_interval"]))
-        return harness.report(trace_name=trace)
+        report = harness.report(trace_name=trace)
+        # Before stop(): the workers must still be alive to hand their
+        # trace fragments over (ISSUE 13) — the worst-outage entry
+        # carries one ASSEMBLED cross-process tree, not just trace ids.
+        await harness.collect_worst_trace(report)
+        return report
     finally:
         await harness.stop()

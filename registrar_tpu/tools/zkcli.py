@@ -892,7 +892,10 @@ def _span_line(entry) -> str:
 
 async def _cmd_trace(args) -> int:
     """Dump the daemon's flight recorder: ``GET /debug/trace?n=`` off
-    the metrics listener, one line per span/event (ISSUE 8).
+    the metrics listener, one line per span/event (ISSUE 8) — or, with
+    ``--id TRACE_ID``, fetch ONE assembled trace tree (ISSUE 13: across
+    every shard worker when the listener fronts the sharded tier) and
+    pretty-print it as an indented duration tree.
 
     Exit 0 = entries printed, 1 = tracing disabled (no `observability`
     block) or the recorder is empty, 2 = unreachable.  ``--json`` prints
@@ -902,6 +905,47 @@ async def _cmd_trace(args) -> int:
     if endpoint is None:
         return 2
     host, port = endpoint
+    if args.id:
+        from registrar_tpu import traceview
+
+        try:
+            tree = await _metrics_get_json(
+                host, port, f"/debug/trace?id={args.id}", args.timeout
+            )
+        except (OSError, ValueError, asyncio.TimeoutError) as e:
+            print(f"zkcli: trace: {host}:{port}: {e}", file=sys.stderr)
+            return 2
+        if tree.get("error"):
+            print(f"zkcli: trace: {tree['error']}", file=sys.stderr)
+            return 2
+        if "roots" not in tree:
+            # Not an assembled tree (a daemon with custom wiring handed
+            # something else back): a clean exit, never a KeyError.
+            print(
+                "zkcli: trace: the listener did not answer an assembled "
+                "tree for --id (unexpected payload shape)",
+                file=sys.stderr,
+            )
+            return 2
+        if args.json:
+            print(json.dumps(tree, indent=2, default=str))
+            return 0 if tree.get("spans") else 1
+        print(traceview.render_text(tree))
+        for source in tree.get("sources") or ():
+            if source.get("error"):
+                print(
+                    f"zkcli: trace: {source['proc']}: {source['error']} "
+                    "(its spans, if any, are orphaned above)",
+                    file=sys.stderr,
+                )
+        if not tree.get("spans"):
+            print(
+                f"zkcli: trace: no spans recorded for {args.id} (wrong "
+                "id, evicted from the ring, or tracing disabled)",
+                file=sys.stderr,
+            )
+            return 1
+        return 0
     try:
         payload = await _metrics_get_json(
             host, port, f"/debug/trace?n={args.n}", args.timeout
@@ -1143,6 +1187,7 @@ async def _cmd_serve_sharded(args) -> int:
     import signal as signal_mod
 
     from registrar_tpu import metrics as metrics_mod
+    from registrar_tpu import trace as trace_mod
     from registrar_tpu.config import ConfigError, load_config
     from registrar_tpu.shard import ShardRouter
 
@@ -1158,6 +1203,20 @@ async def _cmd_serve_sharded(args) -> int:
             file=sys.stderr,
         )
         return 2
+    # The `observability` block turns on CROSS-PROCESS tracing (ISSUE
+    # 13): the router records shard.relay/shard.trace_collect spans,
+    # every spawned worker gets its own recorder at the same sample
+    # rate, and the wire protocol carries one trace id end to end.
+    # Absent block: not a traced byte anywhere, exactly like the daemon.
+    tracer = None
+    obs = cfg.observability
+    if obs is not None:
+        tracer = trace_mod.Tracer(
+            sample_rate=obs.sample_rate,
+            slow_span_ms=obs.slow_span_ms,
+            max_spans=obs.flight_recorder_spans,
+        )
+        trace_mod.set_tracer(tracer)
     router = ShardRouter(
         cfg.zookeeper.servers,
         cfg.serve.shards,
@@ -1168,6 +1227,15 @@ async def _cmd_serve_sharded(args) -> int:
         timeout_ms=cfg.zookeeper.timeout_ms,
         connect_timeout_ms=cfg.zookeeper.connect_timeout_ms,
         request_timeout_ms=cfg.zookeeper.request_timeout_ms,
+        worker_trace=(
+            {
+                "sampleRate": obs.sample_rate,
+                "maxSpans": obs.flight_recorder_spans,
+                "slowSpanMs": obs.slow_span_ms,
+            }
+            if obs is not None
+            else None
+        ),
     )
     try:
         await router.start()
@@ -1175,6 +1243,8 @@ async def _cmd_serve_sharded(args) -> int:
         print(f"zkcli: serve-sharded: cannot start tier: {e!r}",
               file=sys.stderr)
         await router.stop()
+        if tracer is not None:
+            trace_mod.set_tracer(None)
         return 1
 
     metrics_server = None
@@ -1183,6 +1253,14 @@ async def _cmd_serve_sharded(args) -> int:
         metrics_server = metrics_mod.MetricsServer(
             registry, host=cfg.metrics.host, port=cfg.metrics.port,
             status_provider=router.status,
+            trace_provider=(
+                (lambda n: tracer.dump(n)) if tracer is not None else None
+            ),
+            # GET /debug/trace?id=<trace_id>: the OP_TRACE fan-out —
+            # one assembled tree across router + every worker.
+            trace_tree_provider=(
+                router.collect_trace if tracer is not None else None
+            ),
         )
         try:
             await metrics_server.start()
@@ -1267,6 +1345,8 @@ async def _cmd_serve_sharded(args) -> int:
         if metrics_server is not None:
             await metrics_server.stop()
         await router.stop()
+        if tracer is not None:
+            trace_mod.set_tracer(None)
     return 0
 
 
@@ -1492,7 +1572,10 @@ def _register_commands(sub) -> None:
         "trace",
         help="dump the daemon's flight recorder: GET /debug/trace off "
         "the config's metrics listener, one line per span/event (exit "
-        "0 entries / 1 tracing disabled or empty / 2 unreachable)",
+        "0 entries / 1 tracing disabled or empty / 2 unreachable); "
+        "--id TRACE_ID instead fetches ONE assembled trace tree — "
+        "merged across every shard worker when the listener fronts "
+        "the sharded tier",
     )
     p.add_argument(
         "-f", "--file", required=True, metavar="CONFIG",
@@ -1502,6 +1585,12 @@ def _register_commands(sub) -> None:
     p.add_argument(
         "-n", type=int, default=200,
         help="most recent N entries to fetch (default 200)",
+    )
+    p.add_argument(
+        "--id", default=None, metavar="TRACE_ID",
+        help="assemble and pretty-print ONE trace as a parent tree "
+        "(the 16-hex-digit id from a log line, slo-report.json, or a "
+        "flight-recorder entry)",
     )
     p.add_argument(
         "--json", action="store_true",

@@ -135,6 +135,9 @@ class _NoopSpan:
     def mark(self, _name: str) -> None:
         pass
 
+    def set_mark(self, _name: str, _seconds: float) -> None:
+        pass
+
     def finish(self, status: str = "ok", **attrs) -> None:
         pass
 
@@ -143,6 +146,22 @@ class _NoopSpan:
 
 
 NOOP_SPAN = _NoopSpan()
+
+
+def current_context():
+    """The active span's wire-propagation triple — ``(trace_id,
+    parent_span_id, sampled)`` as ``(int, int, int)`` — or None when no
+    span is active (or tracing is off, since the no-op span carries no
+    ids).  This is THE injection rule for every cross-process boundary:
+    the shard protocol's trace-context block (ISSUE 13) and the
+    health-check env stamps both serialize exactly this triple, so a
+    remote process adopting it chains its spans under the caller's.
+    """
+    sp = _current.get()
+    trace_id = getattr(sp, "trace_id", None)
+    if trace_id is None:
+        return None
+    return (int(trace_id, 16), int(sp.span_id, 16), 1 if sp.sampled else 0)
 
 
 class Span:
@@ -217,6 +236,15 @@ class Span:
             self.marks = {}
         self.marks[name] = time.monotonic() - self.start
 
+    def set_mark(self, name: str, seconds: float) -> None:
+        """Record an externally-measured mark value (seconds).  The
+        shard relay span stamps the WORKER's self-reported handling
+        time this way — a duration another process measured, not an
+        offset on this span's own clock."""
+        if self.marks is None:
+            self.marks = {}
+        self.marks[name] = seconds
+
     def set_attr(self, key: str, value) -> None:
         self.attrs[key] = value
 
@@ -280,6 +308,35 @@ class Span:
         }
 
 
+class _RemoteSpan(Span):
+    """A wire-adopted parent anchor: a span that LIVES in another
+    process, reconstructed here from a propagated ``(trace_id,
+    parent_span_id, sampled)`` triple so local spans chain under it.
+
+    Never recorded (``_done`` is born True — the owning process records
+    the real span), never finished, zero new ids: entering it only makes
+    it the *current* span, so every child created inside inherits the
+    remote trace id and parents to the remote span id.  The assembly
+    layer (:mod:`registrar_tpu.traceview`) then joins the fragments
+    across processes by exactly those ids.
+    """
+
+    def __init__(self, tracer, trace_id: int, span_id: int, sampled: bool):
+        # Deliberately NOT Span.__init__: the ids come off the wire,
+        # nothing here is ever recorded, and the anchor sits on the
+        # traced wire hot path (one per adopted request) — so only the
+        # slots children/start_span/chain actually read are set, and no
+        # clocks are sampled.
+        self.tracer = tracer
+        self.name = "<remote>"
+        self.parent = None
+        self.sampled = sampled
+        self.trace_id = f"{trace_id & 0xFFFFFFFFFFFFFFFF:016x}"
+        self.span_id = f"{span_id & 0xFFFFFFFFFFFFFFFF:016x}"
+        self._token = None
+        self._done = True  # finish() is a no-op; the remote owner records
+
+
 class Tracer:
     """One span factory + flight recorder + sink fan-out.
 
@@ -340,6 +397,17 @@ class Tracer:
     #: Python call per span creation is measurable on the traced hot
     #: path (a new span under the current one, context-manager ready).
     span = start_span
+
+    def adopt(self, trace_id: int, parent_span_id: int, sampled: bool):
+        """Adopt a wire-propagated context (ISSUE 13): returns a
+        context manager making the REMOTE span the current parent, so
+        every span created inside chains under the caller across the
+        process boundary.  ``trace_id``/``parent_span_id`` are the u64
+        ints off the wire (:func:`current_context`'s triple); the
+        remote head-based ``sampled`` verdict is inherited whole — an
+        unsampled remote trace propagates ids but records nothing here
+        either."""
+        return _RemoteSpan(self, trace_id, parent_span_id, bool(sampled))
 
     def event(self, name: str, **attrs) -> None:
         """Record an instantaneous point into the flight recorder.
@@ -404,11 +472,24 @@ class Tracer:
             },
         )
 
-    def dump(self, n: Optional[int] = None) -> Dict[str, Any]:
+    def dump(
+        self, n: Optional[int] = None, trace_id: Optional[str] = None
+    ) -> Dict[str, Any]:
         """The flight recorder's contents, newest last.
 
-        ``n`` bounds to the most recent n entries (None/<=0 = all)."""
+        ``n`` bounds to the most recent n entries (None/<=0 = all);
+        ``trace_id`` keeps only one trace's spans and events (the
+        OP_TRACE collection path — a worker answers exactly the
+        fragment the assembler asked for, not its whole ring)."""
         entries = list(self._ring)
+        if trace_id is not None:
+            entries = [
+                e
+                for e in entries
+                if (
+                    e.trace_id if isinstance(e, Span) else e.get("trace_id")
+                ) == trace_id
+            ]
         if n is not None and n > 0:
             entries = entries[-n:]
         entries = [
@@ -469,7 +550,14 @@ class _DisabledTracer:
     def on_span(self, _sink) -> None:
         pass
 
-    def dump(self, _n: Optional[int] = None) -> Dict[str, Any]:
+    def adopt(self, _trace_id: int, _parent_span_id: int, _sampled: bool):
+        return NOOP_SPAN
+
+    def dump(
+        self,
+        _n: Optional[int] = None,
+        _trace_id: Optional[str] = None,
+    ) -> Dict[str, Any]:
         return {"enabled": False, "entries": []}
 
 

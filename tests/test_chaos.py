@@ -40,6 +40,7 @@ domain; this is the adversarial test of all three at once.
 """
 
 import asyncio
+import json
 import os
 import random
 import sys
@@ -549,8 +550,12 @@ def _dump_flight_recorders(workers) -> None:
     """A failing storm leaves each worker's flight recorder on disk
     (CHAOS_DUMP_DIR, default cwd) — the CI chaos job uploads the dumps
     next to the job summary, so the failure arrives with the span chain
-    already in hand (ISSUE 8 satellite)."""
+    already in hand (ISSUE 8 satellite).  ISSUE 13 rider: the biggest
+    trace across all recorders is additionally ASSEMBLED into
+    ``chaos-worst-trace.txt``/``.json`` next to the per-worker dumps —
+    the merged parent tree, not per-process fragments."""
     out_dir = os.environ.get("CHAOS_DUMP_DIR", ".")
+    entries = []
     for w in workers:
         if w.tracer is None:
             continue
@@ -563,6 +568,39 @@ def _dump_flight_recorders(workers) -> None:
                   file=sys.stderr)
         else:
             print(f"flight recorder dumped: {path}", file=sys.stderr)
+        for entry in w.tracer.dump().get("entries", ()):
+            entry = dict(entry)
+            entry.setdefault("proc", f"worker{w.i}")
+            entries.append(entry)
+    by_trace = {}
+    for entry in entries:
+        tid = entry.get("trace_id")
+        if tid:
+            by_trace[tid] = by_trace.get(tid, 0) + 1
+    if not by_trace:
+        return
+    from registrar_tpu import traceview
+
+    worst_id = max(by_trace, key=by_trace.get)
+    tree = traceview.assemble(entries, worst_id)
+    try:
+        with open(
+            os.path.join(out_dir, "chaos-worst-trace.json"),
+            "w", encoding="utf-8",
+        ) as fh:
+            json.dump(tree, fh, indent=2, default=str)
+        with open(
+            os.path.join(out_dir, "chaos-worst-trace.txt"),
+            "w", encoding="utf-8",
+        ) as fh:
+            fh.write(traceview.render_text(tree) + "\n")
+    except OSError as err:
+        print(f"assembled-trace dump failed: {err!r}", file=sys.stderr)
+    else:
+        print(
+            f"assembled worst trace ({worst_id}, {tree['spans']} spans) "
+            "dumped: chaos-worst-trace.txt", file=sys.stderr,
+        )
 
 
 async def test_chaos_storm_forced_expiry_survived_in_process():

@@ -9,7 +9,18 @@ no server crashes.
 import asyncio
 import random
 
-from hypothesis import given, settings, strategies as st
+import pytest
+
+# The property suite NEEDS hypothesis, but the tier-1 environment does
+# not ship it — skip at collection (one 's' in the report) instead of
+# erroring the whole file, which forced every runner to carry
+# --continue-on-collection-errors forever (ISSUE 13 satellite).
+pytest.importorskip(
+    "hypothesis",
+    reason="hypothesis not installed (property/fuzz suite is opt-in)",
+)
+
+from hypothesis import given, settings, strategies as st  # noqa: E402
 
 from registrar_tpu.records import (
     domain_to_path,

@@ -16,13 +16,15 @@ import time
 
 import pytest
 
-from registrar_tpu import binderview
+from registrar_tpu import binderview, trace, traceview
 from registrar_tpu.registration import register
 from registrar_tpu.shard import (
     OP_RESOLVE,
     OP_STATUS,
+    OP_TRACE,
     STATUS_ERR,
     STATUS_OK,
+    TRACE_FLAG,
     Channel,
     HashRing,
     ShardClient,
@@ -33,6 +35,7 @@ from registrar_tpu.shard import (
     decode_resolution,
     encode_resolution,
     pack_frame,
+    pack_request,
     pack_resolve,
     resolve_name,
 )
@@ -147,6 +150,239 @@ class TestCodecs:
         assert body[0] & 1  # live flag
         frame = pack_frame(7, OP_RESOLVE, body)
         assert int.from_bytes(frame[:4], "big") == len(frame) - 4
+
+
+# ---------------------------------------------------------------------------
+# Trace-context wire extension (ISSUE 13): parity + codec
+# ---------------------------------------------------------------------------
+
+
+class TestTraceWire:
+    #: the PR-12 wire format, pinned BYTE FOR BYTE: an OP_RESOLVE
+    #: request frame for ("web.parity.joyent.us", "A") with req_id 7.
+    #: Tracing off must keep emitting exactly this — a drifted frame
+    #: breaks every already-deployed worker mid-rolling-upgrade.
+    GOLDEN_RESOLVE_FRAME = bytes.fromhex(
+        "0000001c00000007010001417765622e7061726974792e6a6f79656e742e7573"
+    )
+
+    def test_untraced_request_is_byte_identical_to_pr12(self):
+        body = pack_resolve("web.parity.joyent.us", "A")
+        assert pack_frame(7, OP_RESOLVE, body) == self.GOLDEN_RESOLVE_FRAME
+        # pack_request without context IS pack_frame — the codec the
+        # Channel uses cannot drift from the pinned format.
+        assert (
+            pack_request(7, OP_RESOLVE, body) == self.GOLDEN_RESOLVE_FRAME
+        )
+
+    def test_traced_request_gates_context_behind_the_flag_bit(self):
+        body = pack_resolve("web.parity.joyent.us", "A")
+        ctx = (0x0123456789ABCDEF, 0xFEDCBA9876543210, 1)
+        frame = pack_request(7, OP_RESOLVE, body, trace_ctx=ctx)
+        # length prefix grew by exactly the 17-byte context block
+        assert int.from_bytes(frame[:4], "big") == len(
+            self.GOLDEN_RESOLVE_FRAME
+        ) - 4 + 17
+        assert frame[8] == OP_RESOLVE | TRACE_FLAG
+        assert frame[9:17] == (0x0123456789ABCDEF).to_bytes(8, "big")
+        assert frame[17:25] == (0xFEDCBA9876543210).to_bytes(8, "big")
+        assert frame[25] == 1
+        # the body rides after the block, unchanged
+        assert frame[26:] == bytes(body)
+
+    async def test_untraced_reply_carries_no_flag_on_the_raw_socket(
+        self, tmp_path
+    ):
+        """A worker answering an untraced request must emit the plain
+        PR-12 reply — no flag bit, no worker_us block — asserted on the
+        RAW socket (the Channel would strip an extension silently)."""
+        server = await ZKServer().start()
+        client = await ZKClient([server.address]).connect()
+        worker = None
+        try:
+            await register(client, REG, admin_ip="10.6.0.1",
+                           hostname="h1", settle_delay=0)
+            worker = ShardWorker(
+                _worker_spec(server, str(tmp_path / "w.sock"))
+            )
+            await worker.start()
+            reader, writer = await asyncio.open_unix_connection(
+                worker.socket_path
+            )
+            try:
+                writer.write(
+                    pack_frame(3, OP_RESOLVE, pack_resolve(REG["domain"]))
+                )
+                await writer.drain()
+                head = await reader.readexactly(4)
+                frame = await reader.readexactly(
+                    int.from_bytes(head, "big")
+                )
+                assert frame[:4] == (3).to_bytes(4, "big")
+                assert frame[4] == STATUS_OK  # no TRACE_FLAG bit
+                res = decode_resolution(frame[5:])
+                assert [a.data for a in res.answers] == ["10.6.0.1"]
+            finally:
+                writer.close()
+        finally:
+            if worker is not None:
+                await worker.close()
+            await client.close()
+            await server.stop()
+
+    async def test_flagged_frame_too_short_answers_error_not_hang(
+        self, tmp_path
+    ):
+        """A length-valid frame with the TRACE_FLAG bit but a body too
+        short for the 17-byte context block must get a STATUS_ERR reply
+        — a dead handler task would leave the requester (whose future
+        has no timeout) waiting forever."""
+        server = await ZKServer().start()
+        client = await ZKClient([server.address]).connect()
+        worker = None
+        chan = None
+        try:
+            await register(client, REG, admin_ip="10.6.0.1",
+                           hostname="h1", settle_delay=0)
+            worker = ShardWorker(
+                _worker_spec(server, str(tmp_path / "w.sock"))
+            )
+            await worker.start()
+            reader, writer = await asyncio.open_unix_connection(
+                worker.socket_path
+            )
+            try:
+                writer.write(
+                    pack_frame(9, OP_RESOLVE | TRACE_FLAG, b"xx")
+                )
+                await writer.drain()
+                head = await asyncio.wait_for(
+                    reader.readexactly(4), timeout=5
+                )
+                frame = await reader.readexactly(
+                    int.from_bytes(head, "big")
+                )
+                assert frame[:4] == (9).to_bytes(4, "big")
+                assert frame[4] == STATUS_ERR
+                assert b"too short" in frame[5:]
+            finally:
+                writer.close()
+            # ...and the worker survived: a normal request still answers.
+            chan = await Channel.open(worker.socket_path)
+            status, body = await chan.request(
+                OP_RESOLVE, pack_resolve(REG["domain"], "A")
+            )
+            assert status == STATUS_OK and decode_resolution(body).answers
+        finally:
+            if chan is not None:
+                await chan.close()
+            if worker is not None:
+                await worker.close()
+            await client.close()
+            await server.stop()
+
+    async def test_worker_adopts_context_and_reports_duration(
+        self, tmp_path
+    ):
+        """A traced request's resolve subtree chains under the WIRE
+        parent id, OP_TRACE hands the fragment back filtered by trace
+        id, and the reply's worker_us block lands as the caller span's
+        ``worker`` mark."""
+        server = await ZKServer().start()
+        client = await ZKClient([server.address]).connect()
+        worker = None
+        chan = None
+        tracer = trace.Tracer(sample_rate=1.0)
+        try:
+            await register(client, REG, admin_ip="10.6.0.1",
+                           hostname="h1", settle_delay=0)
+            worker = ShardWorker(
+                _worker_spec(server, str(tmp_path / "w.sock"))
+            )
+            await worker.start()
+            # In-process worker: hang one private tracer on every
+            # instrumented layer (the spawned-process path installs a
+            # process-global one from spec["trace"] instead).
+            worker.tracer = tracer
+            worker.cache.tracer = tracer
+            worker.zk.tracer = tracer
+            chan = await Channel.open(worker.socket_path)
+
+            caller = trace.Tracer(sample_rate=1.0)
+            with caller.span("client.call") as sp:
+                ctx = trace.current_context()
+                status, body = await chan.request(
+                    OP_RESOLVE, pack_resolve(REG["domain"], "A"),
+                    trace_ctx=ctx, span=sp,
+                )
+            assert status == STATUS_OK
+            assert decode_resolution(body).answers
+            # the Channel stripped the extension and stamped the mark
+            assert sp.marks is not None and sp.marks["worker"] > 0
+
+            trace_id = sp.trace_id
+            status, body = await chan.request(
+                OP_TRACE, json.dumps({"trace_id": trace_id}).encode()
+            )
+            assert status == STATUS_OK
+            dump = json.loads(bytes(body).decode())
+            assert dump["shard"] == 0 and dump["pid"] == os.getpid()
+            names = {e["name"] for e in dump["entries"]}
+            assert "resolve.query" in names  # cold fill: zk ops too
+            assert "cache.fill" in names and "zk.op" in names
+            for entry in dump["entries"]:
+                assert entry["trace_id"] == trace_id
+            # the subtree parents under the WIRE span id
+            resolve_spans = [
+                e for e in dump["entries"] if e["name"] == "resolve.query"
+            ]
+            assert resolve_spans[0]["parent_id"] == sp.span_id
+            # ...and assembles under the caller with zero orphans
+            tree = traceview.assemble(
+                caller.dump()["entries"] + dump["entries"], trace_id
+            )
+            assert tree["orphans"] == 0
+            assert tree["roots"][0]["name"] == "client.call"
+        finally:
+            if chan is not None:
+                await chan.close()
+            if worker is not None:
+                await worker.close()
+            await client.close()
+            await server.stop()
+
+    async def test_unsampled_context_propagates_but_records_nothing(
+        self, tmp_path
+    ):
+        server = await ZKServer().start()
+        client = await ZKClient([server.address]).connect()
+        worker = None
+        chan = None
+        tracer = trace.Tracer(sample_rate=1.0)
+        try:
+            await register(client, REG, admin_ip="10.6.0.1",
+                           hostname="h1", settle_delay=0)
+            worker = ShardWorker(
+                _worker_spec(server, str(tmp_path / "w.sock"))
+            )
+            await worker.start()
+            worker.tracer = tracer
+            worker.cache.tracer = tracer
+            worker.zk.tracer = tracer
+            chan = await Channel.open(worker.socket_path)
+            status, _body = await chan.request(
+                OP_RESOLVE, pack_resolve(REG["domain"], "A"),
+                trace_ctx=(0x1111, 0x2222, 0),  # sampled=0
+            )
+            assert status == STATUS_OK
+            assert tracer.dump()["entries"] == []
+        finally:
+            if chan is not None:
+                await chan.close()
+            if worker is not None:
+                await worker.close()
+            await client.close()
+            await server.stop()
 
 
 # ---------------------------------------------------------------------------
@@ -560,6 +796,188 @@ async def test_worker_crash_respawn_e2e(tmp_path):
         # metrics rollup saw the respawn; resolves_total stayed monotonic
         respawns = registry.get("registrar_shard_respawns_total")
         assert respawns.value({"shard": str(victim)}) == 1.0
+    finally:
+        if sc is not None:
+            await sc.close()
+        if router is not None:
+            await router.stop()
+        await client.close()
+        await server.stop()
+
+
+async def test_cross_process_trace_e2e(tmp_path):
+    """ISSUE 13 acceptance: ONE resolve through the tier yields ONE
+    merged tree — the caller's root span, the router's shard.relay
+    (with its queue/socket/worker mark split), the owning worker's
+    resolve.query subtree and its zk.op leaves — all on one trace id,
+    assembled across process boundaries.  Then the boundaries move:
+    context still joins across a worker respawn and an in-place
+    reshard (the moved domain's next resolve parents under its NEW
+    owner), and a SIGKILLed worker's lost fragment degrades to a
+    visibly incomplete tree, never a collect failure.
+
+    One consolidated test: every scenario reuses the spawned tier (a
+    worker costs an interpreter start, the file's standing policy)."""
+    from registrar_tpu import metrics as metrics_mod
+
+    server = await ZKServer().start()
+    client = await ZKClient([server.address]).connect()
+    router = None
+    sc = None
+    tracer = trace.Tracer(sample_rate=1.0)
+    try:
+        # Deterministic domain choice that covers both shards of the
+        # 2-ring AND includes at least one domain whose owner changes
+        # under the 3-ring (the reshard-propagation leg needs a mover;
+        # the rings are pure functions, so scan-and-pick is exact).
+        ring2, ring3 = HashRing(range(2)), HashRing(range(3))
+        domains, covered, movers = [], set(), 0
+        for i in range(256):
+            dom = f"svc{i}.traced.joyent.us"
+            is_mover = ring2.owner(dom) != ring3.owner(dom)
+            if len(domains) < 8 or (is_mover and movers < 2):
+                domains.append(dom)
+                covered.add(ring2.owner(dom))
+                movers += is_mover
+            if len(domains) >= 8 and movers >= 2 and len(covered) == 2:
+                break
+        assert movers >= 1 and len(covered) == 2
+        for i, dom in enumerate(domains):
+            await register(
+                client,
+                {
+                    "domain": dom,
+                    "type": "load_balancer",
+                    "service": {
+                        "type": "service",
+                        "service": {
+                            "srvce": "_http", "proto": "_tcp", "port": 80,
+                        },
+                    },
+                },
+                admin_ip=f"10.11.0.{i}", hostname="h0", settle_delay=0,
+            )
+        router = ShardRouter(
+            [server.address], 2, str(tmp_path / "traced.sock"),
+            attach_spread="any", poll_interval_s=0.2,
+            worker_trace={"sampleRate": 1.0, "maxSpans": 2048},
+        )
+        router.tracer = tracer
+        await router.start()
+        registry = metrics_mod.instrument_shards(router)
+        sc = await ShardClient(router.socket_path).connect()
+
+        # --- the headline: one resolve, one tree --------------------------
+        with tracer.span("client.root") as root:
+            res = await sc.resolve(domains[0], "A")
+        assert res.answers
+        owner = router.ring.owner(domains[0])
+        tree = await router.collect_trace(root.trace_id)
+        assert tree["trace_id"] == root.trace_id
+        assert tree["orphans"] == 0
+        assert tree["roots"][0]["name"] == "client.root"
+        relay = tree["roots"][0]["children"][0]
+        assert relay["name"] == "shard.relay"
+        assert relay["attrs"]["shard"] == owner
+        assert relay["proc"] == "router"
+        # the queue/socket/worker split: both marks present
+        assert "forwarded" in relay["marks"] and "worker" in relay["marks"]
+        resolve_node = relay["children"][0]
+        assert resolve_node["name"] == "resolve.query"
+        assert resolve_node["proc"] == f"shard{owner}"
+        # cold fill: the worker's zk.op leaves are in the SAME tree
+        subtree_names = set()
+
+        def walk(node):
+            subtree_names.add(node["name"])
+            for child in node["children"]:
+                walk(child)
+
+        walk(resolve_node)
+        assert "cache.fill" in subtree_names and "zk.op" in subtree_names
+        # the relay histogram observed the hop, labeled by owner
+        relay_hist = registry.get("registrar_shard_relay_seconds")
+        assert relay_hist.count({"shard": str(owner)}) == 1
+        # the front socket serves the SAME assembly (OP_TRACE on the
+        # router), which is what zkcli rides without a metrics listener
+        via_socket = await sc.trace_tree(root.trace_id)
+        assert via_socket["spans"] == tree["spans"]
+
+        # --- context joins across a worker respawn ------------------------
+        handle = router._workers[owner]
+        old_seq = handle.seq
+        router.kill_worker(owner)
+        deadline = time.monotonic() + 20
+        while not (handle.up and handle.seq != old_seq):
+            assert time.monotonic() < deadline, "respawn never landed"
+            await asyncio.sleep(0.05)
+        with tracer.span("client.root") as root2:
+            assert (await sc.resolve(domains[0], "A")).answers
+        tree2 = await router.collect_trace(root2.trace_id)
+        assert tree2["orphans"] == 0
+        relay2 = tree2["roots"][0]["children"][0]
+        assert relay2["children"][0]["name"] == "resolve.query"
+        # the fragment came from the RESPAWNED worker process
+        pids = {
+            s.get("pid") for s in tree2["sources"]
+            if s["proc"] == f"shard{owner}"
+        }
+        assert pids == {handle.proc.pid}
+
+        # --- context joins across an in-place reshard ---------------------
+        old_ring = router.ring
+        await router.reshard(3)
+        moved = old_ring.moved(router.ring, domains)
+        assert moved, "sample too small for a moving domain"
+        dom = moved[0]
+        new_owner = router.ring.owner(dom)
+        assert new_owner != old_ring.owner(dom)
+        with tracer.span("client.root") as root3:
+            assert (await sc.resolve(dom, "A")).answers
+        tree3 = await router.collect_trace(root3.trace_id)
+        relay3 = tree3["roots"][0]["children"][0]
+        assert relay3["attrs"]["shard"] == new_owner
+        resolve3 = relay3["children"][0]
+        assert resolve3["name"] == "resolve.query"
+        assert resolve3["proc"] == f"shard{new_owner}"
+
+        # --- a SIGKILLed worker cannot silently erase the tree ------------
+        router.respawn_enabled = False
+        victim = router.ring.owner(domains[1])
+        router.kill_worker(victim)
+        deadline = time.monotonic() + 10
+        while victim not in router.shards_down():
+            assert time.monotonic() < deadline, "kill never detected"
+            await asyncio.sleep(0.05)
+        with tracer.span("client.root") as root4:
+            with pytest.raises(ShardError):
+                await sc.resolve(domains[1], "A")
+        tree4 = await router.collect_trace(root4.trace_id)
+        # the surviving fragments still assemble — root + the errored
+        # relay — and the dead worker is NAMED in sources
+        assert tree4["roots"][0]["name"] == "client.root"
+        relay4 = tree4["roots"][0]["children"][0]
+        assert relay4["name"] == "shard.relay"
+        assert relay4["status"] == "error"
+        assert any(
+            s["proc"] == f"shard{victim}" and s.get("error")
+            for s in tree4["sources"]
+        )
+
+        # --- orphan assembly: a parent nobody collected -------------------
+        orphan_tree = traceview.assemble(
+            [
+                e
+                for e in (await router.collect_trace(root3.trace_id))[
+                    "roots"
+                ][0]["children"][0]["children"][0:1]
+            ],
+            root3.trace_id,
+        )
+        # the resolve.query fragment alone (its relay parent withheld)
+        # lands under <missing parent> instead of vanishing
+        assert orphan_tree["orphans"] == 1
+        assert orphan_tree["roots"][-1]["name"] == traceview.MISSING_PARENT
     finally:
         if sc is not None:
             await sc.close()

@@ -434,6 +434,34 @@ class TestShardScenarios:
             # The scenario itself asserts zero sibling errors (it
             # raises otherwise); the report carries the evidence.
             assert report["shards"]["slice_errors"] > 0
+
+            # ISSUE 13: the worst-outage entry upgrades from trace IDS
+            # to the ASSEMBLED cross-process tree — collected while the
+            # workers are still alive, so the failing probe's span
+            # chain (slo.probe -> shard.relay, and any worker fragment
+            # that survived) is one tree under one trace id.
+            await harness.collect_worst_trace(report)
+            worst = report["outages"]["worst"]
+            tree = worst["trace_tree"]
+            assert tree is not None
+            assert tree["trace_id"] == worst["trace_ids"][0]
+            assert tree["spans"] >= 1
+            names = set()
+
+            def walk(node):
+                names.add(node["name"])
+                for child in node.get("children", ()):
+                    walk(child)
+
+            for root in tree["roots"]:
+                walk(root)
+            assert "slo.probe" in names
+            # the probe's shard leg crossed the wire: the relay span
+            # (recorded by the router, which shares the harness tracer)
+            # is in the SAME tree
+            assert "shard.relay" in names
+            # every queried process answered or is named in sources
+            assert any(s["proc"] == "router" for s in tree["sources"])
         finally:
             await harness.stop()
 

@@ -1,0 +1,361 @@
+"""Cross-process trace assembly (ISSUE 13): traceview's tree builder
+and renderer, the wire-context propagation primitives in trace.py, the
+health-check env stamps, and GET /debug/trace?id= on the metrics
+listener.  The shard protocol's end of the feature (wire parity,
+adoption, OP_TRACE collection) lives in tests/test_shard.py.
+"""
+
+import asyncio
+import json
+
+from registrar_tpu import trace, traceview
+
+
+def _span(
+    name,
+    span_id,
+    parent_id=None,
+    trace_id="aa" * 8,
+    t=1.0,
+    duration_ms=1.0,
+    **extra,
+):
+    return {
+        "kind": "span",
+        "name": name,
+        "trace_id": trace_id,
+        "span_id": span_id,
+        "parent_id": parent_id,
+        "time": t,
+        "duration_ms": duration_ms,
+        "status": "ok",
+        "attrs": {},
+        "marks": {},
+        **extra,
+    }
+
+
+class TestAssemble:
+    def test_parent_tree_across_fragments(self):
+        # Three "processes" dumped separately: the caller's root, the
+        # router's relay, the worker's resolve+zk.op — one tree.
+        entries = [
+            _span("slo.probe", "s1", None, t=1.0),
+            _span("shard.relay", "s2", "s1", t=1.1, proc="router"),
+            _span("resolve.query", "s3", "s2", t=1.2, proc="shard0"),
+            _span("zk.op", "s4", "s3", t=1.3, proc="shard0"),
+            _span("zk.op", "s5", "s3", t=1.25, proc="shard0"),
+        ]
+        tree = traceview.assemble(entries, "aa" * 8)
+        assert tree["spans"] == 5
+        assert tree["orphans"] == 0
+        assert len(tree["roots"]) == 1
+        root = tree["roots"][0]
+        assert root["name"] == "slo.probe"
+        relay = root["children"][0]
+        assert relay["name"] == "shard.relay"
+        resolve = relay["children"][0]
+        assert resolve["name"] == "resolve.query"
+        # children are time-ordered
+        assert [c["span_id"] for c in resolve["children"]] == ["s5", "s4"]
+
+    def test_other_traces_and_duplicates_excluded(self):
+        entries = [
+            _span("a", "s1", None),
+            _span("a-dup", "s1", None),  # same span id: first wins
+            _span("other", "x1", None, trace_id="bb" * 8),
+        ]
+        tree = traceview.assemble(entries, "aa" * 8)
+        assert tree["spans"] == 1
+        assert tree["roots"][0]["name"] == "a"
+
+    def test_orphans_attach_under_missing_parent(self):
+        # The parent lived in a process that crashed before handing its
+        # fragment over: the surviving subtree must NOT vanish.
+        entries = [
+            _span("resolve.query", "s3", "gone", t=1.0),
+            _span("zk.op", "s4", "s3", t=1.1),
+        ]
+        tree = traceview.assemble(entries, "aa" * 8)
+        assert tree["orphans"] == 1
+        assert tree["roots"][-1]["name"] == traceview.MISSING_PARENT
+        assert tree["roots"][-1]["synthetic"] is True
+        orphan = tree["roots"][-1]["children"][0]
+        assert orphan["name"] == "resolve.query"
+        # ...and its own child still chains normally beneath it.
+        assert orphan["children"][0]["name"] == "zk.op"
+
+    def test_events_ride_along_in_time_order(self):
+        entries = [
+            _span("a", "s1", None),
+            {"kind": "event", "name": "slo.fault", "time": 2.0,
+             "trace_id": "aa" * 8, "attrs": {"fault": "shard-kill"}},
+            {"kind": "event", "name": "cache.invalidated", "time": 1.0,
+             "trace_id": "aa" * 8, "attrs": {}},
+            {"kind": "event", "name": "foreign", "time": 1.5,
+             "trace_id": "bb" * 8, "attrs": {}},
+        ]
+        tree = traceview.assemble(entries, "aa" * 8)
+        assert tree["events"] == 2
+        assert [e["name"] for e in tree["events_list"]] == [
+            "cache.invalidated", "slo.fault",
+        ]
+
+    def test_render_text_shows_structure_and_orphans(self):
+        entries = [
+            _span("slo.probe", "s1", None, t=1.0, duration_ms=5.5),
+            _span(
+                "shard.relay", "s2", "s1", t=1.1, proc="router",
+                marks={"forwarded": 0.1, "worker": 1.2},
+            ),
+            _span("resolve.query", "s9", "gone", t=1.2, proc="shard1"),
+        ]
+        text = traceview.render_text(traceview.assemble(entries, "aa" * 8))
+        assert "slo.probe  5.500ms  [ok]" in text
+        assert "@router" in text
+        assert "forwarded=0.1ms" in text and "worker=1.2ms" in text
+        assert traceview.MISSING_PARENT in text
+        # indentation: the relay is one level under the probe
+        probe_line = next(l for l in text.splitlines() if "slo.probe" in l)
+        relay_line = next(l for l in text.splitlines() if "shard.relay" in l)
+        assert len(relay_line) - len(relay_line.lstrip()) > (
+            len(probe_line) - len(probe_line.lstrip())
+        )
+
+    def test_worst_span_ms(self):
+        entries = [
+            _span("a", "s1", None, duration_ms=2.0),
+            _span("b", "s2", "s1", duration_ms=7.25),
+        ]
+        tree = traceview.assemble(entries, "aa" * 8)
+        assert traceview.worst_span_ms(tree) == 7.25
+        assert traceview.worst_span_ms(
+            traceview.assemble([], "aa" * 8)
+        ) is None
+
+
+class TestWireContext:
+    """trace.current_context() + Tracer.adopt(): the propagation
+    primitives every cross-process boundary rides."""
+
+    def test_no_active_span_is_none(self):
+        assert trace.current_context() is None
+
+    def test_noop_span_carries_no_context(self):
+        with trace.DISABLED.span("resolve.query"):
+            assert trace.current_context() is None
+
+    def test_context_round_trips_through_adopt(self):
+        t = trace.Tracer(sample_rate=1.0)
+        with t.span("slo.probe") as root:
+            ctx = trace.current_context()
+        assert ctx == (int(root.trace_id, 16), int(root.span_id, 16), 1)
+        # "Another process": a fresh tracer adopting the triple.
+        remote = trace.Tracer(sample_rate=1.0)
+        with remote.adopt(*ctx):
+            with remote.span("resolve.query") as child:
+                pass
+        assert child.trace_id == root.trace_id
+        assert child.parent_id == root.span_id
+        assert child.sampled is True
+        # ...and the two recorders' fragments assemble into one tree.
+        entries = (
+            t.dump(trace_id=root.trace_id)["entries"]
+            + remote.dump(trace_id=root.trace_id)["entries"]
+        )
+        tree = traceview.assemble(entries, root.trace_id)
+        assert tree["orphans"] == 0
+        assert tree["roots"][0]["name"] == "slo.probe"
+        assert tree["roots"][0]["children"][0]["name"] == "resolve.query"
+
+    def test_unsampled_verdict_is_inherited_whole(self):
+        t = trace.Tracer(sample_rate=1.0)
+        with t.adopt(0x1234, 0x5678, 0):
+            with t.span("resolve.query") as child:
+                pass
+        assert child.sampled is False
+        assert child.trace_id == f"{0x1234:016x}"
+        assert t.dump()["entries"] == []  # nothing recorded
+
+    def test_adopted_parent_is_never_recorded_locally(self):
+        t = trace.Tracer(sample_rate=1.0)
+        with t.adopt(0x1, 0x2, 1):
+            pass
+        assert t.dump()["entries"] == []
+
+    def test_dump_filters_by_trace_id(self):
+        t = trace.Tracer(sample_rate=1.0)
+        with t.span("a") as a:
+            t.event("cache.invalidated", path="/x")
+        with t.span("b") as b:
+            pass
+        only_a = t.dump(trace_id=a.trace_id)["entries"]
+        assert {e["name"] for e in only_a} == {"a", "cache.invalidated"}
+        assert all(e["trace_id"] == a.trace_id for e in only_a)
+        assert {e["name"] for e in t.dump(trace_id=b.trace_id)["entries"]} == {
+            "b"
+        }
+
+    def test_disabled_tracer_adopt_is_noop(self):
+        with trace.DISABLED.adopt(0x1, 0x2, 1) as sp:
+            assert sp is trace.NOOP_SPAN
+            assert trace.current_context() is None
+
+
+class TestHealthTraceEnv:
+    """health.exec stamps REGISTRAR_TRACE_ID/REGISTRAR_SPAN_ID into the
+    check command's environment (ISSUE 13) — and ONLY while traced."""
+
+    async def test_env_stamped_while_traced(self, tmp_path):
+        from registrar_tpu.health import HealthCheck
+
+        out = tmp_path / "env.txt"
+        hc = HealthCheck(
+            command=(
+                f'echo "$REGISTRAR_TRACE_ID $REGISTRAR_SPAN_ID" > {out}'
+            ),
+            interval=60, timeout=5,
+        )
+        hc.tracer = trace.Tracer(sample_rate=1.0)
+        await hc.check_once()
+        (span,) = [
+            e for e in hc.tracer.dump()["entries"]
+            if e["name"] == "health.exec"
+        ]
+        stamped_trace, stamped_span = out.read_text().split()
+        assert stamped_trace == span["trace_id"]
+        assert stamped_span == span["span_id"]
+
+    async def test_env_untouched_when_tracing_off(self, tmp_path):
+        from registrar_tpu.health import HealthCheck
+
+        out = tmp_path / "env.txt"
+        hc = HealthCheck(
+            command=(
+                f'echo "${{REGISTRAR_TRACE_ID-unset}}" > {out}'
+            ),
+            interval=60, timeout=5,
+        )
+        await hc.check_once()
+        assert out.read_text().strip() == "unset"
+
+
+class TestDebugTraceById:
+    """GET /debug/trace?id= on the metrics listener: the assembled-tree
+    endpoint (async provider), coexisting with the ?n= raw-ring view."""
+
+    async def _get(self, port, path):
+        reader, writer = await asyncio.open_connection("127.0.0.1", port)
+        try:
+            writer.write(f"GET {path} HTTP/1.0\r\n\r\n".encode())
+            await writer.drain()
+            raw = await asyncio.wait_for(reader.read(), timeout=5)
+        finally:
+            writer.close()
+        head, _, body = raw.partition(b"\r\n\r\n")
+        return head.split()[1].decode(), json.loads(body)
+
+    async def test_id_routes_to_tree_provider(self):
+        from registrar_tpu import metrics as metrics_mod
+
+        t = trace.Tracer(sample_rate=1.0)
+        with t.span("resolve.query") as sp:
+            pass
+
+        async def tree_provider(trace_id):
+            return traceview.assemble(
+                t.dump(trace_id=trace_id)["entries"], trace_id
+            )
+
+        server = metrics_mod.MetricsServer(
+            metrics_mod.MetricsRegistry(),
+            trace_provider=lambda n: t.dump(n),
+            trace_tree_provider=tree_provider,
+        )
+        await server.start()
+        try:
+            status, tree = await self._get(
+                server.port, f"/debug/trace?id={sp.trace_id}"
+            )
+            assert status == "200"
+            assert tree["trace_id"] == sp.trace_id
+            assert tree["spans"] == 1
+            assert tree["roots"][0]["name"] == "resolve.query"
+            # ?n= still serves the raw ring alongside
+            status, ring = await self._get(server.port, "/debug/trace?n=5")
+            assert status == "200"
+            assert ring["enabled"] is True and ring["entries"]
+        finally:
+            await server.stop()
+
+    async def test_provider_error_answers_json_not_500(self):
+        from registrar_tpu import metrics as metrics_mod
+
+        async def exploding(trace_id):
+            raise RuntimeError("worker unreachable")
+
+        server = metrics_mod.MetricsServer(
+            metrics_mod.MetricsRegistry(),
+            trace_tree_provider=exploding,
+        )
+        await server.start()
+        try:
+            status, payload = await self._get(
+                server.port, "/debug/trace?id=deadbeef"
+            )
+            assert status == "200"
+            assert "worker unreachable" in payload["error"]
+        finally:
+            await server.stop()
+
+
+class TestZkcliTraceId:
+    """zkcli trace --id renders the assembled tree off the listener."""
+
+    async def test_trace_id_renders_tree(self, tmp_path, capsys):
+        from registrar_tpu import metrics as metrics_mod
+        from registrar_tpu.tools import zkcli as zkcli_mod
+
+        t = trace.Tracer(sample_rate=1.0)
+        with t.span("shard.relay", shard=1) as relay:
+            with t.span("resolve.query", qtype="A"):
+                pass
+
+        async def tree_provider(trace_id):
+            return traceview.assemble(
+                t.dump(trace_id=trace_id)["entries"], trace_id
+            )
+
+        server = metrics_mod.MetricsServer(
+            metrics_mod.MetricsRegistry(),
+            trace_tree_provider=tree_provider,
+        )
+        await server.start()
+        try:
+            cfg = tmp_path / "cfg.json"
+            cfg.write_text(json.dumps({
+                "registration": {"domain": "a.b.c", "type": "host"},
+                "zookeeper": {
+                    "servers": [{"host": "127.0.0.1", "port": 1}]
+                },
+                "metrics": {"port": server.port},
+            }))
+
+            class Args:
+                file = str(cfg)
+                id = relay.trace_id
+                json = False
+                n = 200
+                timeout = 5.0
+
+            rc = await zkcli_mod._cmd_trace(Args())
+            out = capsys.readouterr().out
+            assert rc == 0
+            assert relay.trace_id in out
+            assert "shard.relay" in out and "resolve.query" in out
+
+            # An unknown id exits 1 (nothing recorded), not 0.
+            Args.id = "00" * 8
+            assert await zkcli_mod._cmd_trace(Args()) == 1
+        finally:
+            await server.stop()

@@ -985,6 +985,9 @@ class TestServeSharded:
                         "attachSpread": "any",
                     },
                     "metrics": {"port": port},
+                    # ISSUE 13: cross-process tracing across the whole
+                    # tier — router relay spans + per-worker recorders.
+                    "observability": {"sampleRate": 1.0},
                 }))
 
             write_cfg(2)
@@ -1025,17 +1028,69 @@ class TestServeSharded:
             assert snapshot["uptime_s"] is not None
             assert "serve" in snapshot["last_transition"]
 
-            # The tier answers through its front socket.
+            # The tier answers through its front socket — and the ONE
+            # traced resolve is the ISSUE-13 acceptance resolve below.
+            from registrar_tpu import trace as trace_mod
             from registrar_tpu.shard import ShardClient
 
+            tracer = trace_mod.Tracer(sample_rate=1.0)
             sc = await ShardClient(
                 str(tmp_path / "resolve.sock")
             ).connect()
             try:
-                res = await sc.resolve("cli.test.us", "A")
+                with tracer.span("client.request") as root:
+                    res = await sc.resolve("cli.test.us", "A")
                 assert [a.data for a in res.answers] == ["10.5.5.5"]
             finally:
                 await sc.close()
+
+            # ISSUE 13 acceptance: GET /debug/trace?id= off the metrics
+            # listener assembles ONE merged tree — router relay span,
+            # the owning worker's resolve/cache subtree, and its zk.op
+            # spans — all sharing the client's trace id.
+            def fetch_tree():
+                with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/debug/trace"
+                    f"?id={root.trace_id}", timeout=5
+                ) as resp:
+                    return json.loads(resp.read())
+
+            tree = await asyncio.to_thread(fetch_tree)
+            assert tree["trace_id"] == root.trace_id
+            names_by_proc = set()
+
+            def walk(node):
+                names_by_proc.add((node["name"], node.get("proc")))
+                for child in node.get("children", ()):
+                    walk(child)
+
+            for tree_root in tree["roots"]:
+                walk(tree_root)
+            names = {n for n, _p in names_by_proc}
+            assert "shard.relay" in names
+            assert "resolve.query" in names
+            assert "cache.fill" in names and "zk.op" in names
+            worker_procs = {
+                p for n, p in names_by_proc if n == "resolve.query"
+            }
+            assert worker_procs and all(
+                p and p.startswith("shard") for p in worker_procs
+            )
+            # the client's root span was not collected (it lives in
+            # THIS process) — the relay surfaces under <missing
+            # parent> instead of vanishing, per the orphan convention
+            from registrar_tpu import traceview
+
+            assert tree["orphans"] >= 1
+            assert tree["roots"][-1]["name"] == traceview.MISSING_PARENT
+
+            # ...and `zkcli trace --id` renders the same tree.
+            out = _run_tool("trace", "-f", str(cfg), "--id", root.trace_id)
+            assert out.returncode == 0, out.stderr
+            assert "shard.relay" in out.stdout
+            assert "resolve.query" in out.stdout
+            assert "zk.op" in out.stdout
+            assert root.trace_id in out.stdout
 
             # zkcli status understands the sharded shape: healthy -> 0.
             out = _run_tool("status", "-f", str(cfg))

@@ -123,6 +123,48 @@ def _run(trace: str, seed: int, repair: bool) -> dict:
     )
 
 
+def _write_worst_trace(report: dict, report_path: str) -> None:
+    """Dump the worst outage's ASSEMBLED cross-process trace tree next
+    to the report (ISSUE 13): ``<report>.worst-trace.json`` (the raw
+    tree — probe span, router relay, the owning worker's resolve
+    subtree, one trace id) and ``.txt`` (the indented duration render,
+    ``zkcli trace --id``'s view).  ``make slo-quick`` writes these by
+    default and the CI SLO job uploads them with the report, so a bad
+    nines number arrives with its causal tree attached."""
+    from registrar_tpu import traceview
+
+    tree = ((report.get("outages") or {}).get("worst") or {}).get(
+        "trace_tree"
+    )
+    base = (
+        report_path[: -len(".json")]
+        if report_path.endswith(".json")
+        else report_path
+    )
+    if not tree:
+        # A flawless run has no worst outage to dissect — and must not
+        # leave a PREVIOUS run's tree sitting next to the fresh report
+        # (an always() artifact step would upload the mismatched pair).
+        for suffix in (".worst-trace.json", ".worst-trace.txt"):
+            try:
+                os.remove(base + suffix)
+            except OSError:
+                pass
+        return
+    with open(f"{base}.worst-trace.json", "w", encoding="utf-8") as fh:
+        json.dump(tree, fh, indent=2, default=str)
+        fh.write("\n")
+    with open(f"{base}.worst-trace.txt", "w", encoding="utf-8") as fh:
+        fh.write(traceview.render_text(tree))
+        fh.write("\n")
+    print(
+        f"slo: worst-outage trace tree written to {base}.worst-trace.json "
+        f"/ .txt ({tree.get('spans', 0)} spans, "
+        f"{tree.get('orphans', 0)} orphaned)",
+        file=sys.stderr,
+    )
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(
         prog="slo", description="availability-SLO trace runner + gate"
@@ -232,6 +274,7 @@ def main(argv=None) -> int:
             json.dump(report, fh, indent=2)
             fh.write("\n")
         print(f"slo: report written to {args.report}", file=sys.stderr)
+        _write_worst_trace(report, args.report)
     print(_summary_line(report))
 
     failures = []
