@@ -876,16 +876,19 @@ async def test_chaos_storm_forced_expiry_survived_in_process():
             # equality (the cache client survived every expiry too).
             expected = sorted(w.admin_ip for w in workers)
             deadline = asyncio.get_running_loop().time() + 30
+            last = None  # every resolve may raise: "no answer yet" must
+            # still render in the timeout message, not UnboundLocalError
             while True:
                 try:
                     cres = await binderview.resolve(cache, DOMAIN, "A")
-                    if sorted(a.data for a in cres.answers) == expected:
+                    last = sorted(a.data for a in cres.answers)
+                    if last == expected:
                         break
-                except (ZKError, ConnectionError, OSError):
-                    pass
+                except (ZKError, ConnectionError, OSError) as err:
+                    last = repr(err)
                 assert asyncio.get_running_loop().time() < deadline, (
                     "cached view never converged after the expiry storm "
-                    f"(last={sorted(a.data for a in cres.answers)!r})"
+                    f"(last={last!r})"
                 )
                 await asyncio.sleep(0.05)
             assert not cache_client.closed

@@ -397,6 +397,105 @@ PROGRAM_SEEDED_VIOLATIONS = {
             Alert when `registrar_heartbeats_total` stops increasing.
             """,
     },
+    # --- generation 4 (ISSUE 15) ---------------------------------------------
+    "lock-order-cycle": {
+        "registrar_tpu/agent.py": """\
+            import asyncio
+
+            repair_lock = asyncio.Lock()
+            state_lock = asyncio.Lock()
+
+
+            async def repair():
+                async with repair_lock:
+                    await _flush()
+
+
+            async def _flush():
+                async with state_lock:
+                    pass
+
+
+            async def snapshot():
+                async with state_lock:
+                    async with repair_lock:
+                        pass
+            """,
+    },
+    "zk-op-under-lock": {
+        "registrar_tpu/agent.py": """\
+            import asyncio
+
+            from registrar_tpu.zk.client import connect_with_backoff
+
+            repair_lock = asyncio.Lock()
+
+
+            async def reconnect_and_repair(zk):
+                async with repair_lock:
+                    await connect_with_backoff(zk)
+            """,
+        "registrar_tpu/zk/client.py": """\
+            async def connect_with_backoff(zk):
+                await zk.connect()
+                return zk
+            """,
+    },
+    "leaked-resource": {
+        "registrar_tpu/netem.py": """\
+            class ChaosProxy:
+                def __init__(self, addr):
+                    self.addr = addr
+
+                async def start(self):
+                    return self
+
+                async def stop(self):
+                    self.addr = None
+
+
+            async def probe(addr):
+                proxy = await ChaosProxy(addr).start()
+                return addr
+            """,
+    },
+    "span-never-finished": {
+        "registrar_tpu/probe.py": """\
+            def sample(tracer):
+                span = tracer.start_span("probeop")
+                return 7
+            """,
+    },
+    "struct-format-drift": {
+        "registrar_tpu/shard.py": """\
+            import struct
+
+            _HDR = struct.Struct(">IB")
+
+
+            def parse(buf):
+                req_id, op, extra = _HDR.unpack(buf)
+                return req_id, op, extra
+            """,
+    },
+    "opcode-dispatch-drift": {
+        "registrar_tpu/shard.py": """\
+            OP_RESOLVE = 1
+            OP_STATUS = 2
+
+
+            def dispatch(op):
+                if op == OP_RESOLVE:
+                    return "resolve"
+                return None
+            """,
+    },
+    "flag-bit-overlap": {
+        "registrar_tpu/shard.py": """\
+            TRACE_FLAG = 0x80
+            PRIORITY_FLAG = 0xC0
+            """,
+    },
 }
 
 EXPECTED_RULES = sorted(
@@ -2544,6 +2643,167 @@ def test_new_rule_baseline_round_trip(rule, tmp_path):
     assert proc.returncode == 0, proc.stdout + proc.stderr
 
 
+# --- generation 4: locks, lifecycles, wire contracts (ISSUE 15) --------------
+
+
+def test_lock_order_cycle_chains_in_json_and_sarif(tmp_path):
+    tree = seed_program_tree(
+        tmp_path, PROGRAM_SEEDED_VIOLATIONS["lock-order-cycle"]
+    )
+    proc = run_checker(
+        "registrar_tpu", "--no-baseline", "--format", "json", cwd=tree
+    )
+    assert proc.returncode == 1
+    (finding,) = json.loads(proc.stdout)["problems"]
+    assert finding["rule"] == "lock-order-cycle"
+    # BOTH acquisition orders ride along as one concatenated evidence
+    # chain: the interprocedural repair->_flush side and the lexical
+    # snapshot inversion
+    symbols = [h["symbol"] for h in finding["chain"]]
+    assert "async with repair_lock" in symbols
+    assert "async with state_lock" in symbols
+    assert "registrar_tpu.agent:_flush" in symbols
+    assert "registrar_tpu.agent:snapshot" in symbols
+    assert all(
+        set(h) == {"symbol", "path", "line"}
+        and h["path"] == "registrar_tpu/agent.py"
+        and h["line"] > 0
+        for h in finding["chain"]
+    )
+    # one names-only chain per side of the inversion in the message
+    assert " vs " in finding["message"]
+    assert "repair_lock -> state_lock -> repair_lock" in finding["message"]
+    # the same hops, in order, in the SARIF codeFlow
+    proc = run_checker(
+        "registrar_tpu", "--no-baseline", "--format", "sarif", cwd=tree
+    )
+    assert proc.returncode == 1
+    (result,) = json.loads(proc.stdout)["runs"][0]["results"]
+    assert result["ruleId"] == "lock-order-cycle"
+    (flow,) = result["codeFlows"]
+    (thread,) = flow["threadFlows"]
+    assert [
+        h["location"]["message"]["text"] for h in thread["locations"]
+    ] == symbols
+
+
+def test_lock_diamond_consistent_order_has_no_cycle(tmp_path):
+    # Two paths (one lexical, one through a helper) both take
+    # alpha -> beta: an edge, but no inversion — conservative silence.
+    from checklib.locks import lockgraph_for
+
+    model, _ = _flow_for_tree(tmp_path, {
+        "registrar_tpu/agent.py": """\
+            import asyncio
+
+            alpha_lock = asyncio.Lock()
+            beta_lock = asyncio.Lock()
+
+
+            async def left():
+                async with alpha_lock:
+                    await _inner()
+
+
+            async def right():
+                async with alpha_lock:
+                    async with beta_lock:
+                        pass
+
+
+            async def _inner():
+                async with beta_lock:
+                    pass
+            """,
+    })
+    lg = lockgraph_for(model)
+    assert (
+        "registrar_tpu.agent:alpha_lock",
+        "registrar_tpu.agent:beta_lock",
+    ) in lg.edges
+    assert lg.cycles() == []
+
+
+def test_lifecycle_ownership_transfer_is_exempt(tmp_path):
+    # `return proxy` hands the handle to the caller: the callee is no
+    # longer responsible for releasing it.
+    from checklib.lifecycle import lifecycle_for
+
+    model, _ = _flow_for_tree(tmp_path, {
+        "registrar_tpu/netem.py": """\
+            class ChaosProxy:
+                async def start(self):
+                    return self
+
+                async def stop(self):
+                    pass
+
+
+            async def build(addr):
+                proxy = await ChaosProxy(addr).start()
+                return proxy
+            """,
+    })
+    assert lifecycle_for(model).findings["leaked-resource"] == []
+
+
+def test_lifecycle_cm_bound_resource_is_exempt(tmp_path):
+    # `async with ChaosProxy(...)` — the context manager owns release.
+    from checklib.lifecycle import lifecycle_for
+
+    model, _ = _flow_for_tree(tmp_path, {
+        "registrar_tpu/netem.py": """\
+            class ChaosProxy:
+                async def stop(self):
+                    pass
+
+
+            async def probe(addr):
+                async with ChaosProxy(addr) as proxy:
+                    return addr
+            """,
+    })
+    assert lifecycle_for(model).findings["leaked-resource"] == []
+
+
+def test_lifecycle_escape_path_leak_fires(tmp_path):
+    # A release EXISTS but sits on the straight-line path, not in a
+    # finally: the named escape between acquire and release leaks the
+    # handle, and the evidence chain walks acquire -> raise origin.
+    from checklib.lifecycle import lifecycle_for
+
+    model, _ = _flow_for_tree(tmp_path, {
+        "registrar_tpu/netem.py": """\
+            class ChaosProxy:
+                async def start(self):
+                    return self
+
+                async def stop(self):
+                    pass
+
+
+            class RegistrarError(Exception):
+                pass
+
+
+            def risky():
+                raise RegistrarError("boom")
+
+
+            async def probe(addr):
+                proxy = await ChaosProxy(addr).start()
+                risky()
+                await proxy.stop()
+            """,
+    })
+    (finding,) = lifecycle_for(model).findings["leaked-resource"]
+    assert finding.path == "registrar_tpu/netem.py"
+    assert "RegistrarError" in finding.message
+    assert "no release sits in a finally" in finding.message
+    symbols = [hop["symbol"] for hop in finding.chain]
+    assert symbols[0] == "proxy = ChaosProxy(...)"
+
+
 # --- SARIF output ------------------------------------------------------------
 
 
@@ -2690,6 +2950,19 @@ def test_changed_only_clean_when_nothing_changed(tmp_path):
     assert proc.returncode == 0, proc.stdout + proc.stderr
 
 
+def test_changed_only_doc_only_diff_is_a_noop(tmp_path):
+    # The diff touches no checked file: the run short-circuits before
+    # parsing anything — exit 0 and an explicit --stats note, even
+    # though a full run WOULD report the seeded violations.
+    tree = seed_changed_only_tree(tmp_path)
+    (tree / "NOTES.md").write_text("release notes\n")
+    proc = run_changed_only(tree, "--stats")
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "analysis skipped" in proc.stderr
+    assert "mutable-default" not in proc.stdout
+    assert "dead-event-name" not in proc.stdout
+
+
 def test_check_file_exempts_program_rule_suppressions():
     # check_file runs file rules only; a suppression the FULL gate
     # requires (main.py's drain-walk await-in-lock-free-mutator opt-out)
@@ -2733,14 +3006,24 @@ def test_stats_summary_and_json_stats(tmp_path):
     assert proc.returncode == 0, proc.stdout + proc.stderr
     assert "check --stats:" in proc.stderr
     assert "modules" in proc.stderr
+    # the generation-4 fixpoints report their own phases (ISSUE 15)
+    assert "lock graph " in proc.stderr
+    assert "lifecycle fixpoint " in proc.stderr
     proc = run_checker(
         "registrar_tpu", "--no-baseline", "--format", "json", cwd=tree
     )
     report = json.loads(proc.stdout)
     stats = report["stats"]
     assert stats["program"]["modules"] == 1
+    for key in (
+        "lock_sites", "lock_edges", "lock_build_s",
+        "lifecycle_tracked", "lifecycle_build_s",
+    ):
+        assert key in stats["program"], key
     assert "elapsed_s" in stats
     assert set(stats["program_rules_s"]) == set(PROGRAM_SEEDED_VIOLATIONS)
+    # the CI digest's per-generation rollup has all four generations
+    assert set(stats["rule_generations"]) >= {"1", "2", "3", "4"}
 
 
 def test_max_seconds_budget_fails_gate(tmp_path):

@@ -55,11 +55,23 @@ REG = {
 
 
 async def _proxied_pair(seed=7, sock_buf=None, **client_kw):
+    # Cleanup-on-failure: once this returns, the CALLER owns all three
+    # handles — but a proxy/connect failure mid-build must not leak the
+    # pieces already started (the lifecycle smell ISSUE 15 is about).
     server = await ZKServer().start()
-    proxy = await ChaosProxy(server.address, seed=seed, sock_buf=sock_buf).start()
-    client_kw.setdefault("reconnect_policy", FAST)
-    client_kw.setdefault("connect_timeout_ms", 500)
-    client = await ZKClient([proxy.address], **client_kw).connect()
+    proxy = None
+    try:
+        proxy = await ChaosProxy(
+            server.address, seed=seed, sock_buf=sock_buf
+        ).start()
+        client_kw.setdefault("reconnect_policy", FAST)
+        client_kw.setdefault("connect_timeout_ms", 500)
+        client = await ZKClient([proxy.address], **client_kw).connect()
+    except BaseException:
+        if proxy is not None:
+            await proxy.stop()
+        await server.stop()
+        raise
     return server, proxy, client
 
 
@@ -174,10 +186,12 @@ class TestOperationDeadline:
         # fire, tear the connection down, and the reconnect must recover
         # the session.
         server = await ZKServer().start()
-        client = await ZKClient(
-            [server.address], request_timeout_ms=300, reconnect_policy=FAST,
-        ).connect()
+        client = None
         try:
+            client = await ZKClient(
+                [server.address], request_timeout_ms=300,
+                reconnect_policy=FAST,
+            ).connect()
             await client.create("/dl", b"", CreateFlag.EPHEMERAL)
             server.freeze = True
             t0 = time.monotonic()
@@ -192,17 +206,20 @@ class TestOperationDeadline:
             st = await client.stat("/dl")
             assert st.ephemeral_owner == client.session_id
         finally:
-            await client.close()
+            if client is not None:
+                await client.close()
             await server.stop()
 
     async def test_pipelined_ops_share_the_deadline(self):
         # get_many/heartbeat ride one corked burst; the deadline must
         # bound the gathered replies, not just single _call ops.
         server = await ZKServer().start()
-        client = await ZKClient(
-            [server.address], request_timeout_ms=300, reconnect_policy=FAST,
-        ).connect()
+        client = None
         try:
+            client = await ZKClient(
+                [server.address], request_timeout_ms=300,
+                reconnect_policy=FAST,
+            ).connect()
             await client.create("/p1", b"a")
             await client.create("/p2", b"b")
             server.freeze = True
@@ -217,7 +234,8 @@ class TestOperationDeadline:
                 )
         finally:
             server.freeze = False
-            await client.close()
+            if client is not None:
+                await client.close()
             await server.stop()
 
 
@@ -274,12 +292,13 @@ class TestBlackhole:
         # entry's own connect_timeout_ms would allow far more.
         server = await ZKServer().start()
         proxies = []
-        for i in range(3):
-            p = await ChaosProxy(server.address, seed=i).start()
-            p.add(Blackhole(), direction=UP)
-            p.add(Blackhole(), direction=DOWN)
-            proxies.append(p)
         try:
+            for i in range(3):
+                p = await ChaosProxy(server.address, seed=i).start()
+                proxies.append(p)  # before add(): a later failure still
+                # finds this proxy in the teardown list
+                p.add(Blackhole(), direction=UP)
+                p.add(Blackhole(), direction=DOWN)
             client = ZKClient(
                 [p.address for p in proxies],
                 connect_timeout_ms=10_000,       # per-candidate: generous
@@ -331,42 +350,49 @@ class TestStopReadingDrainWedge:
         # the exact stall it exists to detect.  Pre-fix, no `close` ever
         # fires and this test fails; post-fix the bounded drain times out
         # against the dead-after budget and tears the connection down.
-        server = await ZKServer().start()
-        proxy = await ChaosProxy(server.address, seed=3, sock_buf=8192).start()
-        client = await ZKClient(
-            [proxy.address],
-            timeout_ms=1200,           # interval 0.4 s, dead_after 0.8 s
-            reconnect=False,           # keep the post-mortem simple
-        ).connect()
-        try:
-            await client.create("/wedge", b"seed")
-            # Shrink the client-side buffers so the wedge needs KBs, not
-            # MBs: a small kernel send buffer plus a low transport
-            # high-water mark make drain() block almost immediately once
-            # the proxy stops draining its end.
-            import socket as _socket
+        # Context-managed teardown: pre-ISSUE-15 the three acquires sat
+        # BEFORE the try, so a failed connect leaked the server and the
+        # proxy — exactly the straggler shape the lifecycle rule exists
+        # to flag.
+        async with ZKServer() as server, ChaosProxy(
+            server.address, seed=3, sock_buf=8192
+        ) as proxy:
+            client = await ZKClient(
+                [proxy.address],
+                timeout_ms=1200,       # interval 0.4 s, dead_after 0.8 s
+                reconnect=False,       # keep the post-mortem simple
+            ).connect()
+            try:
+                await client.create("/wedge", b"seed")
+                # Shrink the client-side buffers so the wedge needs KBs,
+                # not MBs: a small kernel send buffer plus a low
+                # transport high-water mark make drain() block almost
+                # immediately once the proxy stops draining its end.
+                import socket as _socket
 
-            sock = client._writer.get_extra_info("socket")
-            sock.setsockopt(_socket.SOL_SOCKET, _socket.SO_SNDBUF, 8192)
-            client._writer.transport.set_write_buffer_limits(high=16384)
+                sock = client._writer.get_extra_info("socket")
+                sock.setsockopt(
+                    _socket.SOL_SOCKET, _socket.SO_SNDBUF, 8192
+                )
+                client._writer.transport.set_write_buffer_limits(
+                    high=16384
+                )
 
-            proxy.add(StopReading(), direction=UP)
-            # Fill the pipe: a write far larger than every buffer in the
-            # path wedges this task in _submit's drain — and, pre-fix,
-            # the next ping's drain right behind it.
-            blocked = asyncio.ensure_future(
-                client.set_data("/wedge", bytes(512 * 1024))
-            )
-            t0 = time.monotonic()
-            await client.wait_for("close", timeout=8)
-            detected = time.monotonic() - t0
-            assert detected < 6.0, detected
-            with pytest.raises((ZKError, ConnectionError, OSError)):
-                await blocked
-        finally:
-            await client.close()
-            await proxy.stop()
-            await server.stop()
+                proxy.add(StopReading(), direction=UP)
+                # Fill the pipe: a write far larger than every buffer in
+                # the path wedges this task in _submit's drain — and,
+                # pre-fix, the next ping's drain right behind it.
+                blocked = asyncio.ensure_future(
+                    client.set_data("/wedge", bytes(512 * 1024))
+                )
+                t0 = time.monotonic()
+                await client.wait_for("close", timeout=8)
+                detected = time.monotonic() - t0
+                assert detected < 6.0, detected
+                with pytest.raises((ZKError, ConnectionError, OSError)):
+                    await blocked
+            finally:
+                await client.close()
 
 
 class TestRebirthUnderWireFaults:

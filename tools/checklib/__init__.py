@@ -27,6 +27,14 @@ Layout:
     file-local rules; ``rules_flow.py``, ``rules_contracts.py`` — the
     whole-program rules; ``rules_errors.py`` — the exception-flow
     rules (retry/blackhole/overbroad/fault-matrix contract drift)
+  * ``locks.py``     — the lock-acquisition-order graph: lexical
+    ``async with <lock>`` sites, held-set propagation over call edges,
+    cycle (deadlock) detection (generation 4)
+  * ``lifecycle.py`` — must-release analysis for registered resource
+    vocabularies (transports, caches, workers, subprocesses, spans),
+    escape-path leaks via the generation-3 fixpoint
+  * ``rules_protocol.py`` — wire-contract drift: struct format arity,
+    OP_* dispatch/docs symmetry, flag bit overlap
   * ``suppress.py``  — ``# check: disable=<rule> -- why`` comments
   * ``baseline.py``  — grandfathered findings (tools/check-baseline.json)
   * ``engine.py``    — file iteration, program-model orchestration,
@@ -48,5 +56,8 @@ import checklib.rules_hygiene  # check: disable=unused-import -- import register
 import checklib.rules_flow  # check: disable=unused-import -- import registers the rules
 import checklib.rules_contracts  # check: disable=unused-import -- import registers the rules
 import checklib.rules_errors  # check: disable=unused-import -- import registers the rules
+import checklib.locks  # check: disable=unused-import -- import registers the rules
+import checklib.lifecycle  # check: disable=unused-import -- import registers the rules
+import checklib.rules_protocol  # check: disable=unused-import -- import registers the rules
 
 __all__ = ["Finding", "RULES", "rule", "check_file", "run", "main"]

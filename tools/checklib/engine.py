@@ -15,7 +15,11 @@ Two rule generations share one run (docs/CHECKS.md):
 plus their reverse-dependency closure over the import graph; the program
 model (and every program rule) still sees the full target set, so a
 change that breaks a cross-module contract is reported even when the
-breakage surfaces in an unchanged file.
+breakage surfaces in an unchanged file.  When the changed set does not
+intersect the checked files at all (a doc-only diff), the run
+short-circuits to a no-op BEFORE parsing anything — the documented
+pre-commit path stays instant instead of paying for a full program
+model that no rule will look at.
 """
 
 from __future__ import annotations
@@ -181,6 +185,35 @@ def _git_changed_rel_paths() -> List[str]:
     return out
 
 
+#: rule module (checklib.<name>) -> checker generation, for the CI job
+#: summary's per-generation digest.  New rule modules must be added
+#: here; unknown modules land in generation 0 so the digest makes the
+#: omission visible instead of silently folding it into another bucket.
+_MODULE_GENERATIONS = {
+    "rules_names": 1,
+    "rules_async": 1,
+    "rules_hygiene": 1,
+    "rules_flow": 2,
+    "rules_contracts": 2,
+    "rules_errors": 3,
+    "locks": 4,
+    "lifecycle": 4,
+    "rules_protocol": 4,
+}
+
+
+def _rule_generations() -> Dict[str, int]:
+    """{"1": count, ...}: how many registered rules each checker
+    generation contributes (string keys: this lands in the JSON
+    report)."""
+    out: Dict[str, int] = {}
+    for rule in RULES.values():
+        mod = rule.func.__module__.rsplit(".", 1)[-1]
+        gen = _MODULE_GENERATIONS.get(mod, 0)
+        out[str(gen)] = out.get(str(gen), 0) + 1
+    return dict(sorted(out.items()))
+
+
 def run(
     targets,
     baseline_path: Optional[str] = None,
@@ -206,11 +239,34 @@ def run(
         covered_prefixes.append(
             "" if rel in (".", "") else rel.rstrip("/") + "/"
         )
+    to_parse = []
     for path in iter_python_files(targets):
         rel = _default_rel_path(path)
         if rel in checked_rel_paths:
             continue  # overlapping targets: check (and count) each file once
         checked_rel_paths.add(rel)
+        to_parse.append((path, rel))
+
+    # --changed-only with a changed set that touches NO checked file
+    # (a doc-only diff): nothing this run could report depends on the
+    # diff, so skip the parse and the program model entirely — the
+    # pre-commit path must be instant, not "fast".
+    changed = set(_git_changed_rel_paths()) if changed_only else None
+    if changed is not None and not (changed & checked_rel_paths):
+        return RunResult(
+            [],
+            0,
+            0,
+            lambda p: False,
+            {
+                "elapsed_s": round(time.monotonic() - t0, 4),
+                "checked_files": 0,
+                "analyzed_files": 0,
+                "changed_only_noop": True,
+            },
+        )
+
+    for path, rel in to_parse:
         ctx, engine_findings = _parse_file(path, rel)
         findings.extend(engine_findings)
         if ctx is not None:
@@ -223,8 +279,7 @@ def run(
     # everything that imports them (a helper's contract change must
     # re-lint its consumers); the program rules below still see the
     # full model.
-    if changed_only:
-        changed = set(_git_changed_rel_paths())
+    if changed is not None:
         narrowed_set = model.reverse_import_closure(
             {c.rel_path for c in contexts if c.rel_path in changed}
         )
@@ -263,8 +318,12 @@ def run(
         # a maintainer chasing a --max-seconds regression would profile
         # the wrong module.
         from checklib.exceptions import flow_for
+        from checklib.lifecycle import lifecycle_for
+        from checklib.locks import lockgraph_for
 
         flow_for(model)
+        lockgraph_for(model)
+        lifecycle_for(model)
     ctx_by_path = {c.rel_path: c for c in contexts}
     program_timings: Dict[str, float] = {}
     for rule in program_rules:
@@ -336,6 +395,13 @@ def run(
         # first errors rule, shared by the rest; its fixpoint cost is
         # what --max-seconds is guarding against growing quadratic
         stats["program"].update(flow.stats())
+    lockg = getattr(model, "_lockgraph", None)
+    if lockg is not None:
+        stats["program"].update(lockg.stats())
+    lifecycle = getattr(model, "_lifecycle", None)
+    if lifecycle is not None:
+        stats["program"].update(lifecycle.stats())
+    stats["rule_generations"] = _rule_generations()
     return RunResult(
         findings, len(checked_rel_paths), grandfathered, in_scope, stats
     )
@@ -387,6 +453,11 @@ def _summary(result: RunResult) -> str:
 
 def _render_stats(result: RunResult) -> str:
     s = result.stats
+    if s.get("changed_only_noop"):
+        return (
+            "check --stats: --changed-only: no checked file changed; "
+            f"analysis skipped (total {s.get('elapsed_s', 0):.3f}s)"
+        )
     prog = s.get("program", {})
     rule_times = ", ".join(
         f"{k}={v:.3f}s" for k, v in s.get("program_rules_s", {}).items()
@@ -407,6 +478,11 @@ def _render_stats(result: RunResult) -> str:
         f"escape fixpoint {prog.get('escape_build_s', 0):.3f}s "
         f"({prog.get('escape_functions', 0)} functions, "
         f"{prog.get('escape_iterations', 0)} rounds), "
+        f"lock graph {prog.get('lock_build_s', 0):.3f}s "
+        f"({prog.get('lock_sites', 0)} sites, "
+        f"{prog.get('lock_edges', 0)} edges), "
+        f"lifecycle fixpoint {prog.get('lifecycle_build_s', 0):.3f}s "
+        f"({prog.get('lifecycle_tracked', 0)} resources), "
         f"program rules [{rule_times}]; "
         f"total {s.get('elapsed_s', 0):.3f}s"
     )

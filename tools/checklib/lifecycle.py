@@ -1,0 +1,414 @@
+"""Resource-lifecycle (must-release) analysis (generation 4).
+
+The repo's dynamic history is a catalog of leaked lifecycles: the PR-5
+subprocess leak, drifting ``ChaosProxy`` teardowns in test helpers,
+tracer spans opened and never finished (an unfinished span is a lie in
+the flight recorder — the operator sees an operation that "never
+ended").  This module checks the *shape* statically: for every resource
+with a registered acquire/release vocabulary, some path from the
+acquire site must not provably reach function exit without a release.
+
+The analysis is per-function and deliberately statement-structural —
+the interprocedural half rides on the PR-7 exception-escape fixpoint
+instead of a dataflow lattice of its own:
+
+  * an **acquire** is a constructor call from the vocabulary
+    (``ChaosProxy``, ``ZKCache``, ``ShardWorker``, ``ShardRouter``,
+    ``subprocess.Popen``) or a ``.start_span(...)`` method call;
+  * an acquire bound to a plain local (``proxy = await
+    ChaosProxy(...).start()``) is **tracked**; every other destination
+    is an ownership pattern the function-local analysis must not
+    second-guess, and is exempt: used as a ``with``/``async with``
+    context expression (the manager releases), returned or yielded
+    (ownership transfer to the caller), stored into an attribute,
+    subscript or container (the holder owns it — ``self._failover_span
+    = tr.start_span(...)``), passed as a call argument
+    (``proxies.append(p)``, ``stack.enter_context(...)``), closed over
+    by a nested def, aliased or rebound;
+  * a tracked local **leaks** (``leaked-resource`` /
+    ``span-never-finished``) when
+
+      - no release method from its vocabulary is ever called on it
+        (the straight-line leak), or
+      - releases exist but none sits in a ``finally``, and a *named*
+        exception class provably escapes the function (PR-7's converged
+        escape set, UNKNOWN never acted on) from a site strictly
+        between the acquire and the first release — the escape edge
+        skips the release, and the finding's chain is the acquire hop
+        plus the full escape chain;
+
+  * a bare-statement acquire (``subprocess.Popen(...)`` as an
+    expression statement) discards the only handle outright and is
+    reported immediately.
+
+Anything the model cannot prove stays silent — same contract as every
+other generation.
+"""
+
+from __future__ import annotations
+
+import ast
+import time
+from typing import Dict, Iterator, List, Optional, Set, Tuple
+
+from checklib.callgraph import chain_evidence, chain_names
+from checklib.exceptions import display_name, flow_for
+from checklib.model import Finding
+from checklib.program import (
+    FunctionInfo,
+    ProgramModel,
+    _dotted,
+)
+from checklib.registry import rule
+
+#: Resource constructors -> the method names that release what they
+#: acquire.  Names are distinctive by design (a fixture defining its own
+#: ``ChaosProxy`` is exactly the point); ``Popen`` additionally waits on
+#: ``communicate`` because reaping IS the release for a subprocess.
+RESOURCE_CTORS: Dict[str, frozenset] = {
+    "ChaosProxy": frozenset({"stop", "close", "aclose", "kill"}),
+    "ZKCache": frozenset({"close", "aclose", "stop"}),
+    "ShardWorker": frozenset({"close", "stop"}),
+    "ShardRouter": frozenset({"close", "stop"}),
+    "Popen": frozenset({"wait", "communicate", "terminate", "kill"}),
+}
+
+#: ``.start_span(...)`` outside a ``with`` must be finished explicitly
+#: (trace.Span.finish is idempotent, so belt-and-braces is fine — zero
+#: calls is not).
+SPAN_ACQUIRE = "start_span"
+SPAN_RELEASES = frozenset({"finish", "end", "close"})
+
+#: Methods that return the resource itself in a builder chain
+#: (``ChaosProxy(addr).start()``): the chained call stays the acquire.
+_CHAIN_METHODS = frozenset({"start"})
+
+
+class _Acquire:
+    __slots__ = (
+        "rule", "label", "releases", "func", "name", "lineno", "node",
+        "assign",
+    )
+
+    def __init__(self, rule_name, label, releases, func, name, lineno,
+                 node, assign):
+        self.rule = rule_name
+        self.label = label
+        self.releases = releases
+        self.func: FunctionInfo = func
+        self.name: Optional[str] = name  # tracked local, None = discarded
+        self.lineno = lineno
+        self.node = node
+        self.assign = assign  # the binding ast.Assign (tracked only)
+
+
+def _parent_map(root) -> Dict[int, ast.AST]:
+    out: Dict[int, ast.AST] = {}
+    for parent in ast.walk(root):
+        for child in ast.iter_child_nodes(parent):
+            out[id(child)] = parent
+    return out
+
+
+def _nested_scope_ids(root) -> Set[int]:
+    """ids of every node inside a nested def/class/lambda under root."""
+    out: Set[int] = set()
+    for node in ast.walk(root):
+        if node is root:
+            continue
+        if isinstance(
+            node,
+            (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda,
+             ast.ClassDef),
+        ):
+            for sub in ast.walk(node):
+                out.add(id(sub))
+    return out
+
+
+def _finally_try_lines(root) -> Dict[int, int]:
+    """id(node-in-a-finalbody) -> lineno of the owning ``try``.  A
+    finally-release is unconditional only from the try's first line on:
+    an acquire BEFORE the try (the classic
+    ``p = await Proxy().start()`` / ``try: ... finally: p.stop()``
+    straggler) is still exposed to escapes in the gap.  Outer trys are
+    walked first, so a nested finally keeps its innermost owner."""
+    out: Dict[int, int] = {}
+    for node in ast.walk(root):
+        if isinstance(node, ast.Try):
+            for stmt in node.finalbody:
+                for sub in ast.walk(stmt):
+                    out[id(sub)] = node.lineno
+    return out
+
+
+def _acquire_vocab(func: FunctionInfo, call: ast.Call):
+    """(rule, label, release set) when ``call`` acquires a registered
+    resource, else None."""
+    if (
+        isinstance(call.func, ast.Attribute)
+        and call.func.attr == SPAN_ACQUIRE
+    ):
+        return ("span-never-finished", "start_span(...)", SPAN_RELEASES)
+    d = _dotted(call.func)
+    if d is None:
+        return None
+    base, attrs = d
+    last = attrs[-1] if attrs else base
+    releases = RESOURCE_CTORS.get(last)
+    if releases is None:
+        return None
+    if not attrs and base in func.param_chain():
+        return None  # the "constructor" is a parameter: unknown object
+    return ("leaked-resource", f"{last}(...)", releases)
+
+
+class Lifecycle:
+    """The analysis: build once per run (:func:`lifecycle_for`), query
+    per rule."""
+
+    def __init__(self, model: ProgramModel):
+        self.model = model
+        self.flow = flow_for(model)
+        t0 = time.monotonic()
+        self.tracked = 0
+        #: rule name -> findings (computed once, served to both rules)
+        self.findings: Dict[str, List[Finding]] = {
+            "leaked-resource": [],
+            "span-never-finished": [],
+        }
+        for func in model.functions():
+            if func.node is not None:
+                self._scan_function(func)
+        self.build_seconds = round(time.monotonic() - t0, 4)
+
+    # -- per-function scan ------------------------------------------------
+
+    def _scan_function(self, func: FunctionInfo) -> None:
+        parents = _parent_map(func.node)
+        nested = _nested_scope_ids(func.node)
+        acquires: List[_Acquire] = []
+        for node in ast.walk(func.node):
+            if id(node) in nested or not isinstance(node, ast.Call):
+                continue
+            vocab = _acquire_vocab(func, node)
+            if vocab is None:
+                continue
+            rule_name, label, releases = vocab
+            kind, name, assign = self._classify(parents, node)
+            if kind == "exempt":
+                continue
+            self.tracked += 1
+            acquires.append(
+                _Acquire(
+                    rule_name, label, releases, func, name,
+                    node.lineno, node, assign,
+                )
+            )
+        if not acquires:
+            return
+        finals = _finally_try_lines(func.node)
+        for acq in acquires:
+            finding = (
+                self._judge_discarded(acq)
+                if acq.name is None
+                else self._judge_tracked(acq, parents, nested, finals)
+            )
+            if finding is not None:
+                self.findings[acq.rule].append(finding)
+
+    def _classify(self, parents, call: ast.Call):
+        """Where does the acquired value GO?  ("local", name, assign) for
+        a tracked plain-local binding, ("discarded", None, None) for a
+        bare expression statement, ("exempt", None, None) otherwise."""
+        cur: ast.AST = call
+        while True:
+            p = parents.get(id(cur))
+            if p is None:
+                return ("exempt", None, None)
+            if isinstance(p, ast.Await):
+                cur = p
+                continue
+            if isinstance(p, ast.Attribute) and p.attr in _CHAIN_METHODS:
+                gp = parents.get(id(p))
+                if isinstance(gp, ast.Call) and gp.func is p:
+                    cur = gp  # ChaosProxy(addr).start(): still the resource
+                    continue
+                return ("exempt", None, None)
+            if isinstance(p, ast.Assign) and cur is p.value:
+                if len(p.targets) == 1 and isinstance(
+                    p.targets[0], ast.Name
+                ):
+                    return ("local", p.targets[0].id, p)
+                return ("exempt", None, None)  # attr/subscript/tuple:
+                # stored — the holder owns the lifecycle
+            if isinstance(p, ast.Expr):
+                return ("discarded", None, None)
+            # withitem (cm-managed), Call argument / keyword (transfer),
+            # Return / Yield (transfer), container literal, comparison,
+            # conditional expression, ... — every other destination is
+            # either ownership transfer or something unmodeled: exempt.
+            return ("exempt", None, None)
+
+    def _judge_discarded(self, acq: _Acquire) -> Optional[Finding]:
+        func = acq.func
+        verb = (
+            "finished" if acq.rule == "span-never-finished" else "released"
+        )
+        return Finding(
+            acq.rule,
+            func.module.rel_path,
+            acq.lineno,
+            f"result of '{acq.label}' in '{func.qualname}' is discarded: "
+            f"the handle can never be {verb} "
+            f"({'/'.join(sorted(acq.releases))})",
+        )
+
+    def _judge_tracked(
+        self, acq: _Acquire, parents, nested, finals
+    ) -> Optional[Finding]:
+        func = acq.func
+        name = acq.name
+        #: (release lineno, owning-try lineno when inside a finally)
+        releases: List[Tuple[int, Optional[int]]] = []
+        for node in ast.walk(func.node):
+            if not isinstance(node, ast.Name) or node.id != name:
+                continue
+            if id(node) in nested:
+                return None  # closed over: lifetime escapes this frame
+            if isinstance(node.ctx, ast.Store):
+                p = parents.get(id(node))
+                if isinstance(p, ast.Assign) and p is acq.assign:
+                    continue  # the acquire binding itself
+                return None  # rebound / aliased target: not provable
+            verdict = self._use_verdict(parents, node, acq.releases)
+            if verdict == "exempt":
+                return None
+            if verdict is not None:  # a release lineno
+                releases.append((verdict, finals.get(id(node))))
+        if not releases:
+            verb = (
+                "finished"
+                if acq.rule == "span-never-finished"
+                else "released"
+            )
+            return Finding(
+                acq.rule,
+                func.module.rel_path,
+                acq.lineno,
+                f"'{name}' ({acq.label}) acquired in '{func.qualname}' is "
+                f"never {verb} ({'/'.join(sorted(acq.releases))}) on any "
+                f"path to function exit",
+            )
+        guarded = [t for _, t in releases if t is not None]
+        if guarded and min(guarded) <= acq.lineno:
+            return None  # the finally's try encloses the acquire:
+            # released on every path out
+        # Either no finally release at all, or the try begins AFTER the
+        # acquire: an escape in (acquire, window_end) skips every
+        # release.
+        window_end = (
+            min(guarded) if guarded else min(line for line, _ in releases)
+        )
+        for token in sorted(self.flow.named_escapes(func)):
+            wit = self.flow._witness.get((func, token))
+            if wit is None:
+                continue
+            wline = wit[0]
+            if not (acq.lineno < wline < window_end):
+                continue
+            chain = [
+                (
+                    f"{name} = {acq.label}",
+                    func.module.rel_path,
+                    acq.lineno,
+                )
+            ] + self.flow.escape_chain(func, token)
+            return Finding(
+                acq.rule,
+                func.module.rel_path,
+                acq.lineno,
+                f"'{name}' ({acq.label}) leaks when "
+                f"'{display_name(token)}' escapes '{func.qualname}' "
+                f"between the acquire and the release — no release sits "
+                f"in a finally (chain: {chain_names(chain)})",
+                chain=chain_evidence(chain),
+            )
+        return None
+
+    def _use_verdict(self, parents, node: ast.Name, release_names):
+        """For one Load use of the tracked name: a release call's lineno,
+        "exempt" (ownership transfer / aliasing / cm use), or None
+        (neutral read)."""
+        p = parents.get(id(node))
+        if isinstance(p, ast.Attribute) and p.value is node:
+            gp = parents.get(id(p))
+            if (
+                isinstance(gp, ast.Call)
+                and gp.func is p
+                and p.attr in release_names
+            ):
+                return gp.lineno
+            return None  # attribute read / non-release method: neutral
+        cur: ast.AST = node
+        while True:
+            if p is None:
+                return None
+            if isinstance(p, ast.Call) and cur is not p.func:
+                return "exempt"  # passed along: ownership transfer
+            if isinstance(p, (ast.keyword, ast.Starred)):
+                return "exempt"
+            if isinstance(
+                p, (ast.Return, ast.Yield, ast.YieldFrom)
+            ):
+                return "exempt"
+            if isinstance(p, ast.withitem):
+                return "exempt"  # `async with proxy:` — the cm releases
+            if isinstance(
+                p,
+                (ast.List, ast.Tuple, ast.Set, ast.Dict, ast.ListComp,
+                 ast.SetComp, ast.DictComp, ast.GeneratorExp,
+                 ast.comprehension),
+            ):
+                return "exempt"  # containered: the holder owns it
+            if isinstance(p, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+                return "exempt"  # aliased or stored somewhere
+            if isinstance(p, ast.stmt):
+                return None  # plain read in a statement: neutral
+            cur = p
+            p = parents.get(id(cur))
+
+    def stats(self) -> dict:
+        return {
+            "lifecycle_tracked": self.tracked,
+            "lifecycle_build_s": self.build_seconds,
+        }
+
+
+def lifecycle_for(model: ProgramModel) -> Lifecycle:
+    """One Lifecycle per program model, shared by both resource rules
+    (and surfaced into ``--stats`` by the engine)."""
+    lc = getattr(model, "_lifecycle", None)
+    if lc is None:
+        lc = Lifecycle(model)
+        model._lifecycle = lc
+    return lc
+
+
+@rule(
+    "leaked-resource",
+    "acquired transport/cache/worker/subprocess handle provably reaches "
+    "function exit without a release",
+    scope="program",
+)
+def leaked_resource(model: ProgramModel) -> Iterator[Finding]:
+    yield from lifecycle_for(model).findings["leaked-resource"]
+
+
+@rule(
+    "span-never-finished",
+    "tracer span started outside a with and never finished on some path",
+    scope="program",
+)
+def span_never_finished(model: ProgramModel) -> Iterator[Finding]:
+    yield from lifecycle_for(model).findings["span-never-finished"]

@@ -1,0 +1,558 @@
+"""Interprocedural lock-acquisition-order analysis (generation 4).
+
+The PR-3 single-flight invariant says *who* must hold the lock; nothing
+so far checks in what ORDER locks are taken when there is more than one.
+Two coroutines acquiring ``{A, B}`` in opposite orders deadlock the
+daemon silently — the process stays alive, its heartbeats stop, its
+ephemerals rot (the exact liveness failure the paper's §2.6 contract
+exists to prevent), and no test notices until the interleaving happens
+to land.  This module makes the ordering a static artifact:
+
+  * every lexical ``async with <lock>`` site whose lock expression
+    resolves to a stable identity becomes an **acquisition site**;
+  * held-lock sets propagate forward along the PR-6 resolved call edges
+    (a callee invoked under a lock runs with it held), each held lock
+    carrying the witness chain of hops that led to the hold;
+  * each acquisition performed while other locks are held contributes
+    **order edges** ``held -> acquired`` to a global graph;
+  * a cycle in that graph is a deadlock candidate
+    (``lock-order-cycle``), reported once per lock set with every
+    participating acquisition chain as structured evidence — including
+    the degenerate self-loop (``asyncio.Lock`` is not reentrant: taking
+    a lock you already hold deadlocks immediately, no second coroutine
+    required);
+  * ``zk-op-under-lock`` flags a call site that is lexically under one
+    of the agent-orbit locks (rules_flow.LOCK_SCOPED_MODULES) and
+    provably reaches ``connect_with_backoff`` — the *unbounded*
+    session-(re)establishment retry loop.  Holding the single-flight
+    lock across it wedges every other repair/heartbeat flow for as long
+    as the ensemble stays unreachable (the PR-2 drain-wedge class,
+    caught before merge instead of in a chaos run).
+
+Lock identity resolution is conservative in the file-local tradition
+(zero false positives beats coverage):
+
+  * ``self.<attr>`` with a known enclosing class -> ``module:Class.attr``
+    (the per-class abstraction: all instances share an ordering
+    discipline, which is exactly what an order graph is about);
+  * a bare name assigned exactly once in an enclosing function scope
+    from a ``...Lock()`` constructor -> that function's local lock;
+  * a module-level name bound exactly once, by assignment from a
+    ``...Lock()`` constructor -> a module-global lock;
+  * anything else (parameters, rebindings, degraded modules, opaque
+    expressions) does not resolve, and an unresolved lock contributes
+    neither held-set entries nor order edges — conservative silence.
+"""
+
+from __future__ import annotations
+
+import ast
+import time
+from collections import deque
+from typing import Dict, Iterator, List, Optional, Set, Tuple
+
+from checklib.callgraph import chain_evidence, chain_names
+from checklib.model import Finding
+from checklib.program import (
+    CallSite,
+    FunctionInfo,
+    ModuleInfo,
+    ProgramModel,
+    _dotted,
+    _is_lock_expr,
+)
+from checklib.registry import rule
+from checklib.rules_flow import LOCK_SCOPED_MODULES, graph_for
+
+#: Constructor names that build a mutual-exclusion primitive.  The
+#: *name* being bound must also look like a lock (_is_lock_expr) before
+#: this is ever consulted, so `cond = asyncio.Condition()` never enters
+#: the domain through the back door.
+_LOCK_CTORS = frozenset({"Lock", "RLock"})
+
+#: The unbounded session-(re)establishment boundary zk-op-under-lock
+#: guards: every retry loop the zk client exposes funnels through it.
+_SESSION_RETRY = "connect_with_backoff"
+
+#: A chain hop: (symbol, rel_path, line) — the same shape callgraph.py's
+#: chains use, so chain_names/chain_evidence render them identically.
+Hop = Tuple[str, str, int]
+
+
+def _short(lock_id: str) -> str:
+    """Operator-facing name for a lock id (last dotted component)."""
+    return lock_id.rsplit(".", 1)[-1].rsplit(":", 1)[-1]
+
+
+def _is_lock_ctor(value) -> bool:
+    if isinstance(value, ast.Await):
+        value = value.value
+    if not isinstance(value, ast.Call):
+        return False
+    d = _dotted(value.func)
+    if d is None:
+        return False
+    base, attrs = d
+    return (attrs[-1] if attrs else base) in _LOCK_CTORS
+
+
+def _scope_stmts(node) -> Iterator[ast.stmt]:
+    """Statements belonging to one function scope: the body, recursing
+    through compound statements but NOT into nested def/class bodies."""
+    stack: List[ast.stmt] = list(node.body)
+    while stack:
+        stmt = stack.pop()
+        yield stmt
+        if isinstance(
+            stmt, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)
+        ):
+            continue
+        for child in ast.iter_child_nodes(stmt):
+            if isinstance(child, ast.stmt):
+                stack.append(child)
+            elif isinstance(child, ast.excepthandler):
+                stack.extend(child.body)
+
+
+def _local_binding_assigns(func: FunctionInfo, name: str) -> Optional[list]:
+    """The ``name = ...`` assignment statements binding ``name`` in
+    ``func``'s own scope, or None when the name is bound by anything
+    other than plain assignments (with-as, for target, import, ...) —
+    the ambiguous cases identity resolution refuses to guess about."""
+    if func.node is None:
+        return None
+    assigns: List[ast.Assign] = []
+    for stmt in _scope_stmts(func.node):
+        if isinstance(stmt, ast.Assign):
+            hit = False
+            for t in stmt.targets:
+                for sub in ast.walk(t):
+                    if isinstance(sub, ast.Name) and sub.id == name:
+                        hit = True
+            if hit:
+                if len(stmt.targets) != 1 or not isinstance(
+                    stmt.targets[0], ast.Name
+                ):
+                    return None  # tuple/chained target: ambiguous
+                assigns.append(stmt)
+        elif isinstance(stmt, (ast.AnnAssign, ast.AugAssign)):
+            t = stmt.target
+            if isinstance(t, ast.Name) and t.id == name:
+                return None
+        elif isinstance(stmt, (ast.For, ast.AsyncFor)):
+            for sub in ast.walk(stmt.target):
+                if isinstance(sub, ast.Name) and sub.id == name:
+                    return None
+        elif isinstance(stmt, (ast.With, ast.AsyncWith)):
+            for item in stmt.items:
+                if item.optional_vars is None:
+                    continue
+                for sub in ast.walk(item.optional_vars):
+                    if isinstance(sub, ast.Name) and sub.id == name:
+                        return None
+        elif isinstance(stmt, (ast.Import, ast.ImportFrom)):
+            for alias in stmt.names:
+                if (alias.asname or alias.name.split(".")[0]) == name:
+                    return None
+    return assigns
+
+
+def _module_lock_assign(mod: ModuleInfo, name: str) -> Optional[ast.Assign]:
+    """The single module-level ``name = ...Lock()`` assignment, if the
+    module binds ``name`` exactly that way and no other."""
+    if mod.degraded:
+        return None
+    if mod.bindings.get(name) != {"assign"}:
+        return None
+    assigns: List[ast.Assign] = []
+
+    def scan(stmts) -> None:
+        for stmt in stmts:
+            if isinstance(stmt, ast.Assign):
+                for t in stmt.targets:
+                    for sub in ast.walk(t):
+                        if isinstance(sub, ast.Name) and sub.id == name:
+                            assigns.append(stmt)
+            elif isinstance(stmt, (ast.If, ast.Try)):
+                for child in ast.iter_child_nodes(stmt):
+                    if isinstance(child, ast.stmt):
+                        scan([child])
+                for handler in getattr(stmt, "handlers", []):
+                    scan(handler.body)
+
+    scan(mod.ctx.tree.body)
+    if len(assigns) != 1 or not _is_lock_ctor(assigns[0].value):
+        return None
+    return assigns[0]
+
+
+class LockGraph:
+    """The analysis: build once per run (:func:`lockgraph_for`), query
+    per rule."""
+
+    def __init__(self, model: ProgramModel):
+        self.model = model
+        self.graph = graph_for(model)
+        t0 = time.monotonic()
+        #: resolved acquisition events:
+        #: (lock_id, func, lineno, lexical held {lock_id: chain})
+        self._acquisitions: List[tuple] = []
+        #: CallSite -> {lock_id: chain} held LEXICALLY at the site
+        self._lexical_held: Dict[CallSite, Dict[str, List[Hop]]] = {}
+        #: lock_id -> rel_path of the module defining it
+        self._lock_paths: Dict[str, str] = {}
+        self._functions = list(model.functions())
+        for func in self._functions:
+            if func.node is not None:
+                self._walk_function(func)
+        #: FunctionInfo -> {lock_id: chain} held at ENTRY on some path
+        self._entry_held: Dict[FunctionInfo, Dict[str, List[Hop]]] = {}
+        self._fixpoint()
+        #: (held, acquired) -> first witness chain
+        self.edges: Dict[Tuple[str, str], List[Hop]] = {}
+        self._build_edges()
+        self.lock_sites = len(self._acquisitions)
+        self.build_seconds = round(time.monotonic() - t0, 4)
+
+    # -- lock identity ----------------------------------------------------
+
+    def _lock_id(self, func: FunctionInfo, expr) -> Optional[str]:
+        d = _dotted(expr)
+        if d is None:
+            return None
+        base, attrs = d
+        if base in ("self", "cls"):
+            if len(attrs) != 1 or func.cls is None:
+                return None
+            lock_id = f"{func.module.name}:{func.cls}.{attrs[0]}"
+            self._lock_paths.setdefault(lock_id, func.module.rel_path)
+            return lock_id
+        if attrs:
+            return None  # foreign-object / module-attr lock: not modeled
+        if base in func.param_chain():
+            return None  # a lock handed in: identity unknowable here
+        f: Optional[FunctionInfo] = func
+        while f is not None:
+            assigns = _local_binding_assigns(f, base)
+            if assigns is None:
+                return None  # bound ambiguously somewhere on the chain
+            if assigns:
+                if len(assigns) != 1 or not _is_lock_ctor(assigns[0].value):
+                    return None
+                lock_id = f"{f.ref}.{base}"
+                self._lock_paths.setdefault(lock_id, f.module.rel_path)
+                return lock_id
+            f = f.parent
+        if _module_lock_assign(func.module, base) is not None:
+            lock_id = f"{func.module.name}:{base}"
+            self._lock_paths.setdefault(lock_id, func.module.rel_path)
+            return lock_id
+        return None
+
+    def lock_path(self, lock_id: str) -> Optional[str]:
+        return self._lock_paths.get(lock_id)
+
+    # -- lexical walk -----------------------------------------------------
+
+    def _walk_function(self, func: FunctionInfo) -> None:
+        rel = func.module.rel_path
+        sites = {id(s.node): s for s in func.calls}
+
+        def walk(node, held: Dict[str, List[Hop]]) -> None:
+            if isinstance(
+                node,
+                (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef,
+                 ast.Lambda),
+            ):
+                return  # separate scopes; the fixpoint covers their calls
+            if isinstance(node, ast.AsyncWith):
+                inner = held
+                for item in node.items:
+                    walk(item.context_expr, inner)
+                    if item.optional_vars is not None:
+                        walk(item.optional_vars, inner)
+                    if not _is_lock_expr(item.context_expr):
+                        continue
+                    lock_id = self._lock_id(func, item.context_expr)
+                    if lock_id is None:
+                        continue
+                    lineno = item.context_expr.lineno
+                    self._acquisitions.append(
+                        (lock_id, func, lineno, dict(inner))
+                    )
+                    if inner is held:
+                        inner = dict(held)
+                    inner[lock_id] = [
+                        (func.ref, rel, lineno),
+                        (f"async with {_short(lock_id)}", rel, lineno),
+                    ]
+                for stmt in node.body:
+                    walk(stmt, inner)
+                return
+            if isinstance(node, ast.Call):
+                site = sites.get(id(node))
+                if site is not None and held:
+                    self._lexical_held[site] = dict(held)
+            for child in ast.iter_child_nodes(node):
+                walk(child, held)
+
+        for stmt in func.node.body:
+            walk(stmt, {})
+
+    # -- interprocedural held-set fixpoint --------------------------------
+
+    def _fixpoint(self) -> None:
+        changed = True
+        while changed:
+            changed = False
+            for func in self._functions:
+                entry = self._entry_held.get(func)
+                for site in func.calls:
+                    held: Dict[str, List[Hop]] = dict(entry or {})
+                    held.update(self._lexical_held.get(site, {}))
+                    if not held:
+                        continue
+                    res = self.graph.resolve(site)
+                    if res is None or res[0] != "func":
+                        continue
+                    callee = res[1]
+                    target = self._entry_held.setdefault(callee, {})
+                    for lock_id, chain in held.items():
+                        if lock_id in target:
+                            continue
+                        target[lock_id] = chain + [
+                            (
+                                func.ref,
+                                func.module.rel_path,
+                                site.lineno,
+                            )
+                        ]
+                        changed = True
+
+    def _build_edges(self) -> None:
+        for lock_id, func, lineno, lexical in self._acquisitions:
+            held: Dict[str, List[Hop]] = dict(
+                self._entry_held.get(func, {})
+            )
+            held.update(lexical)
+            if not held:
+                continue
+            rel = func.module.rel_path
+            suffix: List[Hop] = [
+                (func.ref, rel, lineno),
+                (f"async with {_short(lock_id)}", rel, lineno),
+            ]
+            for prior in sorted(held):
+                key = (prior, lock_id)
+                if key not in self.edges:
+                    self.edges[key] = held[prior] + suffix
+
+    # -- queries ----------------------------------------------------------
+
+    def held_at(self, site: CallSite) -> Dict[str, List[Hop]]:
+        """Every resolved lock provably held at ``site`` on some path
+        (lexical block or caller chain), with its acquisition chain."""
+        held = dict(self._entry_held.get(site.func, {}))
+        held.update(self._lexical_held.get(site, {}))
+        return held
+
+    def lexically_held_sites(self):
+        for site, held in self._lexical_held.items():
+            yield site, held
+
+    def cycles(self) -> List[Tuple[List[str], List[List[Hop]]]]:
+        """Each distinct cyclic lock set, once: ``(locks in cycle order,
+        witness chain per participating edge)``.  Deterministic: edges
+        are explored in sorted order, so the reported representative
+        cycle is stable across runs (it is the baseline identity)."""
+        adj: Dict[str, Set[str]] = {}
+        for a, b in self.edges:
+            adj.setdefault(a, set()).add(b)
+        out: List[Tuple[List[str], List[List[Hop]]]] = []
+        reported: Set[frozenset] = set()
+        for a, b in sorted(self.edges):
+            if a == b:
+                key = frozenset({a})
+                if key not in reported:
+                    reported.add(key)
+                    out.append(([a], [self.edges[(a, b)]]))
+                continue
+            path = self._edge_path(b, a, adj)
+            if path is None:
+                continue
+            locks = [a, b] + [edge[1] for edge in path[:-1]]
+            key = frozenset(locks)
+            if key in reported:
+                continue
+            reported.add(key)
+            witnesses = [self.edges[(a, b)]] + [
+                self.edges[edge] for edge in path
+            ]
+            out.append((locks, witnesses))
+        return out
+
+    def _edge_path(self, start: str, goal: str, adj) -> Optional[list]:
+        """Shortest edge list start -> ... -> goal over the order graph."""
+        seen = {start}
+        queue: deque = deque([(start, [])])
+        while queue:
+            node, path = queue.popleft()
+            for nxt in sorted(adj.get(node, ())):
+                edge = (node, nxt)
+                if nxt == goal:
+                    return path + [edge]
+                if nxt not in seen:
+                    seen.add(nxt)
+                    queue.append((nxt, path + [edge]))
+        return None
+
+    def session_retry_chain(self, site: CallSite) -> Optional[List[Hop]]:
+        """Chain from ``site`` to a ``connect_with_backoff`` callee over
+        resolved edges, or None.  Sync and async edges both count: the
+        hold spans every await in the lexical block."""
+        rel = site.func.module.rel_path
+        start: List[Hop] = [(site.func.ref, rel, site.lineno)]
+        hit = self._session_retry_target(site)
+        if hit is not None:
+            return start + [hit]
+        res = self.graph.resolve(site)
+        if res is None or res[0] != "func":
+            return None
+        seen: Set[FunctionInfo] = {res[1]}
+        queue: deque = deque([(res[1], start)])
+        while queue:
+            func, path = queue.popleft()
+            for inner in func.calls:
+                hit = self._session_retry_target(inner)
+                if hit is not None:
+                    return path + [
+                        (func.ref, func.module.rel_path, inner.lineno),
+                        hit,
+                    ]
+            for inner in func.calls:
+                r = self.graph.resolve(inner)
+                if r is None or r[0] != "func" or r[1] in seen:
+                    continue
+                seen.add(r[1])
+                queue.append(
+                    (
+                        r[1],
+                        path + [
+                            (func.ref, func.module.rel_path, inner.lineno)
+                        ],
+                    )
+                )
+        return None
+
+    def _session_retry_target(self, site: CallSite) -> Optional[Hop]:
+        res = self.graph.resolve(site)
+        if res is None:
+            return None
+        if res[0] == "func" and res[1].name == _SESSION_RETRY:
+            callee = res[1]
+            return (callee.ref, callee.module.rel_path, callee.lineno)
+        if res[0] == "ext" and (
+            res[1] == _SESSION_RETRY
+            or res[1].endswith("." + _SESSION_RETRY)
+        ):
+            return (res[1], site.func.module.rel_path, site.lineno)
+        return None
+
+    def stats(self) -> dict:
+        return {
+            "lock_sites": self.lock_sites,
+            "lock_edges": len(self.edges),
+            "lock_build_s": self.build_seconds,
+        }
+
+
+def lockgraph_for(model: ProgramModel) -> LockGraph:
+    """One LockGraph per program model, shared by both lock rules (and
+    surfaced into ``--stats`` by the engine)."""
+    lg = getattr(model, "_lockgraph", None)
+    if lg is None:
+        lg = LockGraph(model)
+        model._lockgraph = lg
+    return lg
+
+
+@rule(
+    "lock-order-cycle",
+    "locks acquired in inconsistent order on different call paths "
+    "(deadlock)",
+    scope="program",
+)
+def lock_order_cycle(model: ProgramModel) -> Iterator[Finding]:
+    # One finding per cyclic lock SET, anchored where the first edge's
+    # second lock is taken; the evidence concatenates every
+    # participating acquisition chain so both (all) sides of the
+    # inversion are walkable in the JSON/SARIF report.
+    lg = lockgraph_for(model)
+    for locks, witnesses in lg.cycles():
+        evidence = [hop for w in witnesses for hop in w]
+        anchor = witnesses[0][-1]
+        if len(locks) == 1:
+            message = (
+                f"lock '{_short(locks[0])}' is re-acquired while already "
+                f"held (asyncio locks are not reentrant: this deadlocks "
+                f"immediately; chain: {chain_names(evidence)})"
+            )
+        else:
+            order = " -> ".join(_short(l) for l in locks + locks[:1])
+            chains = " vs ".join(chain_names(w) for w in witnesses)
+            message = (
+                f"locks acquired in inconsistent order ({order}): a "
+                f"deadlock needs only the right interleaving "
+                f"(chains: {chains})"
+            )
+        yield Finding(
+            "lock-order-cycle",
+            anchor[1],
+            anchor[2],
+            message,
+            chain=chain_evidence(evidence),
+        )
+
+
+@rule(
+    "zk-op-under-lock",
+    "unbounded session-(re)establishment retry held under an agent-orbit "
+    "lock",
+    scope="program",
+)
+def zk_op_under_lock(model: ProgramModel) -> Iterator[Finding]:
+    # connect_with_backoff retries until the ensemble answers — by
+    # design, unbounded.  Reached under one of the agent-orbit locks
+    # (rules_flow.LOCK_SCOPED_MODULES), the hold outlives any repair the
+    # lock exists to serialize: heartbeat repair, rebirth and reload all
+    # queue behind a coroutine that may never return (the PR-2 drain
+    # wedge, as a static fact).  Only LEXICAL lock blocks in the scoped
+    # modules are scanned — an interior helper that is sometimes called
+    # under the lock gets its finding at the lexical site that created
+    # the hold, never twice.
+    lg = lockgraph_for(model)
+    for site, held in lg.lexically_held_sites():
+        if site.func.module.rel_path not in LOCK_SCOPED_MODULES:
+            continue
+        scoped = {
+            lock_id: chain
+            for lock_id, chain in held.items()
+            if lg.lock_path(lock_id) in LOCK_SCOPED_MODULES
+        }
+        if not scoped:
+            continue
+        retry_chain = lg.session_retry_chain(site)
+        if retry_chain is None:
+            continue
+        lock_id = sorted(scoped)[0]
+        full = scoped[lock_id] + retry_chain
+        yield Finding(
+            "zk-op-under-lock",
+            site.func.module.rel_path,
+            site.lineno,
+            f"'{_SESSION_RETRY}' (unbounded session retry) reached while "
+            f"holding '{_short(lock_id)}': every flow serialized by the "
+            f"lock wedges for as long as the ensemble stays unreachable "
+            f"(chain: {chain_names(full)})",
+            chain=chain_evidence(full),
+        )

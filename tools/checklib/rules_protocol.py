@@ -1,0 +1,503 @@
+"""Wire-contract drift rules (generation 4).
+
+The repo hand-rolls two binary protocols: the jute codec under
+``registrar_tpu/zk/`` (PR-1) and the shard tier's length-prefixed
+op-byte protocol in ``registrar_tpu/shard.py`` (PRs 12-13).  Their
+encoder/decoder pairs are kept symmetric by golden tests — which only
+catch drift on the paths the goldens exercise.  These rules check the
+*declared* contract statically:
+
+``struct-format-drift``
+    Every module-level ``NAME = struct.Struct("fmt")`` constant in the
+    protocol modules is compiled with the stdlib (a format that does not
+    compile is itself a finding), and every provable-arity use is
+    checked against the format's field count: ``NAME.pack(a, b)``
+    positional arity, ``a, b = NAME.unpack(...)`` /
+    ``NAME.unpack_from(...)`` tuple destructures, and the jute reader's
+    ``a, b = r.read_struct(NAME)`` idiom.  Literal
+    ``struct.pack("fmt", ...)`` / ``struct.unpack("fmt", ...)`` calls
+    get the same treatment.  Uses whose arity is not lexical — starred
+    args, ``[0]`` subscripts, a result bound to one name — stay silent.
+
+``opcode-dispatch-drift``
+    The ``OP_*`` constant family must agree in three places: the
+    module-level definitions, at least one dispatch arm (an ``OP_*``
+    name compared in an ``if``/``elif`` or used as a dispatch-dict
+    key — a code nobody dispatches is dead protocol surface, and an arm
+    for an undefined code is a decoder for frames nobody sends), and
+    the protocol tables in docs/DESIGN.md + docs/OBSERVABILITY.md
+    (backticked ``OP_*`` rows with a numeric value column).  Doc legs
+    are skipped entirely when neither doc carries a table row, so
+    scratch trees without docs only get the code-side check.
+
+``flag-bit-overlap``
+    Flag constants are OR'd into the same byte as the op code
+    (``op | TRACE_FLAG``), so within one module no two ``*FLAG*``
+    constants may share bits, and no flag may share bits with an
+    ``OP_*``/``STATUS_*`` code value — a collision makes a flagged
+    frame indistinguishable from a different opcode.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+import struct
+from typing import Dict, Iterator, List, Optional, Tuple
+
+from checklib.model import Finding
+from checklib.program import ModuleInfo, ProgramModel, _dotted
+from checklib.registry import rule
+from checklib.rules_contracts import read_doc_lines
+
+#: The hand-rolled wire-protocol surface.  Everything else in the tree
+#: may use ``struct`` casually; only these modules carry a contract.
+_SHARD = "registrar_tpu/shard.py"
+_ZK_PREFIX = "registrar_tpu/zk/"
+
+_PROTOCOL_DOCS = ("docs/DESIGN.md", "docs/OBSERVABILITY.md")
+
+_OP_NAME = re.compile(r"^OP_[A-Z0-9_]+$")
+_STATUS_NAME = re.compile(r"^STATUS_[A-Z0-9_]+$")
+#: A protocol-table row: first cell a backticked OP_* name, some later
+#: cell a bare decimal or 0x hex value.
+_DOC_ROW = re.compile(r"^\s*\|\s*`(OP_[A-Z0-9_]+)`\s*\|(.*)$")
+_DOC_VALUE = re.compile(r"^(?:0[xX][0-9a-fA-F]+|\d+)$")
+
+
+def _protocol_modules(model: ProgramModel) -> List[ModuleInfo]:
+    out = []
+    for mod in model.modules.values():
+        if mod.degraded or mod.ctx.tree is None:
+            continue
+        if mod.rel_path == _SHARD or mod.rel_path.startswith(_ZK_PREFIX):
+            out.append(mod)
+    return sorted(out, key=lambda m: m.rel_path)
+
+
+def _toplevel_stmts(tree: ast.Module):
+    """Module-level statements, looking through If/Try wrappers (the
+    same notion of "module level" the binding table uses)."""
+    stack = list(tree.body)
+    while stack:
+        node = stack.pop(0)
+        if isinstance(node, ast.If):
+            stack = node.body + node.orelse + stack
+            continue
+        if isinstance(node, ast.Try):
+            extra = node.body + node.orelse + node.finalbody
+            for h in node.handlers:
+                extra += h.body
+            stack = extra + stack
+            continue
+        yield node
+
+
+def _single_name_assign(stmt) -> Optional[Tuple[str, ast.expr, int]]:
+    if (
+        isinstance(stmt, ast.Assign)
+        and len(stmt.targets) == 1
+        and isinstance(stmt.targets[0], ast.Name)
+    ):
+        return (stmt.targets[0].id, stmt.value, stmt.lineno)
+    return None
+
+
+def _const_int(expr) -> Optional[int]:
+    if isinstance(expr, ast.Constant) and isinstance(expr.value, int):
+        # bool is an int subclass; True as a wire constant is nonsense
+        # but not this rule's business.
+        if isinstance(expr.value, bool):
+            return None
+        return expr.value
+    return None
+
+
+# -- struct-format-drift -------------------------------------------------------
+
+
+def _struct_ctor_fmt(value) -> Optional[str]:
+    """The constant format string when ``value`` is a
+    ``struct.Struct("fmt")`` call, else None."""
+    if not isinstance(value, ast.Call) or value.keywords:
+        return None
+    d = _dotted(value.func)
+    if d is None:
+        return None
+    base, attrs = d
+    last = attrs[-1] if attrs else base
+    if last != "Struct":
+        return None
+    if len(value.args) != 1:
+        return None
+    arg = value.args[0]
+    if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+        return arg.value
+    return None
+
+
+def _field_count(s: struct.Struct) -> int:
+    return len(s.unpack(b"\x00" * s.size))
+
+
+class _StructConst:
+    __slots__ = ("name", "fmt", "fields", "rel", "lineno")
+
+    def __init__(self, name, fmt, fields, rel, lineno):
+        self.name = name
+        self.fmt = fmt
+        self.fields = fields
+        self.rel = rel
+        self.lineno = lineno
+
+
+def _positional_arity(call: ast.Call, skip: int = 0) -> Optional[int]:
+    """Lexical positional-arg count, or None when not provable (starred
+    args or any keywords)."""
+    if call.keywords:
+        return None
+    if any(isinstance(a, ast.Starred) for a in call.args):
+        return None
+    n = len(call.args) - skip
+    return n if n >= 0 else None
+
+
+def _destructure_arity(stmt: ast.Assign) -> Optional[int]:
+    """Number of names a tuple/list destructure binds, or None."""
+    if len(stmt.targets) != 1:
+        return None
+    tgt = stmt.targets[0]
+    if not isinstance(tgt, (ast.Tuple, ast.List)):
+        return None
+    if not all(isinstance(e, ast.Name) for e in tgt.elts):
+        return None  # starred / nested targets: arity not lexical
+    return len(tgt.elts)
+
+
+@rule(
+    "struct-format-drift",
+    "a struct pack/unpack use whose lexical arity disagrees with its "
+    "format string's field count",
+    scope="program",
+)
+def struct_format_drift(model: ProgramModel) -> Iterator[Finding]:
+    mods = _protocol_modules(model)
+    if not mods:
+        return
+
+    consts: Dict[str, _StructConst] = {}
+    ambiguous = set()
+    for mod in mods:
+        for stmt in _toplevel_stmts(mod.ctx.tree):
+            bound = _single_name_assign(stmt)
+            if bound is None:
+                continue
+            name, value, lineno = bound
+            fmt = _struct_ctor_fmt(value)
+            if fmt is None:
+                continue
+            try:
+                fields = _field_count(struct.Struct(fmt))
+            except struct.error as e:
+                yield Finding(
+                    "struct-format-drift",
+                    mod.rel_path,
+                    lineno,
+                    f"struct format {fmt!r} bound to '{name}' does not "
+                    f"compile: {e}",
+                )
+                continue
+            if name in consts and consts[name].fmt != fmt:
+                ambiguous.add(name)  # same name, two formats: punt
+                continue
+            consts[name] = _StructConst(
+                name, fmt, fields, mod.rel_path, lineno
+            )
+    for name in ambiguous:
+        consts.pop(name, None)
+
+    def const_for(expr) -> Optional[_StructConst]:
+        if isinstance(expr, ast.Name):
+            return consts.get(expr.id)
+        return None
+
+    def check_call(call: ast.Call, rel: str):
+        """Arity-check a pack-side call; yields at most one finding."""
+        if not isinstance(call.func, ast.Attribute):
+            return
+        attr = call.func.attr
+        sc = const_for(call.func.value)
+        if sc is not None and attr in ("pack", "pack_into"):
+            skip = 2 if attr == "pack_into" else 0  # buffer, offset
+            n = _positional_arity(call, skip)
+            if n is not None and n != sc.fields:
+                yield Finding(
+                    "struct-format-drift",
+                    rel,
+                    call.lineno,
+                    f"'{sc.name}.{attr}' called with {n} value(s) but "
+                    f"format {sc.fmt!r} packs {sc.fields} field(s)",
+                )
+            return
+        # literal struct.pack("fmt", ...) / struct.calcsize twin
+        if (
+            isinstance(call.func.value, ast.Name)
+            and call.func.value.id == "struct"
+            and attr in ("pack", "pack_into")
+            and call.args
+            and isinstance(call.args[0], ast.Constant)
+            and isinstance(call.args[0].value, str)
+        ):
+            fmt = call.args[0].value
+            try:
+                fields = _field_count(struct.Struct(fmt))
+            except struct.error as e:
+                yield Finding(
+                    "struct-format-drift",
+                    rel,
+                    call.lineno,
+                    f"literal struct format {fmt!r} does not compile: {e}",
+                )
+                return
+            skip = 3 if attr == "pack_into" else 1  # fmt(, buffer, offset)
+            n = _positional_arity(call, skip)
+            if n is not None and n != fields:
+                yield Finding(
+                    "struct-format-drift",
+                    rel,
+                    call.lineno,
+                    f"'struct.{attr}' called with {n} value(s) but "
+                    f"literal format {fmt!r} packs {fields} field(s)",
+                )
+
+    def unpack_source(value) -> Optional[Tuple[str, str, int]]:
+        """(display, fmt-repr, field count) when ``value`` is an unpack
+        call whose format is known, else None."""
+        if not isinstance(value, ast.Call) or not isinstance(
+            value.func, ast.Attribute
+        ):
+            return None
+        attr = value.func.attr
+        if attr in ("unpack", "unpack_from"):
+            sc = const_for(value.func.value)
+            if sc is not None:
+                return (f"{sc.name}.{attr}", repr(sc.fmt), sc.fields)
+            if (
+                isinstance(value.func.value, ast.Name)
+                and value.func.value.id == "struct"
+                and value.args
+                and isinstance(value.args[0], ast.Constant)
+                and isinstance(value.args[0].value, str)
+            ):
+                fmt = value.args[0].value
+                try:
+                    fields = _field_count(struct.Struct(fmt))
+                except struct.error:
+                    return None  # reported by the pack-side scan if bound
+                return (f"struct.{attr}", repr(fmt), fields)
+            return None
+        if attr == "read_struct" and len(value.args) == 1:
+            sc = const_for(value.args[0])
+            if sc is not None:
+                return (f"read_struct({sc.name})", repr(sc.fmt), sc.fields)
+        return None
+
+    for mod in mods:
+        rel = mod.rel_path
+        for node in ast.walk(mod.ctx.tree):
+            if isinstance(node, ast.Call):
+                yield from check_call(node, rel)
+            elif isinstance(node, ast.Assign):
+                arity = _destructure_arity(node)
+                if arity is None:
+                    continue
+                src = unpack_source(node.value)
+                if src is None:
+                    continue
+                display, fmt_repr, fields = src
+                if arity != fields:
+                    yield Finding(
+                        "struct-format-drift",
+                        rel,
+                        node.lineno,
+                        f"'{display}' result destructured into {arity} "
+                        f"name(s) but format {fmt_repr} yields {fields} "
+                        f"field(s)",
+                    )
+
+
+# -- opcode-dispatch-drift -----------------------------------------------------
+
+
+def _doc_op_rows(root: str):
+    """[(name, value, doc_rel, lineno)] from the protocol tables, or []
+    when no doc carries a row (the legs are then skipped)."""
+    rows = []
+    for doc_rel in _PROTOCOL_DOCS:
+        lines = read_doc_lines(os.path.join(root, *doc_rel.split("/")))
+        if lines is None:
+            continue
+        for i, line in enumerate(lines, start=1):
+            m = _DOC_ROW.match(line)
+            if m is None:
+                continue
+            name = m.group(1)
+            value = None
+            for cell in m.group(2).split("|"):
+                cell = cell.strip().strip("`")
+                if _DOC_VALUE.match(cell):
+                    value = int(cell, 0)
+                    break
+            if value is not None:
+                rows.append((name, value, doc_rel, i))
+    return rows
+
+
+@rule(
+    "opcode-dispatch-drift",
+    "OP_* constants drift between definitions, dispatch arms, and the "
+    "docs protocol tables",
+    scope="program",
+)
+def opcode_dispatch_drift(model: ProgramModel) -> Iterator[Finding]:
+    mods = _protocol_modules(model)
+    if not mods:
+        return
+
+    defined: Dict[str, Tuple[int, str, int]] = {}  # name -> (value, rel, line)
+    for mod in mods:
+        for stmt in _toplevel_stmts(mod.ctx.tree):
+            bound = _single_name_assign(stmt)
+            if bound is None:
+                continue
+            name, value, lineno = bound
+            if not _OP_NAME.match(name):
+                continue
+            iv = _const_int(value)
+            if iv is not None and name not in defined:
+                defined[name] = (iv, mod.rel_path, lineno)
+    if not defined:
+        return
+
+    # A dispatch arm is an OP_* name compared against something, or used
+    # as a dispatch-dict key.  Collect (name, rel, lineno) across every
+    # protocol module: the router and worker legitimately split the arms.
+    arms: Dict[str, Tuple[str, int]] = {}
+    for mod in mods:
+        for node in ast.walk(mod.ctx.tree):
+            cands = ()
+            if isinstance(node, ast.Compare):
+                cands = [node.left] + list(node.comparators)
+            elif isinstance(node, ast.Dict):
+                cands = [k for k in node.keys if k is not None]
+            for expr in cands:
+                if isinstance(expr, ast.Name) and _OP_NAME.match(expr.id):
+                    arms.setdefault(expr.id, (mod.rel_path, node.lineno))
+
+    for name in sorted(defined):
+        value, rel, lineno = defined[name]
+        if name not in arms:
+            yield Finding(
+                "opcode-dispatch-drift",
+                rel,
+                lineno,
+                f"op code '{name}' ({value}) has no dispatch arm in any "
+                f"protocol module: dead wire surface, or a frame the "
+                f"peer sends and nobody decodes",
+            )
+    for name in sorted(arms):
+        if name not in defined:
+            rel, lineno = arms[name]
+            yield Finding(
+                "opcode-dispatch-drift",
+                rel,
+                lineno,
+                f"dispatch arm compares undefined op code '{name}': the "
+                f"arm can never match a real frame",
+            )
+
+    root = model.package_root()
+    if root is None:
+        return
+    rows = _doc_op_rows(root)
+    if not rows:
+        return  # no protocol table anywhere: skip the doc legs
+    doc_names = {name for name, _, _, _ in rows}
+    for name, value, doc_rel, doc_line in rows:
+        if name not in defined:
+            yield Finding(
+                "opcode-dispatch-drift",
+                doc_rel,
+                doc_line,
+                f"protocol table documents op code '{name}' but no "
+                f"protocol module defines it",
+            )
+        elif defined[name][0] != value:
+            yield Finding(
+                "opcode-dispatch-drift",
+                doc_rel,
+                doc_line,
+                f"protocol table says '{name}' = {value} but the code "
+                f"defines {defined[name][0]} "
+                f"({defined[name][1]}:{defined[name][2]})",
+            )
+    for name in sorted(defined):
+        if name not in doc_names:
+            value, rel, lineno = defined[name]
+            yield Finding(
+                "opcode-dispatch-drift",
+                rel,
+                lineno,
+                f"op code '{name}' ({value}) is missing from the "
+                f"protocol tables in {' / '.join(_PROTOCOL_DOCS)}",
+            )
+
+
+# -- flag-bit-overlap ----------------------------------------------------------
+
+
+@rule(
+    "flag-bit-overlap",
+    "wire flag constants share bits with each other or with op/status "
+    "codes in the same byte",
+    scope="program",
+)
+def flag_bit_overlap(model: ProgramModel) -> Iterator[Finding]:
+    for mod in _protocol_modules(model):
+        flags: List[Tuple[str, int, int]] = []
+        codes: List[Tuple[str, int, int]] = []
+        for stmt in _toplevel_stmts(mod.ctx.tree):
+            bound = _single_name_assign(stmt)
+            if bound is None:
+                continue
+            name, value, lineno = bound
+            iv = _const_int(value)
+            if iv is None:
+                continue
+            if "FLAG" in name:
+                flags.append((name, iv, lineno))
+            elif _OP_NAME.match(name) or _STATUS_NAME.match(name):
+                codes.append((name, iv, lineno))
+        for i, (a, av, _) in enumerate(flags):
+            for b, bv, bline in flags[i + 1:]:
+                if av & bv:
+                    yield Finding(
+                        "flag-bit-overlap",
+                        mod.rel_path,
+                        bline,
+                        f"flag constants '{a}' (0x{av:02x}) and '{b}' "
+                        f"(0x{bv:02x}) share bits 0x{av & bv:02x}: the "
+                        f"wire field cannot represent both",
+                    )
+        for fname, fv, _ in flags:
+            for cname, cv, cline in codes:
+                if fv & cv:
+                    yield Finding(
+                        "flag-bit-overlap",
+                        mod.rel_path,
+                        cline,
+                        f"'{fname}' (0x{fv:02x}) shares bits with code "
+                        f"'{cname}' ({cv}): a flagged frame becomes "
+                        f"indistinguishable from op 0x{fv | cv:02x}",
+                    )
