@@ -22,6 +22,9 @@ Exported metrics (all prefixed ``registrar_``):
     registrar_heartbeats_total{status}  znode probes, status="ok"|"failure"
     registrar_health_transitions_total{to}  threshold crossings, to="down"|"up"
     registrar_errors_total              'error' events from any subsystem
+    registrar_malformed_frames_total{surface}  malformed peer frames rejected
+                                        at a decode boundary (jute, zk
+                                        framing/handshake, shard wire)
     registrar_health_down               1 while deregistered by health, else 0
     registrar_znodes_owned              znodes this instance maintains
     registrar_zk_connected              1 while the ZK session is connected
@@ -96,6 +99,7 @@ import logging
 import time
 from typing import Callable, Dict, List, Optional, Tuple
 
+from registrar_tpu import malformed as malformed_mod
 from registrar_tpu import reconcile as reconcile_mod
 from registrar_tpu import trace as trace_mod
 
@@ -590,6 +594,17 @@ def instrument(ee, zk, registry: Optional[MetricsRegistry] = None) -> MetricsReg
     )
     errors = reg.counter(
         "registrar_errors_total", "Unexpected errors from any subsystem"
+    )
+    malformed_frames = reg.counter(
+        "registrar_malformed_frames_total",
+        "Malformed peer frames rejected at a decode boundary, by surface",
+    )
+    for surface in malformed_mod.SURFACES:
+        # Pre-seed every surface's zero series (the registry convention:
+        # alert rate()s must see the series from the first scrape).
+        malformed_frames.inc(0, labels={"surface": surface})
+    malformed_mod.subscribe(
+        lambda surface: malformed_frames.inc(labels={"surface": surface})
     )
     down = reg.gauge(
         "registrar_health_down",

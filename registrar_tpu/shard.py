@@ -116,7 +116,7 @@ import time
 from contextlib import nullcontext
 from typing import Dict, Iterable, List, Optional, Sequence, Tuple
 
-from registrar_tpu import binderview, trace, traceview
+from registrar_tpu import binderview, malformed, trace, traceview
 from registrar_tpu.binderview import Answer, Resolution
 from registrar_tpu.events import EventEmitter, spawn_owned
 from registrar_tpu.retry import RetryPolicy, is_transient
@@ -255,6 +255,7 @@ def split_traced(frame, op: int):
     if not op & TRACE_FLAG:
         return op, None, memoryview(frame)[_HDR.size:]
     if len(frame) < _HDR.size + _TRACE_CTX.size:
+        malformed.note("shard")
         raise ShardError(
             f"traced frame too short for context block ({len(frame)})"
         )
@@ -310,9 +311,26 @@ def pack_resolve(name: str, qtype: str = "A", live: bool = False) -> bytes:
 
 def resolve_name(body) -> str:
     """The domain inside an OP_RESOLVE body — all the router ever parses
-    of a resolve request (it hashes the name and forwards the body)."""
+    of a resolve request (it hashes the name and forwards the body).
+
+    Rejects malformed bodies as :class:`ShardError` — the single
+    contract class the relay path answers with STATUS_ERR (a hostile
+    qtype length must bound-check against the body, not silently slice
+    past it)."""
+    if len(body) < 2:
+        malformed.note("shard")
+        raise ShardError(f"resolve body too short ({len(body)} bytes)")
     qlen = body[1]
-    return bytes(body[2 + qlen:]).decode("utf-8")
+    if 2 + qlen > len(body):
+        malformed.note("shard")
+        raise ShardError(
+            f"resolve qtype length {qlen} overruns body ({len(body)} bytes)"
+        )
+    try:
+        return bytes(body[2 + qlen:]).decode("utf-8")
+    except UnicodeDecodeError as err:
+        malformed.note("shard")
+        raise ShardError(f"resolve name not UTF-8: {err}") from err
 
 
 def encode_resolution(res: Resolution) -> bytes:
@@ -343,6 +361,7 @@ async def _read_frame(reader: asyncio.StreamReader) -> Optional[bytes]:
         return None
     (size,) = struct.unpack(">I", head)
     if size < _HDR.size or size > MAX_FRAME:
+        malformed.note("shard")
         raise ShardError(f"bad frame length {size}")
     try:
         return await reader.readexactly(size)
@@ -701,10 +720,23 @@ class ShardWorker:
         raise ShardError(f"unknown op {op}")
 
     async def _resolve(self, body: memoryview) -> bytes:
+        if len(body) < 2:
+            malformed.note("shard")
+            raise ShardError(f"resolve body too short ({len(body)} bytes)")
         live = bool(body[0] & 1)
         qlen = body[1]
-        qtype = bytes(body[2 : 2 + qlen]).decode("ascii")
-        name = bytes(body[2 + qlen :]).decode("utf-8").rstrip(".").lower()
+        if 2 + qlen > len(body):
+            malformed.note("shard")
+            raise ShardError(
+                f"resolve qtype length {qlen} overruns body "
+                f"({len(body)} bytes)"
+            )
+        try:
+            qtype = bytes(body[2 : 2 + qlen]).decode("ascii")
+            name = bytes(body[2 + qlen :]).decode("utf-8").rstrip(".").lower()
+        except UnicodeDecodeError as err:
+            malformed.note("shard")
+            raise ShardError(f"resolve body not decodable: {err}") from err
         if live:
             res = await binderview.resolve(self.zk, name, qtype)
             self.resolves_total += 1
@@ -1397,7 +1429,7 @@ class ShardRouter(EventEmitter):
         """
         try:
             name = resolve_name(body).rstrip(".").lower()
-        except (IndexError, UnicodeDecodeError) as err:
+        except ShardError as err:
             return STATUS_ERR, f"bad resolve request: {err!r}".encode()
         owner = self.ring.owner(name)
         handle = self._workers.get(owner)

@@ -78,7 +78,8 @@ from registrar_tpu.retry import (
     call_with_backoff,
 )
 from registrar_tpu.zk import protocol as proto
-from registrar_tpu.zk.framing import FrameReader
+from registrar_tpu import malformed
+from registrar_tpu.zk.framing import MAX_FRAME, FrameReader
 from registrar_tpu.zk.jute import Reader, Writer
 from registrar_tpu.zk.protocol import (
     CreateFlag,
@@ -602,6 +603,12 @@ class ZKClient(EventEmitter):
             await asyncio.wait_for(writer.drain(), step_timeout())
             hdr = await asyncio.wait_for(reader.readexactly(4), step_timeout())
             length = int.from_bytes(hdr, "big", signed=True)
+            if length < 0 or length > MAX_FRAME:
+                # A garbage length prefix here is pre-session: nothing
+                # to resynchronize against, so drop the connection (the
+                # reconnect loop owns the retry).
+                malformed.note("zk_client")
+                raise ConnectionError(f"bad handshake frame length {length}")
             payload = await asyncio.wait_for(
                 reader.readexactly(length), step_timeout()
             )

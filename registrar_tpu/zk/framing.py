@@ -33,6 +33,8 @@ from __future__ import annotations
 from collections import deque
 from typing import Deque, List, Optional
 
+from registrar_tpu import malformed
+
 MAX_FRAME = 4 * 1024 * 1024  # matches real ZK's default jute.maxbuffer
 _READ_SIZE = 65536
 
@@ -167,6 +169,7 @@ class FrameReader:
         while self._size >= 4:
             length = self._peek4()
             if length < 0 or length > MAX_FRAME:
+                malformed.note("zk_framing")
                 raise ConnectionError(f"bad frame length {length}")
             if self._size - 4 < length:
                 break
@@ -224,6 +227,7 @@ class FrameReader:
             length = self._peek4()
             self._skip(4)
         if length < 0 or length > MAX_FRAME:
+            malformed.note("zk_framing")
             return None
         if not await self._need(length):
             return None

@@ -20,6 +20,8 @@ from __future__ import annotations
 import struct
 from typing import Callable, List, Optional, TypeVar
 
+from registrar_tpu import malformed
+
 T = TypeVar("T")
 
 _INT = struct.Struct(">i")
@@ -125,6 +127,7 @@ class Reader:
         copy for ``bytes`` input, a zero-copy subview for ``memoryview``
         input.  Internal: callers materialize or decode as needed."""
         if self.remaining() < n:
+            malformed.note("jute")
             raise JuteError(
                 f"truncated jute data: need {n} bytes at offset {self._pos}, "
                 f"have {self.remaining()}"
@@ -171,6 +174,7 @@ class Reader:
         :func:`registrar_tpu.zk.protocol.stat_owner_from_reply`)."""
         pos = self._pos + offset
         if offset < 0 or len(self._data) - pos < 8:
+            malformed.note("jute")
             raise JuteError(
                 f"truncated jute data: need 8 bytes at offset {pos}, "
                 f"have {max(len(self._data) - pos, 0)}"
@@ -182,6 +186,7 @@ class Reader:
         if n == -1:
             return None
         if n < -1:
+            malformed.note("jute")
             raise JuteError(f"negative buffer length: {n}")
         out = self._take(n)
         # Materialize exactly once: a view escaping here would pin the
@@ -193,20 +198,27 @@ class Reader:
         if n == -1:
             return None
         if n < -1:
+            malformed.note("jute")
             raise JuteError(f"negative buffer length: {n}")
         # Decode straight off the buffer slice (bytes or view): one
         # string allocation, no intermediate bytes copy for views.
-        return str(self._take(n), "utf-8")
+        try:
+            return str(self._take(n), "utf-8")
+        except UnicodeDecodeError as err:
+            malformed.note("jute")
+            raise JuteError(f"string not UTF-8: {err}") from err
 
     def read_vector(self, read_item: Callable[["Reader"], T]) -> Optional[List[T]]:
         n = self.read_int()
         if n == -1:
             return None
         if n < -1:
+            malformed.note("jute")
             raise JuteError(f"negative vector length: {n}")
         if n > self.remaining():
             # Every element costs >= 1 byte, so a count beyond the buffer
             # is malformed; reject before allocating the list (a hostile
             # frame could otherwise declare a 2^31 count).
+            malformed.note("jute")
             raise JuteError(f"vector length {n} exceeds remaining data")
         return [read_item(self) for _ in range(n)]

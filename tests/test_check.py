@@ -496,6 +496,99 @@ PROGRAM_SEEDED_VIOLATIONS = {
             PRIORITY_FLAG = 0xC0
             """,
     },
+    # --- generation 5 (ISSUE 16) ---
+    "unbounded-peer-allocation": {
+        "registrar_tpu/shard.py": """\
+            import struct
+
+
+            def parse(frame):
+                (count,) = struct.unpack(">I", frame[:4])
+                return b"\\x00" * count
+            """,
+    },
+    "unvalidated-count-loop": {
+        "registrar_tpu/zk/jute.py": """\
+            import struct
+
+            _INT = struct.Struct(">i")
+
+
+            class Reader:
+                def __init__(self, data):
+                    self._data = data
+                    self._pos = 0
+
+                def read_int(self):
+                    (value,) = _INT.unpack_from(self._data, self._pos)
+                    self._pos += 4
+                    return value
+            """,
+        "registrar_tpu/seeded.py": """\
+            def load_items(r):
+                n = r.read_int()
+                return [r.read_int() for _ in range(n)]
+            """,
+    },
+    "unchecked-peer-read-size": {
+        "registrar_tpu/shard.py": """\
+            import struct
+
+
+            async def read_frame(reader):
+                head = await reader.readexactly(4)
+                (size,) = struct.unpack(">I", head)
+                return await reader.readexactly(size)
+            """,
+    },
+    "taint-boundary-drift": {
+        "registrar_tpu/shard.py": """\
+            import struct
+
+
+            def parse(frame):
+                (count,) = struct.unpack(">I", frame[:4])
+                if count > 64:
+                    raise ValueError("count too large")
+                return b"\\x00" * count
+            """,
+        "docs/DESIGN.md": """\
+            # Design
+
+            ## Appendix: trust boundary (taint sources and sinks)
+
+            | Pattern | Role | Module | Meaning |
+            |---|---|---|---|
+            | `read_int` | source | `registrar_tpu/zk/jute.py` | stale row |
+            | `bytes` | sink | — | allocation sized by arg |
+            | `bytearray` | sink | — | allocation sized by arg |
+            | `range` | sink | — | loop bound |
+            | `readexactly` | sink | — | stream read size |
+            | `_take` | sink | — | buffer carve size |
+            | `_skip` | sink | — | buffer skip size |
+            | `slice` | sink | — | slice bound |
+            | `sequence-repeat` | sink | — | repeat count |
+            | `recursion` | sink | — | tainted self-recursion |
+            """,
+    },
+    "stale-read-across-await": {
+        "registrar_tpu/agent.py": """\
+            import asyncio
+
+            repair_lock = asyncio.Lock()
+
+
+            async def guarded(ee):
+                async with repair_lock:
+                    ee.count = 0
+
+
+            async def bump(ee):
+                snap = ee.count
+                await asyncio.sleep(0)
+                ee.count = snap + 1
+            """,
+    },
 }
 
 EXPECTED_RULES = sorted(
@@ -2802,6 +2895,292 @@ def test_lifecycle_escape_path_leak_fires(tmp_path):
     assert "no release sits in a finally" in finding.message
     symbols = [hop["symbol"] for hop in finding.chain]
     assert symbols[0] == "proxy = ChaosProxy(...)"
+
+
+# --- generation 5: taint flow + await atomicity (ISSUE 16) -------------------
+
+
+def test_unbounded_allocation_chain_in_json_report(tmp_path):
+    tree = seed_program_tree(
+        tmp_path, PROGRAM_SEEDED_VIOLATIONS["unbounded-peer-allocation"]
+    )
+    proc = run_checker(
+        "registrar_tpu", "--no-baseline", "--format", "json", cwd=tree
+    )
+    assert proc.returncode == 1
+    (finding,) = json.loads(proc.stdout)["problems"]
+    assert finding["rule"] == "unbounded-peer-allocation"
+    # the witness chain walks peer read -> sized allocation, hop for hop
+    assert [h["symbol"] for h in finding["chain"]] == [
+        "unpack (peer read)",
+        "tainted * sequence",
+    ]
+    assert all(
+        set(h) == {"symbol", "path", "line"}
+        and h["path"] == "registrar_tpu/shard.py"
+        and h["line"] > 0
+        for h in finding["chain"]
+    )
+    # the names-only chain rides in the message (baseline identity)
+    assert "chain:" in finding["message"]
+    assert "unpack (peer read)" in finding["message"]
+
+
+def test_count_loop_chain_crosses_modules(tmp_path):
+    # The interprocedural leg: the peer read lives in the jute reader,
+    # the unchecked range() two modules away — the chain must carry the
+    # cross-module hop.
+    tree = seed_program_tree(
+        tmp_path, PROGRAM_SEEDED_VIOLATIONS["unvalidated-count-loop"]
+    )
+    proc = run_checker(
+        "registrar_tpu", "--no-baseline", "--format", "json", cwd=tree
+    )
+    assert proc.returncode == 1
+    (finding,) = json.loads(proc.stdout)["problems"]
+    assert finding["rule"] == "unvalidated-count-loop"
+    assert finding["path"] == "registrar_tpu/seeded.py"
+    chain = finding["chain"]
+    assert [h["symbol"] for h in chain] == [
+        "unpack_from (peer read)",
+        "registrar_tpu.seeded:load_items",
+        "range(tainted)",
+    ]
+    assert chain[0]["path"] == "registrar_tpu/zk/jute.py"
+    assert chain[-1]["path"] == "registrar_tpu/seeded.py"
+
+
+def test_peer_read_size_chain_in_json_and_sarif(tmp_path):
+    tree = seed_program_tree(
+        tmp_path, PROGRAM_SEEDED_VIOLATIONS["unchecked-peer-read-size"]
+    )
+    proc = run_checker(
+        "registrar_tpu", "--no-baseline", "--format", "json", cwd=tree
+    )
+    assert proc.returncode == 1
+    (finding,) = json.loads(proc.stdout)["problems"]
+    assert finding["rule"] == "unchecked-peer-read-size"
+    symbols = [h["symbol"] for h in finding["chain"]]
+    assert symbols == ["unpack (peer read)", "readexactly(tainted)"]
+    # the same hops, in order, in the SARIF codeFlow
+    proc = run_checker(
+        "registrar_tpu", "--no-baseline", "--format", "sarif", cwd=tree
+    )
+    assert proc.returncode == 1
+    (result,) = json.loads(proc.stdout)["runs"][0]["results"]
+    assert result["ruleId"] == "unchecked-peer-read-size"
+    (flow,) = result["codeFlows"]
+    (thread,) = flow["threadFlows"]
+    assert [
+        h["location"]["message"]["text"] for h in thread["locations"]
+    ] == symbols
+
+
+def test_stale_read_chain_in_json_report(tmp_path):
+    tree = seed_program_tree(
+        tmp_path, PROGRAM_SEEDED_VIOLATIONS["stale-read-across-await"]
+    )
+    proc = run_checker(
+        "registrar_tpu", "--no-baseline", "--format", "json", cwd=tree
+    )
+    assert proc.returncode == 1
+    (finding,) = json.loads(proc.stdout)["problems"]
+    assert finding["rule"] == "stale-read-across-await"
+    # anchored at the stale read; three hops read -> await -> write
+    assert [h["symbol"] for h in finding["chain"]] == [
+        "read ee.count",
+        "await",
+        "write ee.count",
+    ]
+    assert finding["line"] == finding["chain"][0]["line"]
+
+
+def test_taint_boundary_drift_fires_both_directions(tmp_path):
+    # The fixture seeds both legs at once: a stale source row (jute
+    # read_int with no such call site) and a live peer read (shard
+    # struct.unpack) with no row.  The sink vocabulary is complete, so
+    # only the source directions fire.
+    tree = seed_program_tree(
+        tmp_path, PROGRAM_SEEDED_VIOLATIONS["taint-boundary-drift"]
+    )
+    proc = run_checker(
+        "registrar_tpu", "--no-baseline", "--format", "json", cwd=tree
+    )
+    assert proc.returncode == 1
+    problems = json.loads(proc.stdout)["problems"]
+    assert {p["rule"] for p in problems} == {"taint-boundary-drift"}
+    msgs = sorted(p["message"] for p in problems)
+    assert any(
+        "declares source 'read_int'" in m and "stale row" in m
+        for m in msgs
+    )
+    assert any(
+        "peer-read call 'unpack'" in m and "missing from" in m
+        for m in msgs
+    )
+    # the stale-row leg anchors in the doc, the missing-row leg in code
+    paths = {p["path"] for p in problems}
+    assert paths == {"docs/DESIGN.md", "registrar_tpu/shard.py"}
+
+
+def test_bound_check_sanitizes_peer_allocation(tmp_path):
+    # The taint-boundary-drift fixture's shard.py is exactly the
+    # unbounded-peer-allocation fixture plus a dominating bound check —
+    # run it WITHOUT the docs table and the allocation rule must stay
+    # silent (the comparison against a constant cleanses the count).
+    files = dict(PROGRAM_SEEDED_VIOLATIONS["taint-boundary-drift"])
+    del files["docs/DESIGN.md"]
+    tree = seed_program_tree(tmp_path, files)
+    proc = run_checker("registrar_tpu", "--no-baseline", cwd=tree)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+def test_epoch_recheck_sanctions_stale_read(tmp_path):
+    # The agent's repair idiom: snapshot, await, then consult an epoch
+    # field of the SAME receiver in a guard before writing back — the
+    # guard load between the await and the write sanctions the write.
+    tree = seed_program_tree(tmp_path, {
+        "registrar_tpu/agent.py": """\
+            import asyncio
+
+            repair_lock = asyncio.Lock()
+
+
+            async def guarded(ee):
+                async with repair_lock:
+                    ee.count = 0
+
+
+            async def bump(ee):
+                snap = ee.count
+                epoch = ee.epoch
+                await asyncio.sleep(0)
+                if ee.epoch != epoch:
+                    return
+                ee.count = snap + 1
+            """,
+    })
+    proc = run_checker("registrar_tpu", "--no-baseline", cwd=tree)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+def test_reread_after_await_sanctions_stale_read(tmp_path):
+    tree = seed_program_tree(tmp_path, {
+        "registrar_tpu/agent.py": """\
+            import asyncio
+
+            repair_lock = asyncio.Lock()
+
+
+            async def guarded(ee):
+                async with repair_lock:
+                    ee.count = 0
+
+
+            async def bump(ee):
+                snap = ee.count
+                await asyncio.sleep(0)
+                snap = ee.count
+                ee.count = snap + 1
+            """,
+    })
+    proc = run_checker("registrar_tpu", "--no-baseline", cwd=tree)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+def test_lock_block_sanctions_stale_read(tmp_path):
+    # Read and write inside ONE `async with lock` block: the lock owns
+    # the atomicity (the async-with entry is an await point, but it sits
+    # before the read, not between read and write).
+    tree = seed_program_tree(tmp_path, {
+        "registrar_tpu/agent.py": """\
+            import asyncio
+
+            repair_lock = asyncio.Lock()
+
+
+            async def bump(ee):
+                async with repair_lock:
+                    snap = ee.count
+                    await asyncio.sleep(0)
+                    ee.count = snap + 1
+            """,
+    })
+    proc = run_checker("registrar_tpu", "--no-baseline", cwd=tree)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+def test_reload_base_pinning_shape_stays_silent(tmp_path):
+    # The config-reload idiom (agent.py): snapshot `_applied_desired`,
+    # take the single-flight lock (an await point), consult receiver
+    # fields in guards, write the pin back — sanctioned by the guard
+    # loads, never reported.  The Entry class defines the private attr
+    # so the foreign-receiver poke is same-module cooperation.
+    tree = seed_program_tree(tmp_path, {
+        "registrar_tpu/agent.py": """\
+            class Entry:
+                def __init__(self):
+                    self._applied_desired = None
+                    self.down = False
+
+
+            async def reload(ee, lock):
+                base = ee._applied_desired
+                async with lock:
+                    if ee.down:
+                        ee._applied_desired = None
+                        return "applied"
+                    ee._applied_desired = base
+                return "noop"
+            """,
+    })
+    proc = run_checker("registrar_tpu", "--no-baseline", cwd=tree)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+def test_zkcache_gen_counter_shape_stays_silent(tmp_path):
+    # The ZKCache generation-counter idiom: the epoch-ish `_gens` dict
+    # is read through .get() and written through a subscript — neither
+    # is a whole-field snapshot/clobber, so the scan has nothing to say.
+    tree = seed_program_tree(tmp_path, {
+        "registrar_tpu/zkcache.py": """\
+            import asyncio
+
+
+            class ZKCache:
+                def __init__(self):
+                    self._gens = {}
+
+                async def lookup(self, path):
+                    gen = self._gens.get(path, 0)
+                    await asyncio.sleep(0)
+                    if self._gens.get(path, 0) != gen:
+                        return None
+                    return gen
+            """,
+    })
+    proc = run_checker("registrar_tpu", "--no-baseline", cwd=tree)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+def test_taint_stats_in_json_block(tmp_path):
+    # --stats must carry the generation-5 phase numbers so the CI
+    # summary can echo them.
+    tree = seed_program_tree(
+        tmp_path, PROGRAM_SEEDED_VIOLATIONS["unbounded-peer-allocation"]
+    )
+    proc = run_checker(
+        "registrar_tpu", "--no-baseline", "--format", "json", "--stats",
+        cwd=tree,
+    )
+    prog = json.loads(proc.stdout)["stats"]["program"]
+    for key in (
+        "taint_sources", "taint_sinks", "taint_sanitized",
+        "taint_build_s", "atomicity_tracked", "atomicity_build_s",
+    ):
+        assert key in prog, key
+    assert prog["taint_sources"] >= 1
+    assert prog["taint_sinks"] >= 1
 
 
 # --- SARIF output ------------------------------------------------------------

@@ -8,6 +8,7 @@ no server crashes.
 
 import asyncio
 import random
+import struct
 
 import pytest
 
@@ -63,18 +64,40 @@ class TestJuteProperties:
     @given(st.binary(max_size=512))
     @settings(max_examples=300)
     def test_arbitrary_bytes_never_crash_reader(self, data):
-        """Malformed input must yield JuteError/Unicode errors only."""
+        """Malformed input must yield the typed JuteError ONLY — since
+        ISSUE 16 even invalid UTF-8 in read_ustring is wrapped, so a
+        decode loop needs exactly one except clause."""
         r = Reader(data)
+        fixed = struct.Struct(">iq")
         for op in (Reader.read_int, Reader.read_long, Reader.read_bool,
-                   Reader.read_buffer, Reader.read_ustring):
+                   Reader.read_buffer, Reader.read_ustring,
+                   lambda rr: rr.long_at(0),
+                   lambda rr: rr.read_struct(fixed)):
             try:
                 op(Reader(data))
-            except (JuteError, UnicodeDecodeError):
+            except JuteError:
                 pass
         try:
             r.read_vector(Reader.read_ustring)
-        except (JuteError, UnicodeDecodeError):
+        except JuteError:
             pass
+
+    @given(st.binary(max_size=256))
+    @settings(max_examples=200)
+    def test_memoryview_input_parity(self, data):
+        # The zero-copy path (the frame layer hands replies over as
+        # memoryviews) must accept and reject byte-for-byte like bytes.
+        def script(reader):
+            out = []
+            try:
+                out.append(reader.read_int())
+                out.append(reader.read_buffer())
+                out.append(reader.read_ustring())
+            except JuteError as err:
+                out.append(("reject", str(err)))
+            return out
+
+        assert script(Reader(data)) == script(Reader(memoryview(data)))
 
 
 class TestRecordProperties:
@@ -317,6 +340,112 @@ class TestClientFuzz:
             await client.close()
             srv.close()
             await srv.wait_closed()
+
+
+class TestShardWireFuzz:
+    """ISSUE 16: the sharded serve tier's decode boundary — arbitrary
+    bytes land in ShardError (the class the relay answers STATUS_ERR)
+    or decode cleanly; never MemoryError/IndexError/struct.error."""
+
+    @given(st.binary(max_size=128))
+    @settings(max_examples=300)
+    def test_resolve_name_contract(self, body):
+        from registrar_tpu.shard import ShardError, resolve_name
+
+        try:
+            name = resolve_name(body)
+        except ShardError:
+            return
+        assert isinstance(name, str)
+
+    @given(
+        st.text(max_size=32),
+        st.sampled_from(["A", "AAAA", "SRV", "TXT"]),
+        st.booleans(),
+    )
+    def test_resolve_name_roundtrips_well_formed_bodies(
+        self, name, qtype, live
+    ):
+        from registrar_tpu.shard import pack_resolve, resolve_name
+
+        assert resolve_name(pack_resolve(name, qtype, live)) == name
+
+    @given(st.binary(max_size=64), st.integers(0, 0xFF))
+    @settings(max_examples=300)
+    def test_split_traced_contract(self, frame, op):
+        from registrar_tpu.shard import ShardError, TRACE_FLAG, split_traced
+
+        try:
+            out_op, ctx, body = split_traced(frame, op)
+        except ShardError:
+            return
+        assert 0 <= out_op <= 0xFF and not out_op & TRACE_FLAG
+        assert ctx is None or len(ctx) == 3
+        assert bytes(body) in bytes(frame)
+
+    @given(st.binary(min_size=4, max_size=64))
+    @settings(max_examples=200, deadline=None)
+    def test_read_frame_contract(self, prefix):
+        from registrar_tpu.shard import ShardError, _read_frame
+
+        class _Scripted:
+            def __init__(self, data):
+                self._data = data
+
+            async def readexactly(self, n):
+                if len(self._data) < n:
+                    raise asyncio.IncompleteReadError(self._data, n)
+                out, self._data = self._data[:n], self._data[n:]
+                return out
+
+        try:
+            frame = asyncio.run(_read_frame(_Scripted(prefix)))
+        except ShardError:
+            return
+        assert frame is None or len(frame) == int.from_bytes(
+            prefix[:4], "big"
+        )
+
+
+class TestFramingFuzz:
+    """ISSUE 16: well-formed frames followed by arbitrary trailing
+    garbage, split at an arbitrary chunk boundary — every complete
+    frame carves in order and the only possible raise is the framing
+    contract ConnectionError."""
+
+    @given(
+        st.lists(st.binary(max_size=32), max_size=4),
+        st.binary(max_size=16),
+        st.integers(0, 160),
+    )
+    @settings(max_examples=300, deadline=None)
+    def test_carve_or_reject(self, payloads, garbage, cut):
+        from registrar_tpu.zk.framing import FrameReader
+
+        class _Scripted:
+            def __init__(self, chunks):
+                self._chunks = [c for c in chunks if c]
+
+            async def read(self, _n):
+                return self._chunks.pop(0) if self._chunks else b""
+
+        wire = b"".join(
+            len(p).to_bytes(4, "big") + p for p in payloads
+        ) + garbage
+        cut = min(cut, len(wire))
+        fr = FrameReader(_Scripted([wire[:cut], wire[cut:]]))
+
+        async def go():
+            carved = []
+            while await fr.fill():
+                carved.extend(fr.carve())
+            return carved
+
+        try:
+            carved = [bytes(f) for f in asyncio.run(go())]
+        except ConnectionError:
+            return  # garbage corrupted a length prefix
+        assert carved[: len(payloads)] == payloads
 
 
 class TestChrootMapping:

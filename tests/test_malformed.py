@@ -1,0 +1,243 @@
+"""Golden malformed-input regression suite (ISSUE 16 satellite).
+
+Every hostile or corrupt input a peer can feed a decode boundary must
+land in that surface's contract class — ``JuteError`` (jute
+deserialization), ``ConnectionError`` (stream framing / handshake),
+``ShardError`` (shard wire protocol) — and NEVER in ``MemoryError``,
+``IndexError``, ``struct.error``, or ``UnicodeDecodeError``.  The inputs
+here are the frozen counterexamples (each one a shape the generation-5
+taint rules reason about: a length that overruns, a negative count, a
+count that would size an allocation); tests/test_fuzz.py generalizes
+them property-style when hypothesis is installed.
+
+Each reject is also tallied: ``registrar_tpu.malformed.note()`` feeds
+``registrar_malformed_frames_total{surface}`` (docs/OPERATIONS.md), so
+the goldens assert the counter moves with the raise.
+"""
+
+import asyncio
+import struct
+
+import pytest
+
+from registrar_tpu import malformed
+from registrar_tpu.shard import (
+    _HDR,
+    _read_frame,
+    resolve_name,
+    split_traced,
+    ShardError,
+    TRACE_FLAG,
+)
+from registrar_tpu.zk.framing import MAX_FRAME, FrameReader
+from registrar_tpu.zk.jute import JuteError, Reader, Writer
+
+#: Exception classes that must NEVER escape a decode boundary — each one
+#: is a symptom of trusting a peer-supplied size before validating it.
+FORBIDDEN = (MemoryError, IndexError, struct.error, UnicodeDecodeError)
+
+
+def surface_count(surface):
+    return malformed.counts()[surface]
+
+
+def assert_rejects(surface, contract, fn, *args):
+    """``fn(*args)`` must raise exactly the surface's contract class and
+    bump the surface's malformed tally by one."""
+    before = surface_count(surface)
+    try:
+        fn(*args)
+    except contract:
+        pass
+    except FORBIDDEN as err:  # pragma: no cover - the regression itself
+        pytest.fail(f"non-contract escape: {err!r}")
+    else:
+        pytest.fail("malformed input was accepted")
+    assert surface_count(surface) == before + 1
+
+
+class _FakeReader:
+    """StreamReader stand-in serving scripted read()/readexactly() data."""
+
+    def __init__(self, data: bytes):
+        self._data = data
+
+    async def read(self, n):
+        out, self._data = self._data[:n], self._data[n:]
+        return out
+
+    async def readexactly(self, n):
+        if len(self._data) < n:
+            raise asyncio.IncompleteReadError(self._data, n)
+        out, self._data = self._data[:n], self._data[n:]
+        return out
+
+
+class TestJuteGoldens:
+    def test_truncated_take(self):
+        assert_rejects("jute", JuteError, Reader(b"\x01\x02")._take, 3)
+
+    def test_truncated_int(self):
+        assert_rejects("jute", JuteError, Reader(b"\x00\x00").read_int)
+
+    def test_truncated_struct_run(self):
+        st = struct.Struct(">iq")
+        assert_rejects("jute", JuteError, Reader(b"\x00" * 8).read_struct, st)
+
+    def test_long_at_negative_offset(self):
+        assert_rejects("jute", JuteError, Reader(b"\x00" * 16).long_at, -4)
+
+    def test_long_at_past_end(self):
+        assert_rejects("jute", JuteError, Reader(b"\x00" * 8).long_at, 4)
+
+    def test_buffer_negative_length(self):
+        # -1 means null; anything below is malformed, not a size.
+        body = Writer().write_int(-2).to_bytes()
+        assert_rejects("jute", JuteError, Reader(body).read_buffer)
+
+    def test_buffer_length_overruns_data(self):
+        # The classic allocation bomb: four bytes claim 2 GiB.  The
+        # truncation check must fire before any allocation happens.
+        body = Writer().write_int(0x7FFFFFFF).to_bytes()
+        assert_rejects("jute", JuteError, Reader(body).read_buffer)
+
+    def test_ustring_invalid_utf8(self):
+        body = Writer().write_int(2).to_bytes() + b"\xff\xfe"
+        assert_rejects("jute", JuteError, Reader(body).read_ustring)
+
+    def test_vector_negative_count(self):
+        body = Writer().write_int(-7).to_bytes()
+        assert_rejects(
+            "jute", JuteError, Reader(body).read_vector, Reader.read_int
+        )
+
+    def test_vector_count_exceeds_remaining(self):
+        # A count the buffer cannot possibly hold must reject BEFORE the
+        # element list is allocated.
+        body = Writer().write_int(1 << 30).to_bytes() + b"\x00" * 8
+        assert_rejects(
+            "jute", JuteError, Reader(body).read_vector, Reader.read_int
+        )
+
+    def test_null_sentinels_still_decode(self):
+        # The -1 null sentinel is well-formed: no raise, no tally.
+        before = surface_count("jute")
+        body = Writer().write_int(-1).to_bytes()
+        assert Reader(body).read_buffer() is None
+        assert Reader(body).read_ustring() is None
+        assert Reader(body).read_vector(Reader.read_int) is None
+        assert surface_count("jute") == before
+
+
+class TestFramingGoldens:
+    @staticmethod
+    def _carve(prefix: bytes):
+        fr = FrameReader(_FakeReader(prefix))
+
+        async def go():
+            assert await fr.fill()
+            return fr.carve()
+
+        return asyncio.run(go())
+
+    def test_negative_length_prefix(self):
+        assert_rejects(
+            "zk_framing",
+            ConnectionError,
+            self._carve,
+            (-1).to_bytes(4, "big", signed=True),
+        )
+
+    def test_oversized_length_prefix(self):
+        assert_rejects(
+            "zk_framing",
+            ConnectionError,
+            self._carve,
+            (MAX_FRAME + 1).to_bytes(4, "big"),
+        )
+
+
+class TestShardGoldens:
+    def test_resolve_body_too_short(self):
+        assert_rejects("shard", ShardError, resolve_name, b"\x00")
+
+    def test_resolve_qtype_overruns_body(self):
+        # qlen=200 against a 6-byte body: the slice bound must be
+        # checked against the body, never silently slice past it.
+        assert_rejects(
+            "shard", ShardError, resolve_name, bytes((0, 200)) + b"Axyz"
+        )
+
+    def test_resolve_name_not_utf8(self):
+        assert_rejects(
+            "shard", ShardError, resolve_name, bytes((0, 1)) + b"A\xff\xfe"
+        )
+
+    def test_traced_frame_too_short_for_context(self):
+        frame = _HDR.pack(7, TRACE_FLAG | 1)  # header only, no ctx block
+        assert_rejects(
+            "shard", ShardError, split_traced, frame, TRACE_FLAG | 1
+        )
+
+    def test_read_frame_rejects_bad_length(self):
+        def read(prefix):
+            return asyncio.run(_read_frame(_FakeReader(prefix)))
+
+        assert_rejects("shard", ShardError, read, (MAX_FRAME + 1).to_bytes(4, "big"))
+        # A length below the fixed header can never be a frame either.
+        assert_rejects("shard", ShardError, read, (0).to_bytes(4, "big"))
+
+    def test_read_frame_clean_eof_is_none(self):
+        before = surface_count("shard")
+        assert asyncio.run(_read_frame(_FakeReader(b""))) is None
+        assert surface_count("shard") == before
+
+
+class TestTally:
+    def test_unknown_surface_is_ignored(self):
+        # note() sits on error paths that must stay on their contract
+        # rails: a vocabulary typo is dropped, never raised.
+        before = malformed.counts()
+        malformed.note("not-a-surface")
+        assert malformed.counts() == before
+
+    def test_subscribe_and_unsubscribe(self):
+        seen = []
+        unsubscribe = malformed.subscribe(seen.append)
+        try:
+            malformed.note("jute")
+            assert seen == ["jute"]
+        finally:
+            unsubscribe()
+        malformed.note("jute")
+        assert seen == ["jute"]
+
+    def test_counter_preseeded_and_wired(self):
+        # instrument() pre-seeds a zero series per surface (alert
+        # rate()s need the series from the first scrape) and subscribes
+        # the live tally.
+        from registrar_tpu.metrics import instrument
+
+        class _Emitter:
+            down = False
+            znodes = ()
+
+            def on(self, *_a, **_k):
+                pass
+
+        class _ZK(_Emitter):
+            connected = False
+
+        reg = instrument(_Emitter(), _ZK())
+        text = reg.render()
+        for surface in malformed.SURFACES:
+            assert (
+                f'registrar_malformed_frames_total{{surface="{surface}"}}'
+                in text
+            )
+        with pytest.raises(JuteError):
+            Reader(b"").read_int()
+        assert (
+            'registrar_malformed_frames_total{surface="jute"} 1'
+            in reg.render()
+        )

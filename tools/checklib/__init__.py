@@ -35,6 +35,13 @@ Layout:
     escape-path leaks via the generation-3 fixpoint
   * ``rules_protocol.py`` — wire-contract drift: struct format arity,
     OP_* dispatch/docs symmetry, flag bit overlap
+  * ``taint.py``     — interprocedural taint flow: peer-controlled
+    integers/payloads from the docs/DESIGN.md trust boundary to
+    allocation/loop/read sinks, sanitized by dominating bound checks
+    (generation 5)
+  * ``rules_atomicity.py`` — stale-read-across-await: check-then-act
+    on a lock-relevant field across a suspension point without
+    re-read, epoch re-check, or a held lock
   * ``suppress.py``  — ``# check: disable=<rule> -- why`` comments
   * ``baseline.py``  — grandfathered findings (tools/check-baseline.json)
   * ``engine.py``    — file iteration, program-model orchestration,
@@ -59,5 +66,7 @@ import checklib.rules_errors  # check: disable=unused-import -- import registers
 import checklib.locks  # check: disable=unused-import -- import registers the rules
 import checklib.lifecycle  # check: disable=unused-import -- import registers the rules
 import checklib.rules_protocol  # check: disable=unused-import -- import registers the rules
+import checklib.taint  # check: disable=unused-import -- import registers the rules
+import checklib.rules_atomicity  # check: disable=unused-import -- import registers the rules
 
 __all__ = ["Finding", "RULES", "rule", "check_file", "run", "main"]

@@ -199,6 +199,8 @@ _MODULE_GENERATIONS = {
     "locks": 4,
     "lifecycle": 4,
     "rules_protocol": 4,
+    "taint": 5,
+    "rules_atomicity": 5,
 }
 
 
@@ -320,10 +322,14 @@ def run(
         from checklib.exceptions import flow_for
         from checklib.lifecycle import lifecycle_for
         from checklib.locks import lockgraph_for
+        from checklib.rules_atomicity import atomicity_for
+        from checklib.taint import taint_for
 
         flow_for(model)
         lockgraph_for(model)
         lifecycle_for(model)
+        taint_for(model)
+        atomicity_for(model)
     ctx_by_path = {c.rel_path: c for c in contexts}
     program_timings: Dict[str, float] = {}
     for rule in program_rules:
@@ -401,6 +407,12 @@ def run(
     lifecycle = getattr(model, "_lifecycle", None)
     if lifecycle is not None:
         stats["program"].update(lifecycle.stats())
+    taint = getattr(model, "_taint", None)
+    if taint is not None:
+        stats["program"].update(taint.stats())
+    atomicity = getattr(model, "_atomicity", None)
+    if atomicity is not None:
+        stats["program"].update(atomicity.stats())
     stats["rule_generations"] = _rule_generations()
     return RunResult(
         findings, len(checked_rel_paths), grandfathered, in_scope, stats
@@ -483,6 +495,12 @@ def _render_stats(result: RunResult) -> str:
         f"{prog.get('lock_edges', 0)} edges), "
         f"lifecycle fixpoint {prog.get('lifecycle_build_s', 0):.3f}s "
         f"({prog.get('lifecycle_tracked', 0)} resources), "
+        f"taint fixpoint {prog.get('taint_build_s', 0):.3f}s "
+        f"({prog.get('taint_sources', 0)} sources, "
+        f"{prog.get('taint_sinks', 0)} sinks, "
+        f"{prog.get('taint_sanitized', 0)} sanitized), "
+        f"atomicity scan {prog.get('atomicity_build_s', 0):.3f}s "
+        f"({prog.get('atomicity_tracked', 0)} tracked attrs), "
         f"program rules [{rule_times}]; "
         f"total {s.get('elapsed_s', 0):.3f}s"
     )

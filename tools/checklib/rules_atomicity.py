@@ -1,0 +1,319 @@
+"""Await-atomicity race detection (generation 5).
+
+An ``await`` is a scheduling point: every coroutine sharing the loop
+may run between the read and the write it separates.  The check-then-
+act shape —
+
+    snap = self._registered          # read
+    await self._zk.create(...)       # suspension: world may change
+    self._registered = snap + 1      # act on the STALE read
+
+— is exactly what the PR-3 single-flight + registration-epoch machinery
+exists to prevent, and the repaired sites all share one of three
+sanctioning shapes: re-read the field after the await, re-check an
+epoch/generation marker on the same object, or hold the same lock
+across both sides.  ``stale-read-across-await`` pins the convention:
+
+  * the **tracked vocabulary** is discovered, not hard-coded — any
+    attribute some package function assigns inside an ``async with
+    <lock>`` block (the gen-4 lock vocabulary via ``_is_lock_expr``)
+    is lock-relevant, plus anything epoch-ish by name
+    (``epoch``/``generation``);
+  * a finding needs the full shape in one async function: a local
+    snapshot of ``recv.attr``, an ``await`` (or ``async with`` /
+    ``async for`` suspension) after it, the snapshot local still used
+    after that suspension, and a write back to the same ``recv.attr``
+    after it;
+  * **sanctioners** stay silent: a re-read of the field between the
+    suspension and the write, ANY attribute of the receiver inspected
+    in a test/comparison in that window (the epoch-guard and
+    ``reconciler``-recheck shapes), or snapshot and write sitting in
+    the same lexical lock block (the lock is held across the await).
+
+Purely lexical and per-function, like the rest of the program rules:
+no alias tracking, receivers are plain names, one finding per
+(function, receiver, field).  Conservative by construction — a shape
+the scan cannot prove racy stays silent.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+import time
+from typing import Dict, Iterator, List, Optional, Set, Tuple
+
+from checklib.callgraph import chain_evidence, chain_names
+from checklib.context import PACKAGE_PREFIX
+from checklib.model import Finding
+from checklib.program import FunctionInfo, ProgramModel, _is_lock_expr
+from checklib.registry import rule
+
+#: Epoch-ish field names are lock-relevant even when never assigned
+#: under a lock — they ARE the optimistic-concurrency protocol.
+_EPOCHISH = re.compile(r"epoch|generation", re.IGNORECASE)
+
+_NESTED = (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef, ast.Lambda)
+
+
+def _lock_item(stmt) -> bool:
+    return any(_is_lock_expr(item.context_expr) for item in stmt.items)
+
+
+class AtomicityScan:
+    """Two passes over the program model: discover the lock-protected
+    attribute vocabulary, then scan every async package function for
+    the read→await→stale-write shape."""
+
+    def __init__(self, model: ProgramModel):
+        t0 = time.monotonic()
+        self.model = model
+        self._locked_attrs: Set[str] = set()
+        package = [
+            f
+            for f in model.functions()
+            if f.node is not None
+            and f.module.rel_path.startswith(PACKAGE_PREFIX)
+        ]
+        for func in package:
+            self._collect_locked_writes(func.node, under_lock=False)
+        self.findings: List[Finding] = []
+        for func in sorted(
+            package, key=lambda f: (f.module.rel_path, f.lineno, f.qualname)
+        ):
+            if isinstance(func.node, ast.AsyncFunctionDef):
+                self._scan(func)
+        self.findings.sort(key=lambda f: (f.path, f.line, f.message))
+        self.build_seconds = round(time.monotonic() - t0, 4)
+
+    def _tracked(self, attr: str) -> bool:
+        return attr in self._locked_attrs or _EPOCHISH.search(attr) is not None
+
+    # -- pass 1: what does the tree protect with locks? -------------------
+
+    def _collect_locked_writes(self, node, under_lock: bool) -> None:
+        for stmt in ast.iter_child_nodes(node):
+            if isinstance(stmt, _NESTED):
+                continue
+            inside = under_lock
+            if isinstance(stmt, (ast.With, ast.AsyncWith)) and _lock_item(
+                stmt
+            ):
+                inside = True
+            if under_lock and isinstance(stmt, (ast.Assign, ast.AugAssign)):
+                targets = (
+                    stmt.targets
+                    if isinstance(stmt, ast.Assign)
+                    else [stmt.target]
+                )
+                for target in targets:
+                    if isinstance(target, ast.Attribute) and isinstance(
+                        target.value, ast.Name
+                    ):
+                        self._locked_attrs.add(target.attr)
+            self._collect_locked_writes(stmt, inside)
+
+    # -- pass 2: the shape ------------------------------------------------
+
+    def _scan(self, func: FunctionInfo) -> None:
+        rel = func.module.rel_path
+        # (local, recv, attr, line, lock_id)
+        snapshots: List[Tuple[str, str, str, int, Optional[int]]] = []
+        # (recv, attr, line, lock_id)
+        writes: List[Tuple[str, str, int, Optional[int]]] = []
+        awaits: List[int] = []
+        rereads: Dict[Tuple[str, str], List[int]] = {}
+        guards: Dict[str, List[int]] = {}
+        uses: Dict[str, List[int]] = {}
+
+        def walk_expr(node, in_test: bool) -> None:
+            if node is None or isinstance(node, _NESTED):
+                return
+            if isinstance(node, ast.Await):
+                awaits.append(node.lineno)
+                walk_expr(node.value, in_test)
+                return
+            if isinstance(node, ast.Compare):
+                in_test = True
+            if (
+                isinstance(node, ast.Attribute)
+                and isinstance(node.ctx, ast.Load)
+                and isinstance(node.value, ast.Name)
+            ):
+                if in_test:
+                    guards.setdefault(node.value.id, []).append(node.lineno)
+                if self._tracked(node.attr):
+                    rereads.setdefault(
+                        (node.value.id, node.attr), []
+                    ).append(node.lineno)
+            if isinstance(node, ast.Name) and isinstance(node.ctx, ast.Load):
+                uses.setdefault(node.id, []).append(node.lineno)
+            for child in ast.iter_child_nodes(node):
+                walk_expr(child, in_test)
+
+        def record_write_target(target, lineno, lock_id) -> None:
+            if isinstance(target, ast.Attribute) and isinstance(
+                target.value, ast.Name
+            ):
+                if self._tracked(target.attr):
+                    writes.append(
+                        (target.value.id, target.attr, lineno, lock_id)
+                    )
+            elif isinstance(target, (ast.Tuple, ast.List)):
+                for elt in target.elts:
+                    record_write_target(elt, lineno, lock_id)
+
+        def walk_stmt(stmt, lock_id: Optional[int]) -> None:
+            if isinstance(stmt, _NESTED):
+                return
+            if isinstance(stmt, ast.Assign):
+                walk_expr(stmt.value, False)
+                if (
+                    len(stmt.targets) == 1
+                    and isinstance(stmt.targets[0], ast.Name)
+                    and isinstance(stmt.value, ast.Attribute)
+                    and isinstance(stmt.value.value, ast.Name)
+                    and self._tracked(stmt.value.attr)
+                ):
+                    snapshots.append(
+                        (
+                            stmt.targets[0].id,
+                            stmt.value.value.id,
+                            stmt.value.attr,
+                            stmt.lineno,
+                            lock_id,
+                        )
+                    )
+                for target in stmt.targets:
+                    record_write_target(target, stmt.lineno, lock_id)
+                return
+            if isinstance(stmt, ast.AugAssign):
+                walk_expr(stmt.value, False)
+                record_write_target(stmt.target, stmt.lineno, lock_id)
+                return
+            if isinstance(stmt, (ast.If, ast.While)):
+                walk_expr(stmt.test, True)
+                for s in stmt.body:
+                    walk_stmt(s, lock_id)
+                for s in stmt.orelse:
+                    walk_stmt(s, lock_id)
+                return
+            if isinstance(stmt, ast.Assert):
+                walk_expr(stmt.test, True)
+                return
+            if isinstance(stmt, (ast.With, ast.AsyncWith)):
+                if isinstance(stmt, ast.AsyncWith):
+                    awaits.append(stmt.lineno)
+                inner = id(stmt) if _lock_item(stmt) else lock_id
+                for item in stmt.items:
+                    walk_expr(item.context_expr, False)
+                for s in stmt.body:
+                    walk_stmt(s, inner)
+                return
+            if isinstance(stmt, (ast.For, ast.AsyncFor)):
+                if isinstance(stmt, ast.AsyncFor):
+                    awaits.append(stmt.lineno)
+                walk_expr(stmt.iter, False)
+                for s in stmt.body:
+                    walk_stmt(s, lock_id)
+                for s in stmt.orelse:
+                    walk_stmt(s, lock_id)
+                return
+            if isinstance(stmt, ast.Try):
+                for s in stmt.body:
+                    walk_stmt(s, lock_id)
+                for handler in stmt.handlers:
+                    for s in handler.body:
+                        walk_stmt(s, lock_id)
+                for s in stmt.orelse:
+                    walk_stmt(s, lock_id)
+                for s in stmt.finalbody:
+                    walk_stmt(s, lock_id)
+                return
+            if isinstance(stmt, _NESTED):
+                return
+            for child in ast.iter_child_nodes(stmt):
+                if isinstance(child, ast.stmt):
+                    walk_stmt(child, lock_id)
+                elif isinstance(child, ast.expr):
+                    walk_expr(child, False)
+
+        for stmt in func.node.body:
+            walk_stmt(stmt, None)
+
+        fired: Set[Tuple[str, str]] = set()
+        for local, recv, attr, s_line, s_lock in snapshots:
+            if (recv, attr) in fired:
+                continue
+            for w_recv, w_attr, w_line, w_lock in writes:
+                if (w_recv, w_attr) != (recv, attr) or w_line <= s_line:
+                    continue
+                between = [a for a in awaits if s_line < a < w_line]
+                if not between:
+                    continue
+                first_await = min(between)
+                # the stale value must actually matter after suspension
+                if not any(
+                    u > first_await for u in uses.get(local, ())
+                ):
+                    continue
+                # sanctioner 1: the field is re-read after suspending
+                if any(
+                    first_await < r < w_line
+                    for r in rereads.get((recv, attr), ())
+                ):
+                    continue
+                # sanctioner 2: epoch-guard shape — any attribute of the
+                # receiver re-checked in a test between await and write
+                if any(
+                    first_await < g <= w_line for g in guards.get(recv, ())
+                ):
+                    continue
+                # sanctioner 3: lock held across both sides
+                if s_lock is not None and s_lock == w_lock:
+                    continue
+                hops = [
+                    (f"read {recv}.{attr}", rel, s_line),
+                    ("await", rel, first_await),
+                    (f"write {recv}.{attr}", rel, w_line),
+                ]
+                self.findings.append(
+                    Finding(
+                        "stale-read-across-await",
+                        rel,
+                        s_line,
+                        f"lock-relevant field {recv}.{attr} is read before "
+                        f"an await and written after it without re-read or "
+                        f"epoch re-check (chain: {chain_names(hops)})",
+                        chain=chain_evidence(hops),
+                    )
+                )
+                fired.add((recv, attr))
+                break
+
+    def stats(self) -> dict:
+        return {
+            "atomicity_tracked": len(self._locked_attrs),
+            "atomicity_build_s": self.build_seconds,
+        }
+
+
+def atomicity_for(model: ProgramModel) -> AtomicityScan:
+    """One AtomicityScan per program model (pre-built by the engine so
+    ``--stats`` can report the phase even on a clean run)."""
+    scan = getattr(model, "_atomicity", None)
+    if scan is None:
+        scan = AtomicityScan(model)
+        model._atomicity = scan
+    return scan
+
+
+@rule(
+    "stale-read-across-await",
+    "a lock-relevant field read before an await is written after it "
+    "without re-read or epoch re-check",
+    scope="program",
+)
+def stale_read_across_await(model: ProgramModel) -> Iterator[Finding]:
+    for f in atomicity_for(model).findings:
+        yield f

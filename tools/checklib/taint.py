@@ -1,0 +1,928 @@
+"""Interprocedural taint-flow analysis (generation 5).
+
+Every length, count, and offset a peer can put on the wire eventually
+sizes something: an allocation (``bytes(n)``), a loop (``range(n)``), a
+stream read (``readexactly(n)``), a slice.  The decode-bound invariants
+that keep those safe exist in the tree as hand-written guards
+(``framing.py``'s MAX_FRAME rejection, ``jute.py``'s
+count-vs-remaining check) — this module turns the convention into a
+machine-checked contract over the PR-6 program model:
+
+  * **sources** are peer-controlled reads, declared per wire module in
+    :data:`BOUNDARY_SOURCES` (mirrored by the trust-boundary table in
+    docs/DESIGN.md, which ``taint-boundary-drift`` cross-checks both
+    directions).  A source yields either a peer integer (kind ``num``)
+    or a peer payload (kind ``buf``; subscripting a ``buf`` with a
+    constant index yields a ``num``);
+  * **taint propagates** through arithmetic, tuple destructuring, and
+    along resolved call edges — positional/keyword arguments into
+    callee parameters, callee returns back to the call expression —
+    with the generation-3 duck resolution reused for opaque receivers
+    (``r.read_int()`` on a parameter);
+  * **sinks** are the size-sensitive operations: ``bytes(n)`` /
+    ``bytearray(n)`` allocations, sequence repetition (``b"x" * n``),
+    ``range(n)`` loops, unresolved ``readexactly(n)`` / ``_take(n)`` /
+    ``_skip(n)`` reads, slice bounds, and self-recursion reached with a
+    tainted argument.  A size call that resolves to an in-model
+    function is NOT a sink — the taint flows into the callee instead,
+    where an internal guard is visible to the analysis (this is why
+    ``jute.Reader._take``'s ``remaining()`` check silences every
+    ``_take`` call site);
+  * **sanitizers** kill ``num`` taint: an ordered comparison
+    (``< <= > >=``) whose other side is boundish — a constant, an
+    ALL-CAPS or cap-ish name (max/cap/limit/bound/size/budget), a
+    ``.size`` attribute, ``len()`` / ``remaining()`` / ``min()`` /
+    ``max()`` arithmetic — cleanses the compared name for the rest of
+    the scope.  ``min(n, CAP)`` and ``int()``-style transforms are
+    modeled directly.  Cleansing is deliberately direction-insensitive
+    (``if n < 0`` alone cleanses) — documented in docs/CHECKS.md as the
+    price of a lexical, path-insensitive pass.
+
+Every finding carries the source→sink witness chain as structured
+evidence (JSON ``chain``, SARIF codeFlows), like
+transitive-blocking-call.  Conservatism follows the house contract:
+taint dies at unresolved calls, constructors, attribute stores, and
+anything else the model cannot follow — silence, never a guess.
+Findings are only reported for package files (``registrar_tpu/``);
+tests exercising the decoders on crafted bytes are not decode surface.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+import time
+from typing import Dict, Iterator, List, Optional, Set, Tuple
+
+from checklib.callgraph import chain_evidence, chain_names
+from checklib.context import PACKAGE_PREFIX
+from checklib.model import Finding
+from checklib.program import FunctionInfo, ProgramModel, _dotted
+from checklib.registry import rule
+from checklib.rules_contracts import read_doc_lines
+from checklib.rules_flow import graph_for
+
+#: The trust boundary: per wire module, the callee names whose results
+#: are peer-controlled, and the taint kind they yield.  ``num`` is a
+#: peer integer (lengths, counts, offsets), ``buf`` a peer payload.
+#: docs/DESIGN.md's "trust boundary" appendix mirrors this table and
+#: ``taint-boundary-drift`` keeps the two in sync — against the ACTUAL
+#: call sites, both directions, so neither the doc nor this vocabulary
+#: can go stale.
+BOUNDARY_SOURCES: Dict[str, Dict[str, str]] = {
+    "registrar_tpu/zk/jute.py": {
+        "unpack_from": "num",
+        "read_int": "num",
+    },
+    "registrar_tpu/zk/framing.py": {
+        "from_bytes": "num",
+        "_peek4": "num",
+        "read": "buf",
+    },
+    "registrar_tpu/zk/client.py": {
+        "from_bytes": "num",
+        "readexactly": "buf",
+    },
+    "registrar_tpu/zk/protocol.py": {
+        "read_int": "num",
+        "read_long": "num",
+        "read_struct": "num",
+        "long_at": "num",
+        "read_buffer": "buf",
+        "unpack_from": "num",
+    },
+    "registrar_tpu/shard.py": {
+        "unpack": "num",
+        "unpack_from": "num",
+        "readexactly": "buf",
+    },
+    "registrar_tpu/health.py": {
+        "read": "buf",
+    },
+}
+
+#: Sink vocabulary (the names the docs table documents on the sink
+#: side; set-compared both directions by taint-boundary-drift).
+SINK_VOCAB = frozenset(
+    {
+        "bytes",
+        "bytearray",
+        "range",
+        "readexactly",
+        "_take",
+        "_skip",
+        "slice",
+        "sequence-repeat",
+        "recursion",
+    }
+)
+
+#: Stream-read callables whose first argument is a read size.  Only
+#: UNRESOLVED calls (external stream methods) are sinks; a resolved
+#: in-model callee receives the taint as a parameter instead.
+_SIZE_READS = frozenset({"readexactly", "_take", "_skip"})
+
+#: Callables that return their first argument unchanged (taint-wise).
+_PASSTHROUGH = frozenset({"wait_for", "shield", "memoryview", "abs", "int"})
+
+#: Cap-ish identifier fragments that make a comparison side "boundish".
+_CAPISH = re.compile(r"max|cap|limit|bound|size|budget", re.IGNORECASE)
+
+#: Boundish call targets: buffer arithmetic and explicit clamping.
+_BOUND_CALLS = frozenset({"len", "remaining", "min", "max", "calcsize"})
+
+#: A chain hop, shaped like callgraph.py's: (symbol, rel_path, line).
+Hop = Tuple[str, str, int]
+
+#: (kind, chain): kind is "num" | "buf".
+Taint = Tuple[str, List[Hop]]
+
+_ORDERED_OPS = (ast.Lt, ast.LtE, ast.Gt, ast.GtE)
+
+
+class TaintFlow:
+    """The analysis: build once per run (:func:`taint_for`), query per
+    rule.  A worklist-free fixpoint: full passes over every function
+    that can carry taint, until the interprocedural state (parameter
+    and return taint, both first-wins) stops growing, then one
+    recording pass that collects findings and stats."""
+
+    def __init__(self, model: ProgramModel):
+        self.model = model
+        self.graph = graph_for(model)
+        from checklib.exceptions import flow_for
+
+        self._flow = flow_for(model)  # duck resolution (generation 3)
+        t0 = time.monotonic()
+        self._param_taint: Dict[FunctionInfo, Dict[str, Taint]] = {}
+        self._return_taint: Dict[FunctionInfo, Taint] = {}
+        #: Per-element taint for ``return a, b, c`` literals, merged
+        #: element-wise (first non-None wins per slot) across returns
+        #: and passes.  Without this, ``op, ctx, body =
+        #: split_traced(...)`` smears the trace-context ints' num taint
+        #: onto the payload view and every downstream byte-copy fires.
+        self._return_tuple: Dict[FunctionInfo, List[Optional[Taint]]] = {}
+        self._functions = sorted(
+            (f for f in model.functions() if f.node is not None),
+            key=lambda f: (f.module.rel_path, f.lineno, f.qualname),
+        )
+        #: func -> (has own source sites, resolved+duck callee set) —
+        #: the pruning facts: a function with no source, no tainted
+        #: parameter, and no callee carrying return taint cannot change
+        #: the fixpoint state or produce a finding.
+        self._facts: Dict[FunctionInfo, Tuple[bool, List[FunctionInfo]]] = {}
+        for func in self._functions:
+            self._facts[func] = self._function_facts(func)
+        self.findings: List[Finding] = []
+        #: (module rel_path, source pattern) -> first lineno seen — the
+        #: actual-call-site inventory taint-boundary-drift checks the
+        #: docs table against.
+        self.source_sites: Dict[Tuple[str, str], int] = {}
+        self.sources = 0
+        self.sinks = 0
+        self.sanitized = 0
+        self._recording = False
+        self._seen: Set[tuple] = set()
+        self.iterations = 0
+        for _ in range(30):
+            self.iterations += 1
+            self._changed = False
+            for func in self._functions:
+                if self._relevant(func):
+                    self._analyze(func)
+            if not self._changed:
+                break
+        self._recording = True
+        for func in self._functions:
+            if self._relevant(func):
+                self._analyze(func)
+        self.findings.sort(
+            key=lambda f: (f.path, f.line, f.rule, f.message)
+        )
+        self.build_seconds = round(time.monotonic() - t0, 4)
+
+    # -- pruning ----------------------------------------------------------
+
+    def _function_facts(self, func: FunctionInfo):
+        vocab = BOUNDARY_SOURCES.get(func.module.rel_path)
+        has_source = False
+        callees: List[FunctionInfo] = []
+        for site in func.calls:
+            if site.shape[0] == "name":
+                last: Optional[str] = site.shape[1]
+            elif site.shape[0] == "dotted":
+                last = site.shape[2][-1]
+            else:
+                last = None
+            if vocab and last is not None and last in vocab:
+                has_source = True
+            res = self.graph.resolve(site)
+            if res is not None and res[0] == "func":
+                callees.append(res[1])
+            elif res is None and site.shape[0] == "dotted":
+                duck = self._flow._duck_resolve(site)
+                if duck is not None:
+                    callees.append(duck)
+        return has_source, callees
+
+    def _relevant(self, func: FunctionInfo) -> bool:
+        has_source, callees = self._facts[func]
+        if has_source or self._param_taint.get(func):
+            return True
+        return any(c in self._return_taint for c in callees)
+
+    # -- per-function walk ------------------------------------------------
+
+    def _analyze(self, func: FunctionInfo) -> None:
+        self._func = func
+        self._rel = func.module.rel_path
+        self._sites = {id(s.node): s for s in func.calls}
+        env: Dict[str, Taint] = dict(self._param_taint.get(func) or {})
+        self._walk_block(func.node.body, env)
+
+    def _walk_block(self, stmts, env: Dict[str, Taint]) -> None:
+        for stmt in stmts:
+            self._walk_stmt(stmt, env)
+
+    def _walk_stmt(self, stmt, env: Dict[str, Taint]) -> None:
+        if isinstance(
+            stmt, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)
+        ):
+            return  # separate scopes: covered by their own analysis
+        if isinstance(stmt, ast.Assign):
+            val = self._expr(stmt.value, env)
+            elements = self._tuple_return_of(stmt.value)
+            for target in stmt.targets:
+                if (
+                    elements is not None
+                    and isinstance(target, (ast.Tuple, ast.List))
+                    and len(target.elts) == len(elements)
+                    and not any(
+                        isinstance(e, ast.Starred) for e in target.elts
+                    )
+                ):
+                    hop = (self._func.ref, self._rel, stmt.value.lineno)
+                    for elt, taint in zip(target.elts, elements):
+                        self._assign(
+                            elt,
+                            (taint[0], taint[1] + [hop]) if taint else None,
+                            env,
+                        )
+                else:
+                    self._assign(target, val, env)
+        elif isinstance(stmt, ast.AnnAssign):
+            if stmt.value is not None:
+                self._assign(stmt.target, self._expr(stmt.value, env), env)
+        elif isinstance(stmt, ast.AugAssign):
+            val = self._expr(stmt.value, env)
+            if isinstance(stmt.target, ast.Name):
+                merged = env.get(stmt.target.id) or val
+                if merged is not None:
+                    env[stmt.target.id] = merged
+        elif isinstance(stmt, ast.Return):
+            if stmt.value is not None:
+                if isinstance(stmt.value, ast.Tuple):
+                    elts = [
+                        self._expr(e, env) for e in stmt.value.elts
+                    ]
+                    self._merge_tuple_return(elts)
+                    val = next(
+                        (t for t in elts if t is not None and t[0] == "buf"),
+                        None,
+                    ) or next((t for t in elts if t is not None), None)
+                else:
+                    val = self._expr(stmt.value, env)
+                if val is not None and self._func not in self._return_taint:
+                    self._return_taint[self._func] = val
+                    if not self._recording:
+                        self._changed = True
+        elif isinstance(stmt, ast.If):
+            self._expr(stmt.test, env)
+            self._cleanse(stmt.test, env)
+            then_env = dict(env)
+            self._walk_block(stmt.body, then_env)
+            else_env = dict(env)
+            self._walk_block(stmt.orelse, else_env)
+            # branch merge: taint survives only when BOTH arms leave it
+            # (the guard-and-raise shape kills it in the raising arm's
+            # sibling via _cleanse already; this handles rebindings)
+            env.clear()
+            for name, taint in then_env.items():
+                if name in else_env:
+                    env[name] = taint
+        elif isinstance(stmt, (ast.While,)):
+            self._expr(stmt.test, env)
+            self._cleanse(stmt.test, env)
+            self._walk_block(stmt.body, env)
+            self._walk_block(stmt.orelse, env)
+        elif isinstance(stmt, (ast.For, ast.AsyncFor)):
+            it = self._expr(stmt.iter, env)
+            if it is not None:
+                # iterating peer data yields peer values (bytes -> ints)
+                self._assign(stmt.target, ("num", it[1]), env)
+            self._walk_block(stmt.body, env)
+            self._walk_block(stmt.orelse, env)
+        elif isinstance(stmt, (ast.With, ast.AsyncWith)):
+            for item in stmt.items:
+                val = self._expr(item.context_expr, env)
+                if item.optional_vars is not None:
+                    self._assign(item.optional_vars, val, env)
+            self._walk_block(stmt.body, env)
+        elif isinstance(stmt, ast.Try):
+            self._walk_block(stmt.body, env)
+            for handler in stmt.handlers:
+                handler_env = dict(env)
+                self._walk_block(handler.body, handler_env)
+            self._walk_block(stmt.orelse, env)
+            self._walk_block(stmt.finalbody, env)
+        elif isinstance(stmt, ast.Expr):
+            self._expr(stmt.value, env)
+        elif isinstance(stmt, ast.Assert):
+            self._expr(stmt.test, env)
+            self._cleanse(stmt.test, env)
+        elif isinstance(stmt, ast.Raise):
+            if stmt.exc is not None:
+                self._expr(stmt.exc, env)
+        elif isinstance(stmt, ast.Delete):
+            for target in stmt.targets:
+                if isinstance(target, ast.Name):
+                    env.pop(target.id, None)
+
+    def _assign(self, target, val: Optional[Taint], env) -> None:
+        if isinstance(target, ast.Name):
+            if val is None:
+                env.pop(target.id, None)
+            else:
+                env[target.id] = val
+        elif isinstance(target, (ast.Tuple, ast.List)):
+            for elt in target.elts:
+                inner = elt.value if isinstance(elt, ast.Starred) else elt
+                self._assign(inner, val, env)
+        # attribute / subscript stores: taint dies (not modeled)
+
+    def _merge_tuple_return(self, elts: List[Optional[Taint]]) -> None:
+        if not any(t is not None for t in elts):
+            return
+        current = self._return_tuple.get(self._func)
+        if current is None:
+            self._return_tuple[self._func] = list(elts)
+            if not self._recording:
+                self._changed = True
+            return
+        if len(current) != len(elts):
+            return  # ragged returns: the scalar collapse still applies
+        for i, taint in enumerate(elts):
+            if current[i] is None and taint is not None:
+                current[i] = taint
+                if not self._recording:
+                    self._changed = True
+
+    def _tuple_return_of(self, value) -> Optional[List[Optional[Taint]]]:
+        """Per-element taint when ``value`` is a (possibly awaited)
+        call to an in-model function returning a tuple literal."""
+        if isinstance(value, ast.Await):
+            value = value.value
+        if not isinstance(value, ast.Call):
+            return None
+        callee = self._callee_of(value)
+        if callee is None:
+            return None
+        return self._return_tuple.get(callee)
+
+    def _callee_of(self, node: ast.Call) -> Optional[FunctionInfo]:
+        site = self._sites.get(id(node))
+        if site is None:
+            return None
+        res = self.graph.resolve(site)
+        if res is not None and res[0] == "func":
+            return res[1]
+        if res is None and site.shape[0] == "dotted":
+            return self._flow._duck_resolve(site)
+        return None
+
+    # -- expressions ------------------------------------------------------
+
+    def _expr(self, node, env: Dict[str, Taint]) -> Optional[Taint]:
+        if node is None or isinstance(node, ast.Constant):
+            return None
+        if isinstance(node, ast.Name):
+            return env.get(node.id)
+        if isinstance(node, ast.Await):
+            return self._expr(node.value, env)
+        if isinstance(node, ast.Call):
+            return self._call(node, env)
+        if isinstance(node, ast.BinOp):
+            return self._binop(node, env)
+        if isinstance(node, ast.UnaryOp):
+            return self._expr(node.operand, env)
+        if isinstance(node, ast.BoolOp):
+            taints = [self._expr(v, env) for v in node.values]
+            return next((t for t in taints if t is not None), None)
+        if isinstance(node, ast.Compare):
+            self._expr(node.left, env)
+            for comp in node.comparators:
+                self._expr(comp, env)
+            return None  # a bool is never size-dangerous
+        if isinstance(node, ast.IfExp):
+            self._expr(node.test, env)
+            a = self._expr(node.body, env)
+            b = self._expr(node.orelse, env)
+            return a or b
+        if isinstance(node, ast.Subscript):
+            return self._subscript(node, env)
+        if isinstance(node, (ast.Tuple, ast.List, ast.Set)):
+            taints = [self._expr(e, env) for e in node.elts]
+            # A literal mixing kinds (``return op, ctx, body``) smears
+            # one taint over every destructured target; prefer ``buf``
+            # — copying a peer payload is bounded by its (already
+            # capped) size, while treating the payload as a peer
+            # INTEGER would turn every byte-copy into an allocation
+            # finding.  Indexing the smeared buf still yields tainted
+            # nums, so real length fields keep flowing.
+            taints = [t for t in taints if t is not None]
+            for t in taints:
+                if t[0] == "buf":
+                    return t
+            return taints[0] if taints else None
+        if isinstance(node, ast.Starred):
+            return self._expr(node.value, env)
+        if isinstance(node, ast.NamedExpr):
+            val = self._expr(node.value, env)
+            self._assign(node.target, val, env)
+            return val
+        if isinstance(
+            node, (ast.ListComp, ast.SetComp, ast.GeneratorExp, ast.DictComp)
+        ):
+            comp_env = dict(env)
+            for gen in node.generators:
+                it = self._expr(gen.iter, comp_env)
+                if it is not None:
+                    self._assign(gen.target, ("num", it[1]), comp_env)
+                for cond in gen.ifs:
+                    self._expr(cond, comp_env)
+                    self._cleanse(cond, comp_env)
+            if isinstance(node, ast.DictComp):
+                self._expr(node.key, comp_env)
+                self._expr(node.value, comp_env)
+            else:
+                self._expr(node.elt, comp_env)
+            return None
+        if isinstance(node, (ast.Attribute, ast.Lambda)):
+            if isinstance(node, ast.Attribute):
+                self._expr(node.value, env)
+            return None
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, ast.expr):
+                self._expr(child, env)
+        return None
+
+    def _binop(self, node: ast.BinOp, env) -> Optional[Taint]:
+        left = self._expr(node.left, env)
+        right = self._expr(node.right, env)
+        if isinstance(node.op, ast.Mult):
+            for taint, other, other_taint in (
+                (left, node.right, right),
+                (right, node.left, left),
+            ):
+                if taint is None or taint[0] != "num":
+                    continue
+                sequence_side = (
+                    (
+                        isinstance(other, ast.Constant)
+                        and isinstance(other.value, (str, bytes))
+                    )
+                    or isinstance(other, (ast.List, ast.Tuple))
+                    or (other_taint is not None and other_taint[0] == "buf")
+                )
+                self._note_sink_site(node)
+                if sequence_side:
+                    self._sink(
+                        "unbounded-peer-allocation",
+                        node.lineno,
+                        taint,
+                        "tainted * sequence",
+                        "peer-controlled integer sizes a sequence-repeat "
+                        "allocation with no dominating bound check",
+                    )
+                    return ("buf", taint[1])
+                break
+        for t in (left, right):
+            if t is not None and t[0] == "num":
+                return t
+        return left or right
+
+    def _subscript(self, node: ast.Subscript, env) -> Optional[Taint]:
+        base = self._expr(node.value, env)
+        sl = node.slice
+        if isinstance(sl, ast.Slice):
+            bounds = [b for b in (sl.lower, sl.upper, sl.step) if b is not None]
+            tainted = None
+            for b in bounds:
+                t = self._expr(b, env)
+                if tainted is None and t is not None and t[0] == "num":
+                    tainted = t
+            if any(not isinstance(b, ast.Constant) for b in bounds):
+                self._note_sink_site(node)
+            if tainted is not None:
+                self._sink(
+                    "unchecked-peer-read-size",
+                    node.lineno,
+                    tainted,
+                    "slice[tainted]",
+                    "peer-controlled offset bounds a slice with no "
+                    "dominating bound check",
+                )
+            return base
+        index = self._expr(sl, env)
+        if base is not None and base[0] == "buf":
+            return ("num", base[1])  # buf[i]: a peer byte/element value
+        if index is not None:
+            return None  # tainted key into an untainted container
+        return None
+
+    def _call(self, node: ast.Call, env) -> Optional[Taint]:
+        d = _dotted(node.func)
+        if d is None:
+            self._expr(node.func, env)
+            last: Optional[str] = None
+            attrs: Tuple[str, ...] = ()
+            base: Optional[str] = None
+        else:
+            base, attrs = d
+            last = attrs[-1] if attrs else base
+        starred = any(isinstance(a, ast.Starred) for a in node.args)
+        arg_taints = [self._expr(a, env) for a in node.args]
+        kw_taints = {
+            kw.arg: self._expr(kw.value, env)
+            for kw in node.keywords
+            if kw.arg is not None
+        }
+        for kw in node.keywords:
+            if kw.arg is None:
+                self._expr(kw.value, env)
+
+        callee = self._callee_of(node)
+
+        # -- sinks (checked before the source so readexactly(tainted)
+        #    both fires and yields a tainted payload) -----------------
+        if last in ("bytes", "bytearray") and not attrs and node.args:
+            self._note_sink_site(node)
+            first = arg_taints[0]
+            if first is not None and first[0] == "num":
+                self._sink(
+                    "unbounded-peer-allocation",
+                    node.lineno,
+                    first,
+                    f"{last}(tainted)",
+                    "peer-controlled integer sizes an allocation with no "
+                    "dominating bound check",
+                )
+                return ("buf", first[1])
+            if first is not None and first[0] == "buf":
+                return first
+            return None
+        if last == "range" and not attrs and node.args:
+            self._note_sink_site(node)
+            tainted = next(
+                (t for t in arg_taints if t is not None and t[0] == "num"),
+                None,
+            )
+            if tainted is not None:
+                self._sink(
+                    "unvalidated-count-loop",
+                    node.lineno,
+                    tainted,
+                    "range(tainted)",
+                    "peer-controlled count drives a loop with no "
+                    "dominating bound check",
+                )
+            return None
+        if last in _SIZE_READS and attrs and callee is None and node.args:
+            self._note_sink_site(node)
+            first = arg_taints[0]
+            if first is not None and first[0] == "num":
+                self._sink(
+                    "unchecked-peer-read-size",
+                    node.lineno,
+                    first,
+                    f"{last}(tainted)",
+                    "peer-controlled length sizes a stream read with no "
+                    "dominating bound check",
+                )
+        if (
+            callee is not None
+            and callee is self._func
+            and any(t is not None for t in arg_taints)
+        ):
+            tainted = next(t for t in arg_taints if t is not None)
+            self._note_sink_site(node)
+            self._sink(
+                "unvalidated-count-loop",
+                node.lineno,
+                tainted,
+                "recursion(tainted)",
+                "peer-controlled value reaches self-recursion with no "
+                "dominating bound check",
+            )
+
+        # -- sources ------------------------------------------------------
+        vocab = BOUNDARY_SOURCES.get(self._rel)
+        if vocab is not None and last is not None and last in vocab:
+            if self._recording:
+                self.sources += 1
+                key = (self._rel, last)
+                if key not in self.source_sites:
+                    self.source_sites[key] = node.lineno
+            return (
+                vocab[last],
+                [(f"{last} (peer read)", self._rel, node.lineno)],
+            )
+
+        # -- builtin transforms -------------------------------------------
+        if last in _PASSTHROUGH and node.args:
+            return arg_taints[0]
+        if last in ("min", "max") and not attrs and len(node.args) > 1:
+            if all(t is not None for t in arg_taints):
+                return arg_taints[0]
+            return None  # clamped against an untainted bound
+        if last in ("len", "bool", "sum", "ord") and not attrs:
+            return None
+
+        # -- interprocedural propagation ----------------------------------
+        if callee is not None and callee.node is not None:
+            self._flow_into(
+                callee, node, arg_taints, kw_taints, starred,
+                dotted=bool(attrs),
+            )
+            ret = self._return_taint.get(callee)
+            if ret is not None:
+                return (
+                    ret[0],
+                    ret[1] + [(self._func.ref, self._rel, node.lineno)],
+                )
+        return None
+
+    def _flow_into(
+        self, callee, node, arg_taints, kw_taints, starred, dotted
+    ) -> None:
+        args = callee.node.args
+        ordered = [a.arg for a in list(args.posonlyargs) + list(args.args)]
+        if dotted and ordered and ordered[0] in ("self", "cls"):
+            ordered = ordered[1:]
+        target = self._param_taint.setdefault(callee, {})
+        callee_rel = callee.module.rel_path
+        hop = (callee.ref, callee_rel, callee.lineno)
+
+        def contribute(param: str, taint: Taint) -> None:
+            if param in target:
+                return
+            target[param] = (taint[0], taint[1] + [hop])
+            if not self._recording:
+                self._changed = True
+
+        if not starred:
+            for i, taint in enumerate(arg_taints):
+                if taint is None or i >= len(ordered):
+                    continue
+                contribute(ordered[i], taint)
+        params = callee.params
+        for name, taint in kw_taints.items():
+            if taint is not None and name in params:
+                contribute(name, taint)
+
+    # -- sanitizers -------------------------------------------------------
+
+    def _cleanse(self, test, env: Dict[str, Taint]) -> None:
+        if isinstance(test, ast.BoolOp):
+            for value in test.values:
+                self._cleanse(value, env)
+            return
+        if isinstance(test, ast.UnaryOp) and isinstance(test.op, ast.Not):
+            self._cleanse(test.operand, env)
+            return
+        if not isinstance(test, ast.Compare):
+            return
+        if not all(isinstance(op, _ORDERED_OPS) for op in test.ops):
+            return  # equality tells you nothing about magnitude
+        sides = [test.left] + list(test.comparators)
+        for i, side in enumerate(sides):
+            names = [
+                n.id
+                for n in ast.walk(side)
+                if isinstance(n, ast.Name)
+                and isinstance(n.ctx, ast.Load)
+                and env.get(n.id, (None,))[0] == "num"
+            ]
+            if not names:
+                continue
+            others = sides[:i] + sides[i + 1:]
+            if others and all(self._boundish(o, env) for o in others):
+                for name in names:
+                    if name in env:
+                        del env[name]
+                        if self._recording:
+                            self.sanitized += 1
+
+    def _boundish(self, expr, env) -> bool:
+        if isinstance(expr, ast.Constant):
+            return isinstance(expr.value, (int, float))
+        if isinstance(expr, ast.Name):
+            if expr.id in env:
+                return False
+            return expr.id.isupper() or _CAPISH.search(expr.id) is not None
+        if isinstance(expr, ast.Attribute):
+            return (
+                expr.attr == "size"
+                or expr.attr.isupper()
+                or _CAPISH.search(expr.attr) is not None
+            )
+        if isinstance(expr, ast.Call):
+            d = _dotted(expr.func)
+            if d is None:
+                return False
+            base, attrs = d
+            return (attrs[-1] if attrs else base) in _BOUND_CALLS
+        if isinstance(expr, ast.BinOp):
+            return self._boundish(expr.left, env) and self._boundish(
+                expr.right, env
+            )
+        if isinstance(expr, ast.UnaryOp):
+            return self._boundish(expr.operand, env)
+        return False
+
+    # -- findings / stats -------------------------------------------------
+
+    def _note_sink_site(self, node) -> None:
+        if self._recording and self._rel.startswith(PACKAGE_PREFIX):
+            self.sinks += 1
+
+    def _sink(self, rule_name, lineno, taint, symbol, message) -> None:
+        if not self._recording:
+            return
+        if not self._rel.startswith(PACKAGE_PREFIX):
+            return  # tests/tools feeding the decoders are not surface
+        key = (rule_name, self._rel, lineno, symbol)
+        if key in self._seen:
+            return
+        self._seen.add(key)
+        full = taint[1] + [(symbol, self._rel, lineno)]
+        self.findings.append(
+            Finding(
+                rule_name,
+                self._rel,
+                lineno,
+                f"{message} (chain: {chain_names(full)})",
+                chain=chain_evidence(full),
+            )
+        )
+
+    def stats(self) -> dict:
+        return {
+            "taint_sources": self.sources,
+            "taint_sinks": self.sinks,
+            "taint_sanitized": self.sanitized,
+            "taint_build_s": self.build_seconds,
+        }
+
+
+def taint_for(model: ProgramModel) -> TaintFlow:
+    """One TaintFlow per program model, shared by the taint rules (and
+    surfaced into ``--stats`` by the engine)."""
+    tf = getattr(model, "_taint", None)
+    if tf is None:
+        tf = TaintFlow(model)
+        model._taint = tf
+    return tf
+
+
+@rule(
+    "unbounded-peer-allocation",
+    "a peer-controlled integer sizes an allocation (bytes(n), seq * n) "
+    "without a dominating bound check",
+    scope="program",
+)
+def unbounded_peer_allocation(model: ProgramModel) -> Iterator[Finding]:
+    for f in taint_for(model).findings:
+        if f.rule == "unbounded-peer-allocation":
+            yield f
+
+
+@rule(
+    "unvalidated-count-loop",
+    "a peer-controlled count drives a range() loop or recursion without "
+    "a dominating bound check",
+    scope="program",
+)
+def unvalidated_count_loop(model: ProgramModel) -> Iterator[Finding]:
+    for f in taint_for(model).findings:
+        if f.rule == "unvalidated-count-loop":
+            yield f
+
+
+@rule(
+    "unchecked-peer-read-size",
+    "a peer-controlled length reaches a stream read or slice bound "
+    "without a dominating bound check",
+    scope="program",
+)
+def unchecked_peer_read_size(model: ProgramModel) -> Iterator[Finding]:
+    for f in taint_for(model).findings:
+        if f.rule == "unchecked-peer-read-size":
+            yield f
+
+
+# -- taint-boundary-drift ------------------------------------------------------
+
+#: A trust-boundary table row:
+#: ``| `pattern` | source | `module/path.py` | meaning |`` (source rows)
+#: ``| `pattern` | sink   | —                | meaning |`` (sink rows)
+_BOUNDARY_ROW = re.compile(
+    r"^\s*\|\s*`([A-Za-z_][A-Za-z0-9_-]*)`\s*\|\s*(source|sink)\s*\|"
+    r"\s*(?:`([^`]+)`|[-—–]+)\s*\|"
+)
+
+_DESIGN_DOC = "docs/DESIGN.md"
+
+
+def _boundary_rows(root: str):
+    """[(pattern, role, module-or-None, lineno)] from the DESIGN.md
+    trust-boundary table, or None when the doc (or the table) is absent
+    — the rule then skips entirely, so scratch fixture trees without
+    docs stay clean."""
+    lines = read_doc_lines(os.path.join(root, *_DESIGN_DOC.split("/")))
+    if lines is None:
+        return None
+    rows = []
+    for i, line in enumerate(lines, start=1):
+        m = _BOUNDARY_ROW.match(line)
+        if m is not None:
+            rows.append((m.group(1), m.group(2), m.group(3), i))
+    return rows or None
+
+
+@rule(
+    "taint-boundary-drift",
+    "the docs/DESIGN.md trust-boundary table and the actual peer-read "
+    "call sites disagree",
+    scope="program",
+)
+def taint_boundary_drift(model: ProgramModel) -> Iterator[Finding]:
+    root = model.package_root()
+    if root is None:
+        return
+    rows = _boundary_rows(root)
+    if rows is None:
+        return
+    tf = taint_for(model)
+    doc_sources: Dict[Tuple[str, str], int] = {}
+    doc_sinks: Dict[str, int] = {}
+    for pattern, role, module, lineno in rows:
+        if role == "source" and module is not None:
+            doc_sources.setdefault((module, pattern), lineno)
+        elif role == "sink":
+            doc_sinks.setdefault(pattern, lineno)
+
+    # doc -> code: a documented source must have a live call site the
+    # analysis actually taints (the vocabulary AND the tree agree).
+    for (module, pattern), lineno in sorted(doc_sources.items()):
+        if (module, pattern) not in tf.source_sites:
+            yield Finding(
+                "taint-boundary-drift",
+                _DESIGN_DOC,
+                lineno,
+                f"trust-boundary table declares source '{pattern}' in "
+                f"{module} but no such peer-read call site exists "
+                f"(stale row)",
+            )
+    # code -> doc: every peer-read site the analysis taints must be
+    # declared in the table.
+    for (module, pattern), lineno in sorted(tf.source_sites.items()):
+        if (module, pattern) not in doc_sources:
+            yield Finding(
+                "taint-boundary-drift",
+                module,
+                lineno,
+                f"peer-read call '{pattern}' is a live taint source in "
+                f"{module} but is missing from the {_DESIGN_DOC} "
+                f"trust-boundary table",
+            )
+    # sink vocabulary: set equality, both directions.
+    for pattern, lineno in sorted(doc_sinks.items()):
+        if pattern not in SINK_VOCAB:
+            yield Finding(
+                "taint-boundary-drift",
+                _DESIGN_DOC,
+                lineno,
+                f"trust-boundary table declares sink '{pattern}' but the "
+                f"analysis has no such sink (stale row)",
+            )
+    anchor = min(doc_sinks.values()) if doc_sinks else rows[0][3]
+    for pattern in sorted(SINK_VOCAB - set(doc_sinks)):
+        yield Finding(
+            "taint-boundary-drift",
+            _DESIGN_DOC,
+            anchor,
+            f"taint sink '{pattern}' is checked by the analysis but "
+            f"missing from the trust-boundary table",
+        )
