@@ -7,7 +7,7 @@ NAME = registrar
 RELEASE_TARBALL = $(NAME)-release.tar.gz
 RELSTAGEDIR = /tmp/$(NAME)-release
 
-.PHONY: all check check-core test test-jax chaos restart-e2e bench bench-cached bench-sharded profile slo slo-quick release publish clean
+.PHONY: all check check-core test test-jax chaos restart-e2e bench bench-cached bench-sharded overload-quick profile slo slo-quick release publish clean
 
 all: check test
 
@@ -124,6 +124,19 @@ bench-cached:
 bench-sharded:
 	$(PYTHON) -m pytest tests/test_shard.py -x -q
 	$(PYTHON) bench.py --sharded-only
+
+# Overload-armor slice (ISSUE 17): the admission/shedding suite, then a
+# seeded heavy-tailed storm (Zipf popularity + flash crowd + never-exists
+# churn + malformed frames + slow-loris/half-open clients) paced at ~5x
+# measured capacity against an ARMORED 2-shard tier.  Hard-fails on any
+# admitted-request timeout (sheds must fail FAST, never look like
+# timeouts) or on a storm that sheds nothing (no overload reached = the
+# measurement is vacuous).  The storm seed is printed in a replay line —
+# BENCH_OVERLOAD_SEED=<seed> pins it — and echoed into the CI chaos
+# job's summary.  BENCH_SMOKE=1 drops to reduced scale for shared cores.
+overload-quick:
+	$(PYTHON) -m pytest tests/test_overload.py -x -q
+	$(PYTHON) bench.py --overload-only
 
 # Release tarball rooted at $(PREFIX) (the reference roots its tarball
 # at /opt/smartdc/registrar, Makefile:70-95).  The SMF manifest is

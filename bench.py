@@ -110,6 +110,12 @@ BENCH_METRICS = {
     "sharded_resolve_qps_4_shards_traced": "higher",
     "sharded_trace_overhead_pct": None,
     "reshard_warm_handoff_ms": "lower",
+    "overload_admitted_warm_p99_ms": "lower",
+    "overload_shed_fastfail_p99_ms": "lower",
+    "overload_capacity_qps": None,
+    "overload_offered_x_capacity": None,
+    "overload_sheds_total": None,
+    "overload_storm_seed": None,
 }
 
 #: histogram-quantile metric names as literals (consumed from
@@ -760,6 +766,9 @@ async def _sharded_metrics(server, client, sock_dir: str,
         attempts=3 if smoke else 6, assert_bound=not smoke,
     )
     handoff_ms = await _reshard_handoff(server, sock_dir, domains)
+    overload = await _overload_metrics(
+        server, sock_dir, domains, _overload_seed(), smoke=smoke,
+    )
     cores = os.cpu_count() or 1
     ratio = (
         qps["sharded_resolve_qps_4_shards"]
@@ -780,7 +789,99 @@ async def _sharded_metrics(server, client, sock_dir: str,
         "sharded_resolve_qps_4_shards_traced": round(traced_qps, 1),
         "sharded_trace_overhead_pct": round(overhead_pct, 2),
         "reshard_warm_handoff_ms": round(handoff_ms, 1),
+        **overload,
     }
+
+
+#: the bench tier's overload armor (ISSUE 17): per-connection inflight
+#: does the shedding, the global depth is the backstop, cold fills are
+#: bounded, and a non-reading client is cut loose — mirrors the SLO
+#: harness's armored tier so the gated p99 measures the same defenses
+#: the nines envelope prices
+OVERLOAD_BENCH_ARMOR = {
+    "maxQueueDepth": 96,
+    "maxInflightPerConn": 6,
+    "coldFillConcurrency": 4,
+    "writeDeadlineS": 0.4,
+}
+
+
+async def _overload_metrics(
+    server, sock_dir: str, domains: list, seed: int,
+    shards: int = 2, capacity_x: float = 5.0, storm_s: float = 1.5,
+    smoke: bool = False,
+) -> dict:
+    """p99-under-overload (ISSUE 17): stand up an ARMORED tier, measure
+    its warm capacity closed-loop, then drive the seeded heavy-tailed
+    storm paced at ``capacity_x`` the measured figure.  The gated
+    metrics are the p99 of ADMITTED warm resolves (the armor's promise:
+    accepted work stays fast) and the p99 of an explicit shed reply
+    (the refusals must be fast too — fail-fast, never silence).  A
+    storm request that times out fails the run outright: under armor a
+    timeout is a bug, not a data point."""
+    from registrar_tpu.shard import ShardRouter
+    from registrar_tpu.testing import workload
+
+    router = ShardRouter(
+        [server.address], shards,
+        os.path.join(sock_dir, "benchoverload.sock"),
+        attach_spread="any", poll_interval_s=30.0,
+        overload=OVERLOAD_BENCH_ARMOR,
+    )
+    await router.start()
+    try:
+        capacity = await workload.measure_capacity(
+            router.socket_path, domains,
+            seconds=0.25 if smoke else 0.5,
+        )
+        storm = workload.StormWorkload(
+            router.socket_path, domains, seed=seed,
+            duration_s=storm_s / 2 if smoke else storm_s,
+            clients=8, pipeline=32,
+            offered_rps=capacity * capacity_x,
+            loris_frames=4000 if smoke else 12000,
+        )
+        report = await storm.run()
+        summary = report.summary()
+        if report.timeouts_total:
+            raise RuntimeError(
+                f"overload storm: {report.timeouts_total} requests timed "
+                "out under armor — every refusal must be an explicit "
+                f"fast shed (summary: {summary})"
+            )
+        if report.sheds_total == 0:
+            raise RuntimeError(
+                "overload storm never shed: offered load "
+                f"{summary['offered_rps']} qps did not exceed the tier's "
+                f"admission bounds (capacity {capacity:.1f} qps)"
+            )
+        return {
+            "overload_admitted_warm_p99_ms": summary[
+                "admitted_warm_p99_ms"
+            ],
+            "overload_shed_fastfail_p99_ms": summary[
+                "shed_fastfail_p99_ms"
+            ],
+            "overload_capacity_qps": round(capacity, 1),
+            "overload_offered_x_capacity": round(
+                summary["offered_rps"] / capacity, 2
+            ) if capacity else None,
+            "overload_sheds_total": summary["sheds_total"],
+            "overload_storm_seed": seed,
+        }
+    finally:
+        await router.stop()
+
+
+def _overload_seed() -> int:
+    """The storm seed: pinned via BENCH_OVERLOAD_SEED for replay,
+    drawn fresh otherwise — always echoed in the output line."""
+    raw = os.environ.get("BENCH_OVERLOAD_SEED")
+    if raw is not None:
+        return int(raw)
+    import random
+
+    return random.randrange(2**32)
 
 
 async def _concurrent_agents(server, n_agents: int, znodes_each: int) -> float:
@@ -1098,6 +1199,12 @@ async def _bench() -> dict:
                 "sharded_resolve_qps_4_shards_traced": None,
                 "sharded_trace_overhead_pct": None,
                 "reshard_warm_handoff_ms": None,
+                "overload_admitted_warm_p99_ms": None,
+                "overload_shed_fastfail_p99_ms": None,
+                "overload_capacity_qps": None,
+                "overload_offered_x_capacity": None,
+                "overload_sheds_total": None,
+                "overload_storm_seed": None,
             }
         else:
             import tempfile
@@ -1187,6 +1294,59 @@ async def _bench_cached() -> dict:
         }
     finally:
         await observer.close()
+        await client.close()
+        await server.stop()
+
+
+async def _bench_overload() -> dict:
+    """``--overload-only``: the ISSUE-17 p99-under-overload slice.
+
+    The hook behind ``make overload-quick`` (and the CI chaos job):
+    register the shard-bench domains, stand up the ARMORED 2-shard
+    tier, measure capacity, and drive the seeded storm at ~5x it.
+    Prints the one-JSON-line shape with the storm seed echoed (replay
+    with BENCH_OVERLOAD_SEED=<seed>); never gated here — the
+    cross-round gate on the p99 metrics belongs to ``python bench.py``.
+    A timeout under armor fails the run inside _overload_metrics.
+    """
+    import tempfile
+
+    seed = _overload_seed()
+    server = await ZKServer().start()
+    client = await ZKClient([server.address]).connect()
+    try:
+        domains = await _register_shard_domains(
+            client, n_domains=4 if SMOKE else 8,
+            instances=5 if SMOKE else 10,
+        )
+        with tempfile.TemporaryDirectory(prefix="ovbench") as td:
+            overload = await _overload_metrics(
+                server, td, domains, seed, smoke=SMOKE,
+            )
+        print(
+            f"bench: overload storm seed {seed} "
+            f"(replay: BENCH_OVERLOAD_SEED={seed}) — "
+            f"admitted warm p99 {overload['overload_admitted_warm_p99_ms']}"
+            f"ms, shed fast-fail p99 "
+            f"{overload['overload_shed_fastfail_p99_ms']}ms, "
+            f"{overload['overload_sheds_total']} sheds at "
+            f"{overload['overload_offered_x_capacity']}x capacity",
+            file=sys.stderr,
+        )
+        return {
+            "metric": "overload_admitted_warm_p99_ms",
+            "value": overload["overload_admitted_warm_p99_ms"],
+            "unit": "ms",
+            "seed": seed,
+            "extra": {
+                "baseline": "armored tier under the seeded storm; the "
+                "admitted-warm p99 and shed fast-fail p99 are gated "
+                "cross-round by the full bench, and a timeout under "
+                "armor fails this run outright",
+                **overload,
+            },
+        }
+    finally:
         await client.close()
         await server.stop()
 
@@ -1499,6 +1659,9 @@ def main() -> int:
         return 0
     if "--sharded-only" in sys.argv[1:]:
         print(json.dumps(asyncio.run(_bench_sharded())))
+        return 0
+    if "--overload-only" in sys.argv[1:]:
+        print(json.dumps(asyncio.run(_bench_overload())))
         return 0
     if "--profile" in sys.argv[1:]:
         return run_profile()

@@ -153,6 +153,46 @@ class RestartConfig:
 
 
 @dataclass
+class OverloadConfig:
+    """The ``serve.overload`` block (ISSUE 17): overload armor for the
+    sharded tier.  Every knob is optional and None = that defense off;
+    the whole block absent = no armor anywhere, byte-identical to
+    today's behavior (reference parity — the reference registrar has no
+    serve tier, let alone admission control).
+
+    ``maxQueueDepth``: bound on resolve requests dispatched-but-
+    unanswered per worker (excess fast-fails ``SHED:queue_full``).
+    ``maxInflightPerConn``: bound on resolve requests in flight per
+    worker connection (same shed reason, per-socket).
+    ``clientRateLimit``: per-client resolves/second token bucket at the
+    router's front socket (``SHED:rate_limited``).
+    ``coldFillConcurrency``: bound on concurrent distinct-path cold
+    fills in each worker's cache (``SHED:cold_fill_shed``; warm domains
+    degrade to bounded-age stale answers instead).
+    ``writeDeadlineS``: reply write deadline — a peer that stops
+    reading (slow-loris / half-open) is disconnected after this many
+    seconds instead of pinning its handler tasks forever."""
+
+    max_queue_depth: Optional[int] = None
+    max_inflight_per_conn: Optional[int] = None
+    client_rate_limit: Optional[float] = None
+    cold_fill_concurrency: Optional[int] = None
+    write_deadline_s: Optional[float] = None
+
+    def as_router_kwargs(self) -> Dict[str, Any]:
+        """The dict :class:`registrar_tpu.shard.ShardRouter` takes as
+        ``overload=`` (spec-key spelling, Nones dropped)."""
+        raw = {
+            "maxQueueDepth": self.max_queue_depth,
+            "maxInflightPerConn": self.max_inflight_per_conn,
+            "clientRateLimit": self.client_rate_limit,
+            "coldFillConcurrency": self.cold_fill_concurrency,
+            "writeDeadlineS": self.write_deadline_s,
+        }
+        return {k: v for k, v in raw.items() if v is not None}
+
+
+@dataclass
 class ServeConfig:
     """The ``serve`` block (ISSUE 12): the namespace-sharded resolve
     tier (:mod:`registrar_tpu.shard`), run standalone by ``zkcli
@@ -161,13 +201,16 @@ class ServeConfig:
     router's front unix socket (worker sockets are suffixed onto it);
     ``attachSpread`` is the watch-load placement hint handed to each
     worker's ZK client (``"spread"`` → worker k of n gets
-    ``spread:k-of-n``; ``"follower"`` / ``"any"`` pass through).  The
-    daemon itself never resolves and ignores the block — absent block =
-    today's in-process behavior, reference parity untouched."""
+    ``spread:k-of-n``; ``"follower"`` / ``"any"`` pass through);
+    ``overload`` is the opt-in overload armor (ISSUE 17,
+    :class:`OverloadConfig`).  The daemon itself never resolves and
+    ignores the block — absent block = today's in-process behavior,
+    reference parity untouched."""
 
     shards: int
     socket_path: str
     attach_spread: str = "spread"
+    overload: Optional[OverloadConfig] = None
 
 
 @dataclass
@@ -567,10 +610,64 @@ def parse_config(raw: Mapping[str, Any]) -> Config:
                 'config.serve.attachSpread must be "any", "follower", '
                 'or "spread"'
             )
+        overload = None
+        overload_raw = serve_raw.get("overload")
+        if overload_raw is not None:
+            if not isinstance(overload_raw, Mapping):
+                raise ConfigError("config.serve.overload must be an object")
+
+            def _overload_int(key: str, value) -> Optional[int]:
+                if value is None:
+                    return None
+                if (
+                    not isinstance(value, int)
+                    or isinstance(value, bool)
+                    or value < 1
+                ):
+                    raise ConfigError(
+                        f"config.serve.overload.{key} must be a "
+                        "positive integer"
+                    )
+                return value
+
+            def _overload_num(key: str, value) -> Optional[float]:
+                if value is None:
+                    return None
+                if (
+                    not isinstance(value, (int, float))
+                    or isinstance(value, bool)
+                    or value <= 0
+                ):
+                    raise ConfigError(
+                        f"config.serve.overload.{key} must be a "
+                        "positive number"
+                    )
+                return float(value)
+
+            overload = OverloadConfig(
+                max_queue_depth=_overload_int(
+                    "maxQueueDepth", overload_raw.get("maxQueueDepth")
+                ),
+                max_inflight_per_conn=_overload_int(
+                    "maxInflightPerConn",
+                    overload_raw.get("maxInflightPerConn"),
+                ),
+                client_rate_limit=_overload_num(
+                    "clientRateLimit", overload_raw.get("clientRateLimit")
+                ),
+                cold_fill_concurrency=_overload_int(
+                    "coldFillConcurrency",
+                    overload_raw.get("coldFillConcurrency"),
+                ),
+                write_deadline_s=_overload_num(
+                    "writeDeadlineS", overload_raw.get("writeDeadlineS")
+                ),
+            )
         serve = ServeConfig(
             shards=shards,
             socket_path=socket_path,
             attach_spread=attach_spread,
+            overload=overload,
         )
 
     metrics = None

@@ -885,6 +885,12 @@ def instrument_shards(
     pre-seeded for the router's current shard ids; counters stay
     monotonic across worker crashes because the router banks a dead
     incarnation's totals (``Counter.set_total``).
+
+    The overload-armor families (ISSUE 17) ride the same events:
+    ``registrar_shed_total{reason}`` and ``registrar_queue_depth{shard}``
+    from the status polls, ``registrar_admitted_resolve_seconds`` from
+    the router's ``admitted`` event (one observation per successfully
+    relayed resolve).
     """
     reg = registry if registry is not None else MetricsRegistry()
     resolves = reg.counter(
@@ -919,6 +925,36 @@ def instrument_shards(
         "span's forwarded/worker marks split it into router-queue, "
         "socket, and worker time",
     )
+    # Overload armor rollup (ISSUE 17).  All three families exist (pre-
+    # seeded) whether or not any armor is configured — an un-armored
+    # tier legitimately reports zero sheds, and the alert rate() needs
+    # the zero series either way.
+    from registrar_tpu.shard import SHED_REASONS
+
+    sheds = reg.counter(
+        "registrar_shed_total",
+        "Requests deliberately rejected by the overload armor, by shed "
+        "reason (queue_full = worker admission bound, rate_limited = "
+        "the router's per-client token bucket, cold_fill_shed = the "
+        "cache's cold-fill concurrency bound, slow_client = a reply "
+        "write deadline disconnected a stalled reader); monotonic "
+        "across worker respawns",
+    )
+    for reason in SHED_REASONS:
+        sheds.inc(0, labels={"reason": reason})
+    queue_depth = reg.gauge(
+        "registrar_queue_depth",
+        "Resolve requests dispatched and unanswered in the worker, by "
+        "shard (the bounded dispatch backlog; at maxQueueDepth new "
+        "resolves shed queue_full)",
+    )
+    admitted = reg.histogram(
+        "registrar_admitted_resolve_seconds",
+        "Latency of ADMITTED resolves relayed through the router "
+        "(shed requests are excluded — this prices exactly the work "
+        "the armor let through)",
+    )
+    admitted.preseed(None)
     seeded: set = set()
 
     def seed(sid) -> None:
@@ -928,6 +964,7 @@ def instrument_shards(
         up.set(0.0, labels=labels)
         respawns.inc(0, labels=labels)
         relay.preseed(labels)
+        queue_depth.set(0.0, labels=labels)
         seeded.add(sid)
 
     for sid in getattr(router.ring, "shard_ids", ()):
@@ -945,6 +982,7 @@ def instrument_shards(
         for sid in seeded - current:
             entries.remove({"shard": str(sid)})
             up.remove({"shard": str(sid)})
+            queue_depth.remove({"shard": str(sid)})
             seeded.discard(sid)
 
     def on_poll(statuses) -> None:
@@ -958,8 +996,20 @@ def instrument_shards(
                 router.shard_resolves_total(sid), labels=labels
             )
             entries.set(float(status.get("entries", 0)), labels=labels)
+            queue_depth.set(
+                float(
+                    (status.get("overload") or {}).get("queue_depth", 0)
+                ),
+                labels=labels,
+            )
+        # Tier-wide shed rollup: router-side rejects plus every slot's
+        # banked + live worker counts (set_total keeps it monotonic
+        # across respawns, same contract as resolves).
+        for reason, count in router.sheds_total().items():
+            sheds.set_total(count, labels={"reason": reason})
 
     router.on("poll", on_poll)
+    router.on("admitted", lambda seconds: admitted.observe(seconds))
     router.on(
         "respawn",
         lambda sid: (

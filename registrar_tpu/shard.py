@@ -121,7 +121,9 @@ from registrar_tpu.binderview import Answer, Resolution
 from registrar_tpu.events import EventEmitter, spawn_owned
 from registrar_tpu.retry import RetryPolicy, is_transient
 from registrar_tpu.zk.client import ZKClient, connect_with_backoff
-from registrar_tpu.zkcache import DEFAULT_MAX_ENTRIES, ZKCache
+from registrar_tpu.zkcache import (
+    CacheOverloadError, DEFAULT_MAX_ENTRIES, ZKCache,
+)
 
 log = logging.getLogger("registrar_tpu.shard")
 
@@ -176,6 +178,67 @@ DEFAULT_MAX_STALE_S = 30.0
 
 class ShardError(Exception):
     """A sharded-tier request failed (worker down, protocol error)."""
+
+
+#: wire marker for a deliberate overload reject: the STATUS_ERR body is
+#: ``SHED:<reason>[ <detail>]``.  A prefix on the existing error body —
+#: not a new status code — so every PR-12 peer (and the router's
+#: verbatim error forwarding) carries it unchanged, while armor-aware
+#: clients can tell "the tier refused fast" from "the tier broke".
+SHED_PREFIX = b"SHED:"
+
+#: the shed-reason taxonomy (docs/OPERATIONS.md "Overload"): the label
+#: vocabulary of registrar_shed_total and the first word of every
+#: SHED: reject body.  queue_full = worker admission (dispatch backlog
+#: or per-connection in-flight bound), rate_limited = the router's
+#: per-client token bucket, cold_fill_shed = ZKCache's bounded cold-fill
+#: concurrency, slow_client = a reply write deadline expired (slow-loris
+#: / half-open peer disconnected).
+SHED_REASONS = ("queue_full", "rate_limited", "cold_fill_shed", "slow_client")
+
+
+class ShardShedError(ShardError):
+    """A request the overload armor deliberately rejected (fast-fail —
+    the reply came back immediately, it did NOT time out).  ``reason``
+    is one of :data:`SHED_REASONS`; callers that want to degrade (serve
+    stale, back off, retry elsewhere) can catch this narrower class
+    while plain :class:`ShardError` keeps meaning "broken"."""
+
+    def __init__(self, reason: str, detail: str = ""):
+        self.reason = reason
+        self.detail = detail
+        super().__init__(
+            f"shed [{reason}]{' ' + detail if detail else ''}"
+        )
+
+    def payload(self) -> bytes:
+        return shed_body(self.reason, self.detail)
+
+
+def shed_body(reason: str, detail: str = "") -> bytes:
+    """The wire body of a shed reject (STATUS_ERR + this)."""
+    out = SHED_PREFIX + reason.encode("ascii")
+    if detail:
+        out += b" " + detail.encode("utf-8", "replace")
+    return out
+
+
+def shed_reason(body) -> Optional[str]:
+    """The shed reason inside a STATUS_ERR body, or None if the error
+    is not a shed reject (the client-side classifier)."""
+    raw = bytes(body)
+    if not raw.startswith(SHED_PREFIX):
+        return None
+    return (
+        raw[len(SHED_PREFIX):].split(b" ", 1)[0].decode("ascii", "replace")
+    )
+
+
+def _opt_int(raw) -> Optional[int]:
+    """An optional spec knob: None stays None (unbounded), anything
+    else must coerce to int — a typo'd bound must fail the spawn, not
+    silently disable the armor it claimed to configure."""
+    return None if raw is None else int(raw)
 
 
 # ---------------------------------------------------------------------------
@@ -565,6 +628,27 @@ class ShardWorker:
         self.max_stale_s = float(
             spec.get("maxStaleS") or DEFAULT_MAX_STALE_S
         )
+        # -- overload armor (ISSUE 17; every knob None = unbounded, the
+        # pre-armor behavior — config absent means not a byte changes) --
+        #: bound on resolve requests dispatched-but-unanswered across
+        #: the whole worker (the dispatch backlog satellite 1 bounds)
+        self.max_queue_depth = _opt_int(spec.get("maxQueueDepth"))
+        #: bound on resolve requests in flight per connection (the
+        #: per-connection in-flight map satellite 1 bounds)
+        self.max_inflight_per_conn = _opt_int(spec.get("maxInflightPerConn"))
+        #: reply write deadline (seconds): a peer that stops reading is
+        #: disconnected rather than allowed to pin its handler tasks
+        self.write_deadline_s = (
+            float(spec["writeDeadlineS"])
+            if spec.get("writeDeadlineS") is not None
+            else None
+        )
+        #: bound on concurrent cold fills, threaded into ZKCache
+        self.cold_fill_concurrency = _opt_int(spec.get("coldFillConcurrency"))
+        #: resolve requests currently dispatched and unanswered
+        self.queue_depth = 0
+        #: deliberate rejects by reason (docs/OPERATIONS.md taxonomy)
+        self.sheds: Dict[str, int] = {r: 0 for r in SHED_REASONS}
         #: LRU warm set: (name, qtype) -> (last-good serialized answer,
         #: monotonic stamp); dict order = recency
         self.warm: Dict[Tuple[str, str], Tuple[bytes, float]] = {}
@@ -592,7 +676,11 @@ class ShardWorker:
         # readiness signal the router's respawn bound is built on.
         self.zk = self._make_client()
         await connect_with_backoff(self.zk)
-        self.cache = ZKCache(self.zk, max_entries=self.max_entries)
+        self.cache = ZKCache(
+            self.zk,
+            max_entries=self.max_entries,
+            fill_concurrency=self.cold_fill_concurrency,
+        )
         try:
             os.unlink(self.socket_path)
         except OSError:
@@ -633,15 +721,77 @@ class ShardWorker:
     # -- request handling ---------------------------------------------------
 
     async def _on_connection(self, reader, writer) -> None:
+        # Per-connection in-flight count, shared (mutably) with the
+        # handler tasks this connection spawns.
+        conn = {"inflight": 0}
         try:
             while True:
                 frame = await _read_frame(reader)
                 if frame is None:
                     return
-                # Each request is its own task: a cold fill awaiting the
-                # wire must not head-of-line-block the warm answers
-                # pipelined behind it (replies demux by req_id).
-                spawn_owned(self._handle(frame, writer), self._tasks)
+                req_id, op = _HDR.unpack_from(frame)
+                reason = self._admission_check(op, conn)
+                if reason is not None:
+                    # Fast-fail shed: answered inline from the read
+                    # loop, never dispatched, normally never drained —
+                    # a shed reply must not queue behind the very
+                    # backlog it is refusing to join (and must never
+                    # look like a timeout; the requester's future
+                    # resolves now).
+                    self.sheds[reason] += 1
+                    writer.write(
+                        pack_frame(
+                            req_id, STATUS_ERR,
+                            shed_body(reason, f"shard {self.shard_id}"),
+                        )
+                    )
+                    transport = writer.transport
+                    if (
+                        self.write_deadline_s is not None
+                        and transport is not None
+                        and transport.get_write_buffer_size() > 65536
+                    ):
+                        # A peer that floods requests but never reads
+                        # replies grows the reject buffer without bound
+                        # — the slow-loris shape the admitted path's
+                        # drain deadline can't see (sheds outnumber
+                        # admissions by orders of magnitude under a
+                        # flood).  Once the buffer is past the
+                        # transport's high-water mark, drain under the
+                        # same deadline; a well-behaved bursty reader
+                        # drains in microseconds, a non-reader gets
+                        # disconnected here.
+                        try:
+                            await asyncio.wait_for(
+                                writer.drain(), self.write_deadline_s
+                            )
+                        except asyncio.TimeoutError:
+                            self.sheds["slow_client"] += 1
+                            log.warning(
+                                "shard %d: shed backlog write stalled "
+                                "> %.1fs; disconnecting slow client",
+                                self.shard_id, self.write_deadline_s,
+                            )
+                            transport.abort()
+                            return
+                    continue
+                # Each admitted request is its own task: a cold fill
+                # awaiting the wire must not head-of-line-block the
+                # warm answers pipelined behind it (replies demux by
+                # req_id).  Control ops (OP_STATUS/OP_RING/OP_TRACE...)
+                # skip admission entirely — the priority lane: they are
+                # never shed and never wait behind a saturated resolve
+                # backlog, because that backlog is bounded and anything
+                # beyond the bound was refused above.
+                if op & ~TRACE_FLAG & 0xFF == OP_RESOLVE:
+                    conn["inflight"] += 1
+                    self.queue_depth += 1
+                    spawn_owned(
+                        self._handle_admitted(frame, writer, conn),
+                        self._tasks,
+                    )
+                else:
+                    spawn_owned(self._handle(frame, writer), self._tasks)
         except (ShardError, ConnectionError, OSError):
             pass
         finally:
@@ -650,7 +800,47 @@ class ShardWorker:
             except Exception:  # noqa: BLE001 - teardown best effort
                 pass
 
-    async def _handle(self, frame: bytes, writer) -> None:
+    def _admission_check(self, op: int, conn: Dict) -> Optional[str]:
+        """The admission decision for one incoming frame: a shed reason,
+        or None for admitted.  Only OP_RESOLVE is ever shed."""
+        if op & ~TRACE_FLAG & 0xFF != OP_RESOLVE:
+            return None
+        if (
+            self.max_inflight_per_conn is not None
+            and conn["inflight"] >= self.max_inflight_per_conn
+        ):
+            return "queue_full"
+        if (
+            self.max_queue_depth is not None
+            and self.queue_depth >= self.max_queue_depth
+        ):
+            return "queue_full"
+        return None
+
+    async def _handle_admitted(self, frame: bytes, writer, conn: Dict) -> None:
+        released = False
+
+        def release() -> None:
+            # The admission slot covers the resolve WORK, not the reply
+            # flush: it must be free before the reply bytes can reach
+            # the peer, or a well-behaved serial client races its own
+            # slot (reply arrives, next request sent, worker's
+            # decrement still parked behind the drain's loop yield) and
+            # gets spuriously shed at maxInflightPerConn=1.  _handle
+            # calls this right before writing; the finally covers every
+            # early-exit path exactly once.
+            nonlocal released
+            if not released:
+                released = True
+                conn["inflight"] -= 1
+                self.queue_depth -= 1
+
+        try:
+            await self._handle(frame, writer, release)
+        finally:
+            release()
+
+    async def _handle(self, frame: bytes, writer, release=None) -> None:
         req_id, op = _HDR.unpack_from(frame)
         try:
             op, ctx, body = split_traced(frame, op)
@@ -675,6 +865,12 @@ class ShardWorker:
             status = STATUS_OK
         except asyncio.CancelledError:
             raise
+        except ShardShedError as err:
+            # A deliberate overload reject (counted at the shed site),
+            # not a failure: the SHED: body travels the plain error
+            # rail, so every peer back to the client sees the reason.
+            reply = err.payload()
+            status = STATUS_ERR
         except Exception as err:  # noqa: BLE001 - one bad request != the worker
             self.errors_total += 1
             reply = repr(err).encode()
@@ -683,9 +879,31 @@ class ShardWorker:
             # Traced reply extension: this worker's handling time, the
             # relay span's "worker" mark.
             status, reply = stamp_traced_reply(status, reply, t0)
+        if release is not None:
+            release()
         try:
             writer.write(pack_frame(req_id, status, reply))
-            await writer.drain()
+            if self.write_deadline_s is None:
+                await writer.drain()
+            else:
+                # Slow-loris armor: a peer that stops reading keeps the
+                # transport's send buffer full and would park THIS task
+                # (and its in-flight slot) on drain() forever.  Bound
+                # the wait and abort the transport — the connection
+                # handler's finally cleans up; in-flight accounting
+                # unwinds through _handle_admitted's finally.
+                await asyncio.wait_for(
+                    writer.drain(), self.write_deadline_s
+                )
+        except asyncio.TimeoutError:
+            self.sheds["slow_client"] += 1
+            log.warning(
+                "shard %d: reply write stalled > %.1fs; disconnecting "
+                "slow client", self.shard_id, self.write_deadline_s,
+            )
+            transport = writer.transport
+            if transport is not None:
+                transport.abort()
         except (ConnectionError, OSError):
             pass  # requester went away; nothing owed
 
@@ -743,6 +961,19 @@ class ShardWorker:
             return encode_resolution(res)
         try:
             res = await binderview.resolve(self.cache, name, qtype)
+        except CacheOverloadError as err:
+            # Cold-fill stampede shed: prefer stale over collapse — a
+            # warm domain whose entry was churned out answers its
+            # bounded-age last-known-good bytes instead of joining the
+            # fill queue; a genuinely cold domain fails fast with the
+            # explicit shed reason (never a timeout).
+            self.sheds["cold_fill_shed"] += 1
+            payload = self._stale_payload(name, qtype)
+            if payload is None:
+                raise ShardShedError("cold_fill_shed", str(err)) from err
+            self.stale_serves += 1
+            self.resolves_total += 1
+            return payload
         except Exception as err:  # noqa: BLE001 - classified right below
             payload = self._stale_payload(name, qtype)
             if payload is None or not is_transient(err):
@@ -784,6 +1015,17 @@ class ShardWorker:
             "resolves_total": self.resolves_total,
             "errors_total": self.errors_total,
             "stale_serves": self.stale_serves,
+            "overload": {
+                "queue_depth": self.queue_depth,
+                "max_queue_depth": self.max_queue_depth,
+                "max_inflight_per_conn": self.max_inflight_per_conn,
+                "sheds": dict(self.sheds),
+                "fill_sheds": (
+                    int(self.cache.stats.get("fill_sheds", 0))
+                    if self.cache is not None
+                    else 0
+                ),
+            },
             "warm": len(self.warm),
             "entries": cache.entries if cache is not None else 0,
             "authoritative": (
@@ -858,7 +1100,8 @@ class _WorkerHandle:
 
     __slots__ = (
         "shard_id", "seq", "socket_path", "proc", "chan", "up",
-        "up_since", "respawns", "resolves_base", "last_status",
+        "up_since", "respawns", "resolves_base", "sheds_base",
+        "last_status",
     )
 
     def __init__(self, shard_id: int, seq: int, socket_path: str):
@@ -874,11 +1117,29 @@ class _WorkerHandle:
         #: worker restarts its counter at zero, and the rolled-up
         #: registrar_shard_resolves_total must stay monotonic
         self.resolves_base = 0
+        #: same banking for the shed counters (registrar_shed_total is
+        #: a counter too; a respawn must not rewind it)
+        self.sheds_base: Dict[str, int] = {r: 0 for r in SHED_REASONS}
         self.last_status: Dict = {}
 
     def resolves_total(self) -> int:
         return self.resolves_base + int(
             self.last_status.get("resolves_total", 0)
+        )
+
+    def sheds_total(self) -> Dict[str, int]:
+        """Per-reason request sheds across every incarnation of this
+        slot (the cache's per-FILL ``fill_sheds`` stat stays a status
+        detail — different unit, one request can shed several fills)."""
+        sheds = (self.last_status.get("overload") or {}).get("sheds") or {}
+        return {
+            r: self.sheds_base[r] + int(sheds.get(r, 0))
+            for r in SHED_REASONS
+        }
+
+    def queue_depth(self) -> int:
+        return int(
+            (self.last_status.get("overload") or {}).get("queue_depth", 0)
         )
 
 
@@ -890,7 +1151,9 @@ class ShardRouter(EventEmitter):
 
     Events (consumed by :func:`registrar_tpu.metrics.instrument_shards`):
     ``respawn`` (shard_id), ``reshard`` (old_count, new_count, moved),
-    ``poll`` (list of per-shard status dicts).
+    ``poll`` (list of per-shard status dicts), ``admitted`` (seconds —
+    one per successfully relayed resolve, the admitted-latency
+    histogram's feed).
     """
 
     def __init__(
@@ -910,6 +1173,7 @@ class ShardRouter(EventEmitter):
         python: Optional[str] = None,
         worker_log_level: Optional[str] = None,
         worker_trace: Optional[Dict] = None,
+        overload: Optional[Dict] = None,
     ):
         super().__init__()
         if shards < 1:
@@ -937,6 +1201,16 @@ class ShardRouter(EventEmitter):
         #: {"sampleRate": 1.0, "maxSpans": 2048}; None = workers trace
         #: nothing, exactly the pre-13 behavior
         self.worker_trace = worker_trace
+        #: overload-armor knobs (ISSUE 17, config ``serve.overload``):
+        #: {"maxQueueDepth", "maxInflightPerConn", "clientRateLimit",
+        #: "coldFillConcurrency", "writeDeadlineS"} — worker-side knobs
+        #: ride each spawn spec, clientRateLimit is enforced HERE (a
+        #: per-front-connection token bucket).  None = no armor, byte-
+        #: identical specs and relays to the pre-17 tier.
+        self.overload = dict(overload) if overload else None
+        #: the router's own deliberate rejects (rate_limited lives here;
+        #: worker reasons roll up from status polls + crash banking)
+        self._sheds: Dict[str, int] = {r: 0 for r in SHED_REASONS}
         #: per-instance tracer override for the router's OWN spans
         #: (shard.relay, shard.trace_collect); None = process default
         self.tracer = None
@@ -961,7 +1235,7 @@ class ShardRouter(EventEmitter):
         attach = self.attach_spread
         if attach == "spread":
             attach = f"spread:{shard_id}-of-{shards}"
-        return {
+        spec = {
             "socket": socket_path,
             "shard": shard_id,
             "shards": shards,
@@ -974,6 +1248,16 @@ class ShardRouter(EventEmitter):
             "requestTimeoutMs": self.request_timeout_ms,
             "trace": self.worker_trace,
         }
+        if self.overload:
+            # Worker-side armor knobs only when configured: an un-armored
+            # router's spec stays byte-identical to the pre-17 format.
+            for key in (
+                "maxQueueDepth", "maxInflightPerConn",
+                "coldFillConcurrency", "writeDeadlineS",
+            ):
+                if self.overload.get(key) is not None:
+                    spec[key] = self.overload[key]
+        return spec
 
     def _spawn_proc(self, spec: Dict) -> subprocess.Popen:
         env = dict(os.environ)
@@ -1165,6 +1449,7 @@ class ShardRouter(EventEmitter):
                     # slices throughout.
                     handle.up = False
                     handle.resolves_base = handle.resolves_total()
+                    handle.sheds_base = handle.sheds_total()
                     handle.last_status = {}
                     if handle.chan is not None:
                         await handle.chan.close()
@@ -1354,11 +1639,40 @@ class ShardRouter(EventEmitter):
 
     async def _on_connection(self, reader, writer) -> None:
         tasks: set = set()
+        # Per-client token bucket (ISSUE 17): one bucket per front
+        # connection, resolves only — control ops (status, ring, trace)
+        # are the priority lane and are never rate limited.  Burst =
+        # one second's refill, so a well-behaved client never notices.
+        rate = float((self.overload or {}).get("clientRateLimit") or 0)
+        tokens = rate
+        last = time.monotonic()
         try:
             while True:
                 frame = await _read_frame(reader)
                 if frame is None:
                     return
+                if rate > 0:
+                    req_id, op = _HDR.unpack_from(frame)
+                    if op & ~TRACE_FLAG & 0xFF == OP_RESOLVE:
+                        now = time.monotonic()
+                        tokens = min(rate, tokens + (now - last) * rate)
+                        last = now
+                        if tokens < 1.0:
+                            # Fast-fail from the read loop, like the
+                            # worker's admission reject: the client
+                            # hears "rate_limited" now, not a timeout.
+                            self._sheds["rate_limited"] += 1
+                            writer.write(
+                                pack_frame(
+                                    req_id, STATUS_ERR,
+                                    shed_body(
+                                        "rate_limited",
+                                        f"limit {rate:g}/s per client",
+                                    ),
+                                )
+                            )
+                            continue
+                        tokens -= 1.0
                 spawn_owned(self._serve_frame(frame, writer), tasks)
         except (ShardError, ConnectionError, OSError):
             pass
@@ -1458,14 +1772,23 @@ class ShardRouter(EventEmitter):
             if span is not None:
                 span.finish("error", err="shard down")
             return STATUS_ERR, b"shard down"
+        t0 = time.monotonic()
         try:
-            return await handle.chan.request(
+            status, reply = await handle.chan.request(
                 OP_RESOLVE, body, trace_ctx=ctx, span=span
             )
         except ShardError as err:
             if span is not None:
                 span.finish("error", err=repr(err))
             return STATUS_ERR, repr(err).encode()
+        if status == STATUS_OK:
+            # One observation per ADMITTED resolve (ISSUE 17): the
+            # registrar_admitted_resolve_seconds histogram's feed — a
+            # shed request (refused by us or by the worker) never lands
+            # here, so the histogram prices exactly the work the armor
+            # let through.
+            self.emit("admitted", time.monotonic() - t0)
+        return status, reply
 
     def ring_info(self) -> Dict:
         return {
@@ -1498,6 +1821,22 @@ class ShardRouter(EventEmitter):
         handle = self._workers.get(shard_id)
         return handle.resolves_total() if handle is not None else 0
 
+    def sheds_total(self) -> Dict[str, int]:
+        """Deliberate rejects by reason, tier-wide: the router's own
+        (rate_limited) plus every worker slot's rollup, monotonic
+        across worker respawns (registrar_shed_total's source)."""
+        out = dict(self._sheds)
+        for handle in self._workers.values():
+            for reason, count in handle.sheds_total().items():
+                out[reason] += count
+        return out
+
+    def shard_queue_depth(self, shard_id: int) -> int:
+        """The shard worker's last-polled resolve dispatch backlog
+        (registrar_queue_depth's source)."""
+        handle = self._workers.get(shard_id)
+        return handle.queue_depth() if handle is not None else 0
+
     def shards_down(self) -> List[int]:
         return sorted(
             sid
@@ -1526,6 +1865,8 @@ class ShardRouter(EventEmitter):
                 "socket": handle.socket_path,
                 "respawns": handle.respawns,
                 "resolves_total": handle.resolves_total(),
+                "queue_depth": handle.queue_depth(),
+                "sheds": handle.sheds_total(),
                 "entries": st.get("entries", 0),
                 "warm": st.get("warm", 0),
                 "authoritative": st.get("authoritative", False),
@@ -1540,6 +1881,8 @@ class ShardRouter(EventEmitter):
                 "reshards": self.reshards,
                 "attachSpread": self.attach_spread,
                 "respawns_total": self.respawns_total(),
+                "overload": self.overload,
+                "sheds_total": self.sheds_total(),
             },
             "degraded": bool(down),
             "shards_down": down,
@@ -1637,6 +1980,19 @@ class ShardRouter(EventEmitter):
 # ---------------------------------------------------------------------------
 
 
+def _raise_reply_error(reply) -> None:
+    """Raise the client-side class for one STATUS_ERR reply: a SHED:
+    body (any hop's deliberate overload reject — the router forwards
+    worker error bodies verbatim) becomes :class:`ShardShedError` with
+    its reason; anything else stays plain :class:`ShardError`."""
+    reason = shed_reason(reply)
+    text = bytes(reply).decode("utf-8", "replace")
+    if reason is not None and reason in SHED_REASONS:
+        detail = text[len(SHED_PREFIX) + len(reason):].strip()
+        raise ShardShedError(reason, detail)
+    raise ShardError(text)
+
+
 class ShardClient:
     """Resolve through the router's front socket (the simple path: one
     connection, the router relays to owners)."""
@@ -1678,7 +2034,7 @@ class ShardClient:
             op, body, trace_ctx=trace_ctx
         )
         if status != STATUS_OK:
-            raise ShardError(bytes(reply).decode("utf-8", "replace"))
+            _raise_reply_error(reply)
         return reply
 
     async def resolve(
@@ -1730,6 +2086,11 @@ class ShardDirectClient:
         self._ring: Optional[HashRing] = None
         self._chans: Dict[int, Channel] = {}
         self._sockets: Dict[int, str] = {}
+        #: serializes per-shard channel opens: N concurrent resolves
+        #: racing a cold (or dropped) channel must share ONE open — each
+        #: leaked loser would keep a live reader task forever (same
+        #: hazard ShardClient's _reopen_lock guards)
+        self._chan_locks: Dict[int, asyncio.Lock] = {}
 
     async def connect(self) -> "ShardDirectClient":
         await self.refresh()
@@ -1767,8 +2128,12 @@ class ShardDirectClient:
     async def channel(self, shard_id: int) -> Channel:
         chan = self._chans.get(shard_id)
         if chan is None or chan.closed:
-            chan = await Channel.open(self._sockets[shard_id])
-            self._chans[shard_id] = chan
+            lock = self._chan_locks.setdefault(shard_id, asyncio.Lock())
+            async with lock:
+                chan = self._chans.get(shard_id)
+                if chan is None or chan.closed:
+                    chan = await Channel.open(self._sockets[shard_id])
+                    self._chans[shard_id] = chan
         return chan
 
     async def resolve(
@@ -1785,7 +2150,7 @@ class ShardDirectClient:
             trace_ctx=trace.current_context(),
         )
         if status != STATUS_OK:
-            raise ShardError(bytes(reply).decode("utf-8", "replace"))
+            _raise_reply_error(reply)
         return decode_resolution(reply)
 
 

@@ -89,6 +89,8 @@ FAULT_IDS = (
     # sharded serve tier fault classes (ISSUE 12; need shards= > 0)
     "shard-kill",
     "reshard-wave",
+    # overload armor (ISSUE 17; need shards= > 0)
+    "overload-storm",
 )
 
 #: nines(1.0) would be infinite; the cap keeps a flawless short trace
@@ -460,6 +462,10 @@ class SLOHarness(EventEmitter):
         #: never-blips assertions diff snapshots of this)
         self.slice_errors: Dict[str, int] = {}
         self.shard_probes = 0
+        #: the serve tier's overload armor (ISSUE 17) — installed by
+        #: _start_shard_tier iff repair is on; None IS the detection
+        #: proof's lever (repair=False runs the same storm unarmored)
+        self.shard_overload: Optional[Dict[str, Any]] = None
 
         self.probes: List[Probe] = []
         self.faults: List[FaultEvent] = []
@@ -616,6 +622,25 @@ class SLOHarness(EventEmitter):
             self.slice_expected[name] = ip
             self.slice_errors[name] = 0
         self._shard_dir = tempfile.mkdtemp(prefix="sloshard")
+        # Overload armor (ISSUE 17), repair-gated like the respawn
+        # below: the armored run degrades under the storm scenario
+        # (bounded queues, explicit sheds, stale answers); the
+        # repair=False run faces the SAME seeded storm with every
+        # defense withheld — the collapse the detection proof measures.
+        # Sizing: per-conn inflight does the shedding (6 per storm
+        # connection) and the global depth is the backstop, sized so
+        # the router's own relay channel (the probes' path) never hits
+        # it; the rate limit is far above probe cadence on purpose —
+        # the storm drives workers directly, and a probe must never be
+        # the client that gets limited.
+        if self.repair:
+            self.shard_overload = {
+                "maxQueueDepth": 96,
+                "maxInflightPerConn": 6,
+                "clientRateLimit": 1000.0,
+                "coldFillConcurrency": 4,
+                "writeDeadlineS": 0.4,
+            }
         self.router = ShardRouter(
             self._zk_addresses(),
             self.n_shards,
@@ -634,6 +659,7 @@ class SLOHarness(EventEmitter):
             # tree — probe span → shard.relay → the owning worker's
             # resolve subtree — in the worst-outage report.
             worker_trace={"sampleRate": 1.0, "maxSpans": 4096},
+            overload=self.shard_overload,
         )
         self.router.tracer = self.tracer
         # With repair withheld, a crashed worker stays dead — the
@@ -885,12 +911,13 @@ class SLOHarness(EventEmitter):
             "partition-minority": self._scenario_partition_minority,
             "shard-kill": self._scenario_shard_kill,
             "reshard-wave": self._scenario_reshard_wave,
+            "overload-storm": self._scenario_overload_storm,
         }
         ensemble_only = {
             "leader-kill", "quorum-loss", "rolling-upgrade",
             "partition-minority",
         }
-        sharded_only = {"shard-kill", "reshard-wave"}
+        sharded_only = {"shard-kill", "reshard-wave", "overload-storm"}
         if fault_id not in methods:
             raise ValueError(f"unknown scenario {fault_id!r}")
         if fault_id in ensemble_only and self.ensemble is None:
@@ -1248,6 +1275,103 @@ class SLOHarness(EventEmitter):
                 f"reshard-wave was not zero-error: {blipped}"
             )
 
+    async def _scenario_overload_storm(
+        self,
+        storm_s: float = 1.5,
+        clients: int = 6,
+        pipeline: int = 36,
+    ) -> None:
+        """Seeded heavy-tailed storm far past the tier's capacity
+        (ISSUE 17): Zipf warm traffic, a flash crowd on one slice,
+        never-exists churn, malformed frames, and slow-loris/half-open
+        clients — all over the real direct-client paths, while the
+        probes keep flying.  With armor on (the overload config
+        _start_shard_tier installs iff repair) the tier must DEGRADE,
+        not collapse — asserted: every queue-depth sample stays under
+        the configured bound, no worker dies, the storm was actually
+        refused work (sheds > 0) and every refusal carried an explicit
+        shed reason with ZERO timeouts, and the write deadline cut the
+        slow-loris connections loose.  With repair=False the SAME seed
+        hits an unarmored tier and whatever happens to the probes is
+        the honest answer — the detection proof's collapse."""
+        from registrar_tpu.testing import workload
+
+        storm = workload.StormWorkload(
+            self.router.socket_path,
+            list(self.slice_expected),
+            # Derived from the harness seed: --prove-detection re-runs
+            # the SAME storm with the armor withheld.
+            seed=(self.seed ^ 0x17AC0CE) & 0xFFFFFFFF,
+            duration_s=storm_s,
+            clients=clients,
+            pipeline=pipeline,
+            loris_frames=12000,
+        )
+        event = self.inject("overload-storm")
+        if not self.repair:
+            # Unarmored: no admission control, no bounds, no deadline.
+            # The storm's queued cold fills and pinned handler tasks
+            # outlive the storm window itself; nothing here recovers
+            # deliberately, so the event is never cleared.
+            await storm.run()
+            return
+        respawns_before = self.router.respawns_total()
+        bound = self.shard_overload["maxQueueDepth"]
+        peak_depth = 0
+        stop_sampling = asyncio.Event()
+
+        async def sample_depth() -> None:
+            # Rides OP_STATUS — satellite 2's priority lane, exercised
+            # live: the sampler must keep answering while resolves shed.
+            nonlocal peak_depth
+            while not stop_sampling.is_set():
+                status = await self.router.status()
+                for entry in status["shards"].values():
+                    peak_depth = max(
+                        peak_depth, int(entry.get("queue_depth") or 0)
+                    )
+                try:
+                    await asyncio.wait_for(stop_sampling.wait(), 0.15)
+                except asyncio.TimeoutError:
+                    pass
+
+        sampler = asyncio.get_running_loop().create_task(sample_depth())
+        try:
+            report = await storm.run()
+        finally:
+            stop_sampling.set()
+            await sampler
+        self.clear(event)
+        await self.wait_healthy()
+        problems = []
+        if report.sheds_total == 0:
+            problems.append("the storm never overloaded the tier (0 sheds)")
+        if report.timeouts_total:
+            problems.append(
+                f"{report.timeouts_total} storm requests timed out — a "
+                "shed must be an explicit fast refusal, never silence"
+            )
+        if peak_depth > bound:
+            problems.append(
+                f"queue depth {peak_depth} exceeded the configured "
+                f"bound {bound}"
+            )
+        if self.router.respawns_total() != respawns_before:
+            problems.append("a worker died under the storm")
+        if report.loris["conns"] and not report.loris["disconnected"]:
+            problems.append(
+                "no slow-loris client was disconnected (write-deadline "
+                "armor never engaged)"
+            )
+        if problems:
+            raise RuntimeError(
+                "overload-storm armor failed: " + "; ".join(problems)
+            )
+        log.info(
+            "overload-storm envelope: peak_depth=%d %s",
+            peak_depth, report.summary(),
+        )
+
     # -- the report ---------------------------------------------------------
 
     async def settle(self, seconds: float = 0.2) -> None:
@@ -1456,6 +1580,7 @@ TRACES: Dict[str, Dict[str, Any]] = {
             ("quorum-loss", {"hold_s": 0.4}),
             ("shard-kill", {"kills": 1}),
             ("reshard-wave", {"hold_s": 0.15}),
+            ("overload-storm", {"storm_s": 1.5}),
         ),
     },
     "full": {
@@ -1478,6 +1603,7 @@ TRACES: Dict[str, Dict[str, Any]] = {
             ("quorum-loss", {"hold_s": 0.8}),
             ("shard-kill", {"kills": 2}),
             ("reshard-wave", {"hold_s": 0.3}),
+            ("overload-storm", {"storm_s": 2.0, "clients": 8}),
             ("deploy-wave", {"wave": 6, "down_s": 0.15}),
             ("expiry-storm", {"victims": 5, "restart_delay": 0.2}),
         ),

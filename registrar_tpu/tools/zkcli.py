@@ -816,6 +816,25 @@ async def _cmd_status(args) -> int:
                 f"respawns={info.get('respawns')}",
                 file=sys.stderr,
             )
+            # Overload armor at a glance (ISSUE 17): the live dispatch
+            # backlog plus deliberate rejects by reason — the runbook's
+            # shed-reason taxonomy, one line per shard.
+            sheds = {
+                reason: count
+                for reason, count in (info.get("sheds") or {}).items()
+                if count
+            }
+            shed_bits = (
+                " ".join(f"{r}={n}" for r, n in sorted(sheds.items()))
+                if sheds
+                else "none"
+            )
+            print(
+                f"zkcli: status: shard {sid} "
+                f"queueDepth={info.get('queue_depth', 0)} "
+                f"sheds: {shed_bits}",
+                file=sys.stderr,
+            )
         problems = []
         for sid in snapshot.get("shards_down") or []:
             problems.append(f"shard {sid} down")
@@ -1234,6 +1253,14 @@ async def _cmd_serve_sharded(args) -> int:
                 "slowSpanMs": obs.slow_span_ms,
             }
             if obs is not None
+            else None
+        ),
+        # Overload armor (ISSUE 17): admission bounds + shed policy
+        # from config.serve.overload.  Absent block: None — not a knob
+        # set anywhere, byte-identical to the unarmored tier.
+        overload=(
+            cfg.serve.overload.as_router_kwargs()
+            if cfg.serve.overload is not None
             else None
         ),
     )

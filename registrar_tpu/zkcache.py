@@ -71,6 +71,16 @@ _DATA_EVENTS = (EventType.NODE_DATA_CHANGED, EventType.NODE_CREATED)
 DEFAULT_MAX_ENTRIES = 4096
 
 
+class CacheOverloadError(Exception):
+    """A cold fill was load-shed: ``fill_concurrency`` distinct-path
+    fills were already in flight (ISSUE 17).  Deliberate and immediate —
+    never a timeout — so the serve tier above can degrade (serve a
+    bounded-age stale answer, or fail fast with an explicit shed
+    reason) instead of queueing into collapse.  Joiners of an
+    ALREADY-in-flight fill are never shed: single-flight sharing is the
+    cheap case the bound exists to protect."""
+
+
 class _Entry:
     """One cached node.  ``data is None`` ⇒ negative (node absent, an
     exists-watch is armed); ``children is None`` ⇒ children unknown (the
@@ -103,12 +113,25 @@ class ZKCache(EventEmitter):
     Not thread-safe (asyncio single-loop, like the client itself).
     """
 
-    def __init__(self, zk: ZKClient, max_entries: int = DEFAULT_MAX_ENTRIES):
+    def __init__(
+        self,
+        zk: ZKClient,
+        max_entries: int = DEFAULT_MAX_ENTRIES,
+        fill_concurrency: Optional[int] = None,
+    ):
         super().__init__()
         if max_entries < 1:
             raise ValueError("max_entries must be >= 1")
+        if fill_concurrency is not None and fill_concurrency < 0:
+            raise ValueError("fill_concurrency must be >= 0")
         self._zk = zk
         self.max_entries = max_entries
+        #: cold-fill stampede bound (ISSUE 17): at most this many
+        #: DISTINCT-path read_node fills in flight at once; the next
+        #: would-be fill LEADER raises :class:`CacheOverloadError`
+        #: instead of queueing (joiners always share).  None = unbounded,
+        #: the pre-armor behavior.
+        self.fill_concurrency = fill_concurrency
         #: insertion-ordered entry map (dict order drives eviction)
         self._entries: Dict[str, _Entry] = {}
         #: per-path invalidation generation, reset by clear() via _epoch
@@ -149,6 +172,7 @@ class ZKCache(EventEmitter):
             "coherence_lag_ms_last": 0.0,
             "coherence_lag_ms_total": 0.0,
             "coherence_lag_count": 0,
+            "fill_sheds": 0,
         }
         self._was_authoritative = self.authoritative
         zk.on("close", self._on_close)
@@ -412,6 +436,19 @@ class ZKCache(EventEmitter):
                 if fut.cancelled():
                     continue  # leader died; take over
                 raise
+        if (
+            self.fill_concurrency is not None
+            and len(self._inflight) >= self.fill_concurrency
+        ):
+            # Cold-fill stampede shed (class CacheOverloadError): this
+            # would be a NEW fill leader beyond the bound.  Checked
+            # after the join loop on purpose — a request for a path
+            # already being filled rides the existing future for free.
+            self.stats["fill_sheds"] += 1
+            raise CacheOverloadError(
+                f"cold-fill concurrency bound reached "
+                f"({len(self._inflight)} >= {self.fill_concurrency})"
+            )
         fut = asyncio.get_running_loop().create_future()
         self._inflight[path] = fut
         try:

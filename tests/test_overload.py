@@ -1,0 +1,672 @@
+"""Overload armor (ISSUE 17): admission control, bounded backlogs,
+fast-fail shedding, slow-client disconnects, cold-fill stampede
+behavior, the `serve.overload` config block, and the seeded workload
+generator the storm benches ride.
+
+The in-process worker tests stall the resolve path behind an event so
+the backlog shapes are deterministic facts, not races: the pre-armor
+unbounded-growth regression and each bound's shed behavior are asserted
+at exact counts.
+"""
+
+import asyncio
+import struct
+
+import pytest
+
+from registrar_tpu.config import ConfigError, parse_config
+from registrar_tpu.registration import register
+from registrar_tpu.shard import (
+    OP_RESOLVE,
+    OP_STATUS,
+    STATUS_ERR,
+    STATUS_OK,
+    Channel,
+    ShardClient,
+    ShardRouter,
+    ShardShedError,
+    ShardWorker,
+    decode_resolution,
+    pack_resolve,
+)
+from registrar_tpu.testing import workload
+from registrar_tpu.testing.server import ZKServer
+from registrar_tpu.zk.client import ZKClient
+from registrar_tpu.zkcache import CacheOverloadError, ZKCache
+
+
+REG = {
+    "domain": "one.overload.joyent.us",
+    "type": "load_balancer",
+    "service": {
+        "type": "service",
+        "service": {"srvce": "_http", "proto": "_tcp", "port": 80},
+    },
+}
+
+
+def _worker_spec(server, path, **armor):
+    spec = {
+        "socket": path,
+        "shard": 0,
+        "shards": 1,
+        "servers": [[server.host, server.port]],
+        "timeoutMs": 4000,
+    }
+    spec.update(armor)
+    return spec
+
+
+async def _stalled_worker(server, tmp_path, **armor):
+    """A started worker whose resolve path parks on a gate event —
+    admission accounting runs (it lives outside ``_resolve``), but no
+    admitted resolve completes until the gate opens."""
+    worker = ShardWorker(_worker_spec(server, str(tmp_path / "w.sock"), **armor))
+    await worker.start()
+    gate = asyncio.Event()
+
+    async def stalled_resolve(body):
+        await gate.wait()
+        return b"{}"
+
+    worker._resolve = stalled_resolve
+    return worker, gate
+
+
+async def _wait_for(predicate, timeout=5.0, message="condition"):
+    deadline = asyncio.get_running_loop().time() + timeout
+    while not predicate():
+        assert asyncio.get_running_loop().time() < deadline, message
+        await asyncio.sleep(0.005)
+
+
+# ---------------------------------------------------------------------------
+# Satellite 1: the unbounded dispatch backlog, before and after bounds
+# ---------------------------------------------------------------------------
+
+
+async def test_unarmored_backlog_grows_without_bound(tmp_path):
+    """The pre-armor regression shape: with no `serve.overload` knobs a
+    single pipelining connection grows the worker's dispatch backlog
+    arbitrarily — every frame is admitted, every admitted frame is a
+    live task holding an in-flight slot.  This is the collapse mode the
+    armor exists to remove (and the parity contract: config absent =
+    exactly this behavior)."""
+    server = await ZKServer().start()
+    worker = chan = None
+    try:
+        worker, gate = await _stalled_worker(server, tmp_path)
+        chan = await Channel.open(worker.socket_path)
+        futs = [
+            asyncio.ensure_future(
+                chan.request(OP_RESOLVE, pack_resolve(REG["domain"], "A"))
+            )
+            for _ in range(40)
+        ]
+        # Unbounded admission: the backlog tracks the offered load 1:1.
+        await _wait_for(
+            lambda: worker.queue_depth == 40,
+            message="backlog never reached the offered 40",
+        )
+        assert worker.sheds["queue_full"] == 0
+        gate.set()
+        replies = await asyncio.gather(*futs)
+        assert all(status == STATUS_OK for status, _ in replies)
+        assert worker.queue_depth == 0
+    finally:
+        if chan is not None:
+            await chan.close()
+        if worker is not None:
+            await worker.close()
+        await server.stop()
+
+
+async def test_per_connection_inflight_bound_sheds_fast(tmp_path):
+    """maxInflightPerConn: excess pipelined resolves on one connection
+    are refused inline from the read loop — the shed replies resolve
+    while the admitted ones are still stalled (fast-fail, never a
+    timeout), the backlog is pinned at the bound, and the in-flight
+    accounting unwinds to zero."""
+    server = await ZKServer().start()
+    worker = chan = None
+    try:
+        worker, gate = await _stalled_worker(
+            server, tmp_path, maxInflightPerConn=4
+        )
+        chan = await Channel.open(worker.socket_path)
+        futs = [
+            asyncio.ensure_future(
+                chan.request(OP_RESOLVE, pack_resolve(REG["domain"], "A"))
+            )
+            for _ in range(40)
+        ]
+        await _wait_for(lambda: worker.sheds["queue_full"] == 36)
+        assert worker.queue_depth == 4  # pinned at the bound, not 40
+        # The 36 sheds answered ALREADY — the gate is still closed, so
+        # anything resolved by now traveled the reject path, not the
+        # resolve path.
+        done, _pending = await asyncio.wait(futs, timeout=2.0)
+        assert len(done) == 36
+        for fut in done:
+            status, body = fut.result()
+            assert status == STATUS_ERR
+            assert bytes(body).startswith(b"SHED:queue_full")
+        gate.set()
+        replies = await asyncio.gather(*futs)
+        assert sum(1 for status, _ in replies if status == STATUS_OK) == 4
+        assert worker.queue_depth == 0
+        assert worker.status()["overload"]["sheds"]["queue_full"] == 36
+    finally:
+        if chan is not None:
+            await chan.close()
+        if worker is not None:
+            await worker.close()
+        await server.stop()
+
+
+async def test_global_queue_depth_bound_across_connections(tmp_path):
+    """maxQueueDepth bounds the whole worker's backlog: two connections
+    each below their per-conn allowance still cannot push the dispatch
+    backlog past the global bound."""
+    server = await ZKServer().start()
+    worker = None
+    chans = []
+    try:
+        worker, gate = await _stalled_worker(server, tmp_path, maxQueueDepth=6)
+        chans = [
+            await Channel.open(worker.socket_path),
+            await Channel.open(worker.socket_path),
+        ]
+        futs = [
+            asyncio.ensure_future(
+                chan.request(OP_RESOLVE, pack_resolve(REG["domain"], "A"))
+            )
+            for chan in chans
+            for _ in range(10)
+        ]
+        await _wait_for(lambda: worker.sheds["queue_full"] == 14)
+        assert worker.queue_depth == 6
+        gate.set()
+        replies = await asyncio.gather(*futs)
+        assert sum(1 for status, _ in replies if status == STATUS_OK) == 6
+        assert worker.queue_depth == 0
+    finally:
+        for chan in chans:
+            await chan.close()
+        if worker is not None:
+            await worker.close()
+        await server.stop()
+
+
+# ---------------------------------------------------------------------------
+# Satellite 2: the control-op priority lane
+# ---------------------------------------------------------------------------
+
+
+async def test_status_priority_lane_answers_while_resolves_shed(tmp_path):
+    """OP_STATUS skips admission entirely: with the resolve backlog
+    saturated (every new resolve shedding), a status request on the
+    SAME stuffed connection answers promptly — supervision and `zkcli
+    status` stay alive mid-storm by construction."""
+    server = await ZKServer().start()
+    worker = chan = None
+    try:
+        worker, gate = await _stalled_worker(
+            server, tmp_path, maxInflightPerConn=2
+        )
+        chan = await Channel.open(worker.socket_path)
+        futs = [
+            asyncio.ensure_future(
+                chan.request(OP_RESOLVE, pack_resolve(REG["domain"], "A"))
+            )
+            for _ in range(8)
+        ]
+        await _wait_for(lambda: worker.sheds["queue_full"] == 6)
+        status, body = await asyncio.wait_for(
+            chan.request(OP_STATUS, b""), timeout=2.0
+        )
+        assert status == STATUS_OK
+        import json
+
+        st = json.loads(bytes(body).decode())
+        assert st["overload"]["queue_depth"] == 2
+        assert st["overload"]["max_inflight_per_conn"] == 2
+        assert st["overload"]["sheds"]["queue_full"] == 6
+        gate.set()
+        await asyncio.gather(*futs)
+    finally:
+        if chan is not None:
+            await chan.close()
+        if worker is not None:
+            await worker.close()
+        await server.stop()
+
+
+# ---------------------------------------------------------------------------
+# Satellite 3: hostile clients — slow-loris and half-open
+# ---------------------------------------------------------------------------
+
+
+async def test_write_deadline_disconnects_slow_reader_without_leak(tmp_path):
+    """A peer that stops reading is aborted at writeDeadlineS: the shed
+    is counted as slow_client, the parked handler's in-flight slot
+    unwinds (no leak), and the worker keeps answering everyone else."""
+    server = await ZKServer().start()
+    worker = chan = None
+    reader = writer = None
+    try:
+        worker = ShardWorker(
+            _worker_spec(
+                server, str(tmp_path / "w.sock"), writeDeadlineS=0.3
+            )
+        )
+        await worker.start()
+
+        # A reply big enough that drain() must wait on the non-reading
+        # peer (unix-socket kernel buffer + the transport's high-water
+        # mark are both far below this).
+        async def big_resolve(body):
+            return b"x" * 600_000
+
+        worker._resolve = big_resolve
+        reader, writer = await workload._open_raw(
+            worker.socket_path, rcvbuf=4096
+        )
+        from registrar_tpu.shard import pack_request
+
+        writer.write(pack_request(7, OP_RESOLVE, pack_resolve(REG["domain"])))
+        await writer.drain()
+        # ...and never read.  The armor must fire and unwind the slot.
+        await _wait_for(
+            lambda: worker.sheds["slow_client"] >= 1,
+            message="write deadline never fired",
+        )
+        await _wait_for(
+            lambda: worker.queue_depth == 0,
+            message="in-flight slot leaked past the abort",
+        )
+        # Our side observes the disconnect (EOF or reset).
+        try:
+            data = await asyncio.wait_for(reader.read(), timeout=5.0)
+        except (ConnectionError, OSError):
+            data = b""
+        assert isinstance(data, bytes)
+        # The worker is not wedged: a well-behaved client still resolves.
+        del worker._resolve  # restore the class's resolve path
+        chan = await Channel.open(worker.socket_path)
+        status, body = await chan.request(
+            OP_RESOLVE, pack_resolve("absent.overload.joyent.us", "A")
+        )
+        assert status == STATUS_OK
+        assert decode_resolution(body).answers == []
+    finally:
+        if writer is not None:
+            writer.close()
+        if chan is not None:
+            await chan.close()
+        if worker is not None:
+            await worker.close()
+        await server.stop()
+
+
+async def test_half_open_client_holds_no_slot_and_wedges_nothing(tmp_path):
+    """workload.half_open promises a frame that never arrives: the read
+    loop waits it out without admitting anything, the eventual close is
+    a clean EOF, and concurrent well-behaved traffic never notices."""
+    server = await ZKServer().start()
+    client = await ZKClient([server.address]).connect()
+    worker = chan = None
+    try:
+        await register(
+            client, REG, admin_ip="10.6.0.1", hostname="h1", settle_delay=0
+        )
+        worker = ShardWorker(
+            _worker_spec(
+                server, str(tmp_path / "w.sock"),
+                maxInflightPerConn=4, maxQueueDepth=8,
+            )
+        )
+        await worker.start()
+        chan = await Channel.open(worker.socket_path)
+        half = asyncio.ensure_future(
+            workload.half_open(worker.socket_path, hold_s=0.3)
+        )
+        for _ in range(5):
+            status, body = await chan.request(
+                OP_RESOLVE, pack_resolve(REG["domain"], "A")
+            )
+            assert status == STATUS_OK
+        await half
+        assert worker.queue_depth == 0
+        assert all(n == 0 for n in worker.sheds.values())
+    finally:
+        if chan is not None:
+            await chan.close()
+        if worker is not None:
+            await worker.close()
+        await client.close()
+        await server.stop()
+
+
+# ---------------------------------------------------------------------------
+# The router's per-client token bucket
+# ---------------------------------------------------------------------------
+
+
+async def test_router_rate_limit_sheds_rate_limited(tmp_path):
+    """clientRateLimit at the router front socket: a client bursting
+    past its bucket gets ShardShedError("rate_limited") — classified
+    client-side from the SHED: body — and the router's shed rollup
+    counts it; a sibling connection's bucket is untouched."""
+    server = await ZKServer().start()
+    client = await ZKClient([server.address]).connect()
+    router = sc = sc2 = None
+    try:
+        await register(
+            client, REG, admin_ip="10.6.0.1", hostname="h1", settle_delay=0
+        )
+        router = await ShardRouter(
+            [server.address], 1, str(tmp_path / "rate.sock"),
+            attach_spread="any", overload={"clientRateLimit": 3.0},
+        ).start()
+        sc = await ShardClient(router.socket_path).connect()
+        outcomes = []
+        for _ in range(8):
+            try:
+                res = await sc.resolve(REG["domain"], "A")
+                outcomes.append(("ok", res))
+            except ShardShedError as err:
+                outcomes.append(("shed", err.reason))
+        oks = [o for o in outcomes if o[0] == "ok"]
+        sheds = [o for o in outcomes if o[0] == "shed"]
+        assert len(oks) == 3  # burst == rate
+        assert len(sheds) == 5
+        assert all(reason == "rate_limited" for _tag, reason in sheds)
+        assert router.sheds_total()["rate_limited"] >= 5
+        # A fresh connection has its own bucket.
+        sc2 = await ShardClient(router.socket_path).connect()
+        res = await sc2.resolve(REG["domain"], "A")
+        assert res.answers
+        # ...and a drained bucket refills with time.
+        await asyncio.sleep(0.5)
+        res = await sc.resolve(REG["domain"], "A")
+        assert res.answers
+    finally:
+        for c in (sc, sc2):
+            if c is not None:
+                await c.close()
+        if router is not None:
+            await router.stop()
+        await client.close()
+        await server.stop()
+
+
+# ---------------------------------------------------------------------------
+# Cold-fill stampedes: single-flight, bounded leaders, stale-over-collapse
+# ---------------------------------------------------------------------------
+
+
+async def test_cache_cold_fill_bound_sheds_new_leaders_only(tmp_path):
+    """ZKCache.fill_concurrency bounds NEW fill leaders; a request for a
+    path already being filled joins the in-flight future for free (the
+    single-flight guarantee is exactly why the bound is safe)."""
+    server = await ZKServer().start()
+    client = await ZKClient([server.address]).connect()
+    cache = None
+    try:
+        cache = ZKCache(client, fill_concurrency=1)
+        # Occupy the one fill slot with a pending in-flight future.
+        fut = asyncio.get_running_loop().create_future()
+        cache._inflight["/held"] = fut
+        # A distinct-path cold read would be a SECOND leader: shed.
+        with pytest.raises(CacheOverloadError):
+            await cache.read_node("/other")
+        assert cache.stats["fill_sheds"] == 1
+        # A same-path read JOINS the in-flight fill — no shed.
+        joiner = asyncio.ensure_future(cache._fill_node("/held"))
+        await asyncio.sleep(0.01)
+        assert not joiner.done()
+        fut.set_result(None)
+        assert await joiner is None
+        assert cache.stats["fill_sheds"] == 1
+    finally:
+        if cache is not None:
+            cache.close()
+        await client.close()
+        await server.stop()
+
+
+async def test_worker_serves_stale_over_cold_fill_collapse(tmp_path):
+    """A warm domain whose cache entry was churned out answers its
+    bounded-age last-known-good bytes when the fill path sheds; a
+    genuinely cold domain fails fast with SHED:cold_fill_shed."""
+    server = await ZKServer().start()
+    client = await ZKClient([server.address]).connect()
+    worker = chan = None
+    try:
+        await register(
+            client, REG, admin_ip="10.6.0.1", hostname="h1", settle_delay=0
+        )
+        worker = ShardWorker(_worker_spec(server, str(tmp_path / "w.sock")))
+        await worker.start()
+        chan = await Channel.open(worker.socket_path)
+        status, body = await chan.request(
+            OP_RESOLVE, pack_resolve(REG["domain"], "A")
+        )
+        assert status == STATUS_OK
+        warm_answer = bytes(body)
+
+        # Swap in a cold cache that sheds EVERY new fill leader: the
+        # stampede shape without the stampede.
+        old = worker.cache
+        worker.cache = ZKCache(client, fill_concurrency=0)
+        old.close()
+
+        status, body = await chan.request(
+            OP_RESOLVE, pack_resolve(REG["domain"], "A")
+        )
+        assert status == STATUS_OK
+        assert bytes(body) == warm_answer  # stale-over-collapse
+        assert worker.stale_serves == 1
+        assert worker.sheds["cold_fill_shed"] == 1
+
+        status, body = await chan.request(
+            OP_RESOLVE, pack_resolve("never.overload.joyent.us", "A")
+        )
+        assert status == STATUS_ERR
+        assert bytes(body).startswith(b"SHED:cold_fill_shed")
+        assert worker.sheds["cold_fill_shed"] == 2
+    finally:
+        if chan is not None:
+            await chan.close()
+        if worker is not None:
+            await worker.close()
+        await client.close()
+        await server.stop()
+
+
+# ---------------------------------------------------------------------------
+# Satellite 5 (docs/CONFIG.md contract): the serve.overload block
+# ---------------------------------------------------------------------------
+
+
+def _serve_cfg(overload=None):
+    cfg = {
+        "registration": {"domain": "d.example.us", "type": "host"},
+        "zookeeper": {"servers": [{"host": "h", "port": 1}]},
+        "serve": {"shards": 2, "socketPath": "/tmp/s.sock"},
+    }
+    if overload is not None:
+        cfg["serve"]["overload"] = overload
+    return cfg
+
+
+class TestOverloadConfig:
+    def test_absent_block_is_none(self):
+        assert parse_config(_serve_cfg()).serve.overload is None
+
+    def test_full_block_round_trips_to_router_kwargs(self):
+        cfg = parse_config(
+            _serve_cfg(
+                {
+                    "maxQueueDepth": 96,
+                    "maxInflightPerConn": 6,
+                    "clientRateLimit": 1000,
+                    "coldFillConcurrency": 4,
+                    "writeDeadlineS": 0.4,
+                }
+            )
+        )
+        ov = cfg.serve.overload
+        assert ov.max_queue_depth == 96
+        assert ov.max_inflight_per_conn == 6
+        assert ov.client_rate_limit == 1000.0
+        assert ov.cold_fill_concurrency == 4
+        assert ov.write_deadline_s == 0.4
+        assert ov.as_router_kwargs() == {
+            "maxQueueDepth": 96,
+            "maxInflightPerConn": 6,
+            "clientRateLimit": 1000.0,
+            "coldFillConcurrency": 4,
+            "writeDeadlineS": 0.4,
+        }
+
+    def test_partial_block_drops_absent_knobs(self):
+        cfg = parse_config(_serve_cfg({"maxQueueDepth": 10}))
+        assert cfg.serve.overload.as_router_kwargs() == {"maxQueueDepth": 10}
+
+    @pytest.mark.parametrize(
+        "block",
+        [
+            {"maxQueueDepth": 0},
+            {"maxQueueDepth": -1},
+            {"maxQueueDepth": "many"},
+            {"maxInflightPerConn": 1.5},
+            {"clientRateLimit": 0},
+            {"clientRateLimit": "fast"},
+            {"coldFillConcurrency": -2},
+            {"writeDeadlineS": 0},
+            "not-an-object",
+        ],
+    )
+    def test_invalid_values_are_config_errors(self, block):
+        with pytest.raises(ConfigError):
+            parse_config(_serve_cfg(block))
+
+
+# ---------------------------------------------------------------------------
+# The workload generator itself
+# ---------------------------------------------------------------------------
+
+
+class TestWorkload:
+    def test_zipf_weights_are_heavy_tailed(self):
+        w = workload.zipf_weights(16)
+        assert len(w) == 16
+        assert w[0] > w[1] > w[-1] > 0  # strictly rank-decreasing
+        # heavier s = heavier head relative to the tail
+        heavy = workload.zipf_weights(16, s=2.0)
+        assert heavy[0] / heavy[-1] > w[0] / w[-1]
+
+    def test_zipf_picker_is_seed_deterministic(self):
+        import random
+
+        names = [f"n{i}.x.us" for i in range(12)]
+        picker = workload._ZipfPicker(names)
+        rng_a, rng_b = random.Random(7), random.Random(7)
+        draws_a = [picker.pick(rng_a) for _ in range(20)]
+        draws_b = [picker.pick(rng_b) for _ in range(20)]
+        assert draws_a == draws_b
+        assert set(draws_a) <= set(names)
+
+    def test_malformed_frames_keep_valid_length_prefixes(self):
+        import random
+
+        frames = workload.malformed_resolve_frames(random.Random(3), 32)
+        assert len(frames) == 32
+        for frame in frames:
+            (size,) = struct.unpack(">I", frame[:4])
+            assert size == len(frame) - 4  # poisons the request, not
+            assert size >= 5  # the connection
+
+    def test_storm_report_summary_shape(self):
+        report = workload.StormReport(seed=42)
+        report.sent["warm"] = 10
+        report.ok["warm"] = 8
+        report.record_shed("queue_full", 0.001)
+        report.admitted_warm_s.extend([0.002, 0.003])
+        report.duration_s = 1.0
+        summary = report.summary()
+        assert summary["seed"] == 42
+        assert summary["sheds"]["queue_full"] == 1
+        assert summary["sheds_total"] == 1
+        assert summary["timeouts_total"] == 0
+        assert summary["admitted_warm_p99_ms"] is not None
+        assert summary["shed_fastfail_p99_ms"] is not None
+
+
+async def test_storm_against_armored_tier_sheds_and_never_times_out(tmp_path):
+    """A small seeded storm end-to-end against a deliberately tight
+    armored tier: overload is guaranteed (pipeline 8 against an
+    in-flight bound of 1), every excess request sheds fast, and no
+    admitted request times out — the ISSUE's core acceptance shape at
+    unit-test scale."""
+    server = await ZKServer().start()
+    client = await ZKClient([server.address]).connect()
+    router = None
+    try:
+        domains = []
+        for i in range(4):
+            reg = {
+                "domain": f"svc{i}.storm.overload.joyent.us",
+                "type": "load_balancer",
+                "service": {
+                    "type": "service",
+                    "service": {
+                        "srvce": "_http", "proto": "_tcp", "port": 80,
+                    },
+                },
+            }
+            await register(
+                client, reg, admin_ip=f"10.7.0.{i}", hostname="h0",
+                settle_delay=0,
+            )
+            domains.append(reg["domain"])
+        router = await ShardRouter(
+            [server.address], 2, str(tmp_path / "storm.sock"),
+            attach_spread="any",
+            overload={
+                "maxQueueDepth": 8,
+                "maxInflightPerConn": 1,
+                "coldFillConcurrency": 2,
+                "writeDeadlineS": 0.5,
+            },
+        ).start()
+        async with ShardClient(router.socket_path) as sc:
+            for dom in domains:
+                res = await sc.resolve(dom, "A")
+                assert res.answers
+
+        storm = workload.StormWorkload(
+            router.socket_path, domains, seed=99,
+            duration_s=0.6, clients=3, pipeline=8,
+            loris_conns=1, loris_frames=200,
+            half_open_conns=1, malformed_frames=8,
+        )
+        report = await storm.run()
+        assert report.sent_total > 0
+        assert report.ok["warm"] + report.ok["flash"] > 0
+        assert report.sheds_total > 0  # pipeline 8 vs in-flight bound 1
+        assert set(report.sheds) <= {
+            "queue_full", "rate_limited", "cold_fill_shed", "slow_client"
+        }
+        assert report.timeouts_total == 0  # sheds never look like timeouts
+        assert report.half_open["held"] >= 1
+        summary = report.summary()
+        assert summary["seed"] == 99
+        assert summary["shed_fastfail_p99_ms"] is not None
+    finally:
+        if router is not None:
+            await router.stop()
+        await client.close()
+        await server.stop()
