@@ -7,7 +7,7 @@ NAME = registrar
 RELEASE_TARBALL = $(NAME)-release.tar.gz
 RELSTAGEDIR = /tmp/$(NAME)-release
 
-.PHONY: all check check-core test test-jax chaos restart-e2e bench bench-cached bench-sharded overload-quick profile slo slo-quick release publish clean
+.PHONY: all check check-core test test-jax chaos restart-e2e bench bench-cached bench-sharded overload-quick dns-quick profile slo slo-quick release publish clean
 
 all: check test
 
@@ -34,7 +34,8 @@ check-core:
 	    registrar_tpu.testing.server, registrar_tpu.testing.netem, \
 	    registrar_tpu.config, \
 	    registrar_tpu.tools.zkcli, registrar_tpu.binderview, \
-	    registrar_tpu.zkcache, registrar_tpu.metrics, registrar_tpu.shard"
+	    registrar_tpu.zkcache, registrar_tpu.metrics, registrar_tpu.shard, \
+	    registrar_tpu.dnsfront"
 
 # Hermetic suite: jax-marked tests are deselected via pyproject addopts,
 # because jax backend init can take minutes in some environments.  (In the
@@ -137,6 +138,19 @@ bench-sharded:
 overload-quick:
 	$(PYTHON) -m pytest tests/test_overload.py -x -q
 	$(PYTHON) bench.py --overload-only
+
+# DNS frontend slice (ISSUE 19): the golden wire suite (codec vectors,
+# truncation->TCP retry, NXDOMAIN/NODATA negatives, watch-coherent
+# encode cache incl. RFC 8767 serve-stale), then a seeded Zipf query
+# storm over real UDP sockets against a 4-shard SO_REUSEPORT tier —
+# asserting the >0.9 encode-cache hit ratio and (non-smoke) warm DNS
+# QPS within 25% of the unix-socket sharded path.  The storm seed is
+# printed in a replay line — BENCH_DNS_SEED=<seed> pins it — and echoed
+# into the CI chaos job's summary.  BENCH_SMOKE=1 drops to reduced
+# scale for shared cores.
+dns-quick:
+	$(PYTHON) -m pytest tests/test_dns_golden.py -x -q
+	$(PYTHON) bench.py --dns-only
 
 # Release tarball rooted at $(PREFIX) (the reference roots its tarball
 # at /opt/smartdc/registrar, Makefile:70-95).  The SMF manifest is

@@ -59,7 +59,7 @@ class Answer:
     """One DNS answer (shape mirrors dig output lines)."""
 
     name: str
-    rtype: str  # "A" | "SRV"
+    rtype: str  # "A" | "SRV" | "TXT"
     ttl: int
     #: A: the IPv4 address.  SRV: "<prio> <weight> <port> <target>".
     data: str
@@ -76,6 +76,16 @@ class Resolution:
     @property
     def empty(self) -> bool:
         return not self.answers
+
+    def to_wire_records(self):
+        """Render to DNS wire form: ``(answers, additionals)`` as
+        ``(name, type_code, ttl, rdata_bytes)`` tuples.  THE one RR
+        renderer — the dnsfront encode cache and ``zkcli dig`` both
+        come through here (the ``registration_payloads`` precedent:
+        one stable hook instead of two drifting copies)."""
+        from registrar_tpu import dnsfront
+
+        return dnsfront.wire_records(self)
 
 
 def _host_ttl(record: Dict[str, Any]) -> int:
@@ -247,15 +257,39 @@ async def resolve_srv(src, name: str) -> Resolution:
     return res
 
 
+async def resolve_txt(src, name: str) -> Resolution:
+    """Answer a TXT query for ``name``.
+
+    Rebuild extension (the reference Binder serves TXT from the same
+    records; our subset): a node that exists and parses answers one TXT
+    string ``registrar-type=<type>`` — the operator-facing "what kind
+    of record is actually behind this name" probe `zkcli dig -t TXT`
+    uses.  TTL follows the host chain (top-level ttl, else default).
+    """
+    name = name.rstrip(".").lower()
+    node = await src.read_node(domain_to_path(name))
+    res = Resolution()
+    if node is None:
+        return res
+    record = _record_from_bytes(node[0])
+    if record is None or not isinstance(record.get("type"), str):
+        return res
+    ttl = record["ttl"] if isinstance(record.get("ttl"), int) else DEFAULT_TTL
+    res.answers.append(
+        Answer(name, "TXT", ttl, f"registrar-type={record['type']}")
+    )
+    return res
+
+
 async def resolve(src, name: str, qtype: str = "A") -> Resolution:
-    """Resolve ``name`` for query type ``qtype`` ("A" or "SRV").
+    """Resolve ``name`` for query type ``qtype`` ("A", "SRV" or "TXT").
 
     ``src`` is the read source: a connected
     :class:`~registrar_tpu.zk.client.ZKClient` for live answers, or a
     :class:`~registrar_tpu.zkcache.ZKCache` for the in-memory hot path.
     """
     qtype = qtype.upper()
-    if qtype not in ("A", "SRV"):
+    if qtype not in ("A", "SRV", "TXT"):
         raise ValueError(f"unsupported query type: {qtype}")
     # source: "cached" only while a ZKCache is actually serving from
     # memory (a degraded cache falls through to live reads and is
@@ -270,4 +304,6 @@ async def resolve(src, name: str, qtype: str = "A") -> Resolution:
     ):
         if qtype == "A":
             return await resolve_a(src, name)
+        if qtype == "TXT":
+            return await resolve_txt(src, name)
         return await resolve_srv(src, name)

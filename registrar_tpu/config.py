@@ -193,6 +193,52 @@ class OverloadConfig:
 
 
 @dataclass
+class DnsConfig:
+    """The ``serve.dns`` block (ISSUE 19): the real-DNS frontend over
+    the sharded tier (:mod:`registrar_tpu.dnsfront`).  Presence of the
+    block turns it ON: every shard worker binds one SO_REUSEPORT UDP
+    socket (plus a TCP listener for TC-bit retries) on ``host:port``.
+    ``port: 0`` means the router allocates a free port once at start
+    (every worker must share it for the kernel fan-out).  Absent block
+    = no DNS sockets anywhere, the tier's behavior untouched.
+
+    ``udpPayloadMax``: EDNS answer-size ceiling we honor (default 1232).
+    ``negativeTtl``: NXDOMAIN/NODATA SOA-minimum TTL, seconds — defaults
+    to the cache's coherence bound (5 s), never believe an absence
+    longer than the tier itself would.
+    ``staleTtl``: how long (seconds) a front whose ZKCache lost
+    authority keeps answering from pre-rendered templates (RFC 8767
+    serve-stale, default 30); ``0`` fails closed — templates drop the
+    moment authority is lost.
+    ``maxPending`` / ``rateLimit``: the PR-17 armor mapped onto DNS —
+    pending cold-resolve bound and queries/second token bucket; over
+    either, the front answers REFUSED (never silence).  Warm
+    encode-cache hits bypass both."""
+
+    host: str = "127.0.0.1"
+    port: int = 0
+    udp_payload_max: Optional[int] = None
+    negative_ttl: Optional[float] = None
+    stale_ttl: Optional[float] = None
+    max_pending: Optional[int] = None
+    rate_limit: Optional[float] = None
+
+    def as_spec(self) -> Dict[str, Any]:
+        """The dict a worker spec carries as ``dns`` (spec-key
+        spelling, Nones dropped)."""
+        raw = {
+            "host": self.host,
+            "port": self.port,
+            "udpPayloadMax": self.udp_payload_max,
+            "negativeTtl": self.negative_ttl,
+            "staleTtl": self.stale_ttl,
+            "maxPending": self.max_pending,
+            "rateLimit": self.rate_limit,
+        }
+        return {k: v for k, v in raw.items() if v is not None}
+
+
+@dataclass
 class ServeConfig:
     """The ``serve`` block (ISSUE 12): the namespace-sharded resolve
     tier (:mod:`registrar_tpu.shard`), run standalone by ``zkcli
@@ -211,6 +257,7 @@ class ServeConfig:
     socket_path: str
     attach_spread: str = "spread"
     overload: Optional[OverloadConfig] = None
+    dns: Optional[DnsConfig] = None
 
 
 @dataclass
@@ -663,11 +710,90 @@ def parse_config(raw: Mapping[str, Any]) -> Config:
                     "writeDeadlineS", overload_raw.get("writeDeadlineS")
                 ),
             )
+        dns = None
+        dns_raw = serve_raw.get("dns")
+        if dns_raw is not None:
+            if not isinstance(dns_raw, Mapping):
+                raise ConfigError("config.serve.dns must be an object")
+            dns_host = dns_raw.get("host", "127.0.0.1")
+            if not isinstance(dns_host, str) or not dns_host:
+                raise ConfigError("config.serve.dns.host must be a string")
+            dns_port = dns_raw.get("port", 0)
+            if (
+                not isinstance(dns_port, int)
+                or isinstance(dns_port, bool)
+                or not 0 <= dns_port < 65536
+            ):
+                raise ConfigError(
+                    "config.serve.dns.port must be a port number "
+                    "(0 = allocate at start)"
+                )
+
+            def _dns_int(key: str, value) -> Optional[int]:
+                if value is None:
+                    return None
+                if (
+                    not isinstance(value, int)
+                    or isinstance(value, bool)
+                    or value < 1
+                ):
+                    raise ConfigError(
+                        f"config.serve.dns.{key} must be a positive integer"
+                    )
+                return value
+
+            def _dns_num(key: str, value) -> Optional[float]:
+                if value is None:
+                    return None
+                if (
+                    not isinstance(value, (int, float))
+                    or isinstance(value, bool)
+                    or value <= 0
+                ):
+                    raise ConfigError(
+                        f"config.serve.dns.{key} must be a positive number"
+                    )
+                return float(value)
+
+            udp_payload_max = _dns_int(
+                "udpPayloadMax", dns_raw.get("udpPayloadMax")
+            )
+            if udp_payload_max is not None and udp_payload_max < 512:
+                raise ConfigError(
+                    "config.serve.dns.udpPayloadMax must be >= 512 "
+                    "(the pre-EDNS UDP ceiling)"
+                )
+            stale_ttl = dns_raw.get("staleTtl")
+            if stale_ttl is not None:
+                # Unlike the other dns numbers, 0 is meaningful here:
+                # "no serve-stale window, fail closed on authority loss".
+                if (
+                    not isinstance(stale_ttl, (int, float))
+                    or isinstance(stale_ttl, bool)
+                    or stale_ttl < 0
+                ):
+                    raise ConfigError(
+                        "config.serve.dns.staleTtl must be a "
+                        "non-negative number"
+                    )
+                stale_ttl = float(stale_ttl)
+            dns = DnsConfig(
+                host=dns_host,
+                port=dns_port,
+                udp_payload_max=udp_payload_max,
+                negative_ttl=_dns_num(
+                    "negativeTtl", dns_raw.get("negativeTtl")
+                ),
+                stale_ttl=stale_ttl,
+                max_pending=_dns_int("maxPending", dns_raw.get("maxPending")),
+                rate_limit=_dns_num("rateLimit", dns_raw.get("rateLimit")),
+            )
         serve = ServeConfig(
             shards=shards,
             socket_path=socket_path,
             attach_spread=attach_spread,
             overload=overload,
+            dns=dns,
         )
 
     metrics = None

@@ -26,8 +26,8 @@ from typing import Callable, Dict, List
 
 #: One label value per decode surface: jute deserialization, the ZK
 #: client/server frame buffer, the ZK client handshake, the shard
-#: router/worker wire protocol.
-SURFACES = ("jute", "zk_framing", "zk_client", "shard")
+#: router/worker wire protocol, the DNS frontend's packet codec.
+SURFACES = ("jute", "zk_framing", "zk_client", "shard", "dns")
 
 _counts: Dict[str, int] = {surface: 0 for surface in SURFACES}
 _subscribers: List[Callable[[str], None]] = []

@@ -278,6 +278,30 @@ class Histogram(_Metric):
         counts[bisect.bisect_left(self.buckets, value)] += 1
         self._sums[key] = self._sums.get(key, 0.0) + value
 
+    def set_totals(
+        self,
+        counts: List[int],
+        total_sum: float,
+        labels: Optional[Dict[str, str]] = None,
+    ) -> None:
+        """Install polled cumulative per-bucket counts + sum for one
+        label set — the histogram counterpart of Counter.set_total, for
+        ladders whose truth lives in other processes (the shard router
+        polls each worker's DNS latency counts and banks a crashed
+        incarnation's).  ``counts`` is the non-cumulative per-bucket
+        list incl. the +Inf slot (short lists are zero-padded); same
+        monotonic guard — a stale lower snapshot is ignored rather than
+        rendered as a histogram going backwards."""
+        key = self._key(labels)
+        fresh = [int(c) for c in counts]
+        if len(fresh) > len(self.buckets) + 1:
+            raise ValueError("more bucket counts than bounds")
+        fresh.extend([0] * (len(self.buckets) + 1 - len(fresh)))
+        if sum(fresh) < sum(self._counts.get(key, ())):
+            return
+        self._counts[key] = fresh
+        self._sums[key] = max(float(total_sum), self._sums.get(key, 0.0))
+
     def count(self, labels: Optional[Dict[str, str]] = None) -> int:
         return sum(self._counts.get(self._key(labels), ()))
 
@@ -955,6 +979,52 @@ def instrument_shards(
         "the armor let through)",
     )
     admitted.preseed(None)
+    # DNS frontend rollup (ISSUE 19).  Families exist (pre-seeded)
+    # whether or not serve.dns is configured — an un-DNS'd tier
+    # legitimately reports zero queries, and alert rate()s need the
+    # zero series either way (the registry's parity stance).
+    from registrar_tpu.dnsfront import QTYPE_NAMES, SERVED_QTYPES
+
+    dns_queries = reg.counter(
+        "registrar_dns_queries_total",
+        "DNS queries answered at the SO_REUSEPORT frontend, by qtype "
+        "and rcode (rolled up from worker status polls; monotonic "
+        "across worker respawns)",
+    )
+    for qt in SERVED_QTYPES:
+        for rc in ("NOERROR", "NXDOMAIN", "REFUSED", "SERVFAIL"):
+            dns_queries.inc(
+                0, labels={"qtype": QTYPE_NAMES[qt], "rcode": rc}
+            )
+    dns_udp = reg.histogram(
+        "registrar_dns_udp_seconds",
+        "UDP DNS answer latency at the frontend (packet in to sendto), "
+        "aggregated across shard workers (Histogram.set_totals from "
+        "the polled per-worker ladders; monotonic across respawns)",
+    )
+    dns_udp.preseed(None)
+    dns_hits = reg.counter(
+        "registrar_dns_encode_cache_hits_total",
+        "Warm answer-encode-cache template hits (the memcpy-path "
+        "answers), tier-wide",
+    )
+    dns_hits.inc(0)
+    dns_misses = reg.counter(
+        "registrar_dns_encode_cache_misses_total",
+        "Answer-encode-cache misses (full resolve + RR render), "
+        "tier-wide",
+    )
+    dns_misses.inc(0)
+    dns_invalidations = reg.counter(
+        "registrar_dns_encode_cache_invalidations_total",
+        "Pre-rendered answer templates dropped by ZKCache watch "
+        "events (the coherence mechanism), tier-wide",
+    )
+    dns_invalidations.inc(0)
+    dns_entries = reg.gauge(
+        "registrar_dns_encode_cache_entries",
+        "Pre-rendered answer templates currently held, tier-wide",
+    )
     seeded: set = set()
 
     def seed(sid) -> None:
@@ -1007,6 +1077,27 @@ def instrument_shards(
         # across respawns, same contract as resolves).
         for reason, count in router.sheds_total().items():
             sheds.set_total(count, labels={"reason": reason})
+        # DNS surface rollup (ISSUE 19): the router folds every slot's
+        # banked + live front stats; the same monotonic contract.
+        rollup = (
+            router.dns_rollup() if hasattr(router, "dns_rollup") else None
+        )
+        if rollup:
+            for key, count in (rollup.get("queries") or {}).items():
+                qt, _, rc = key.partition(" ")
+                dns_queries.set_total(
+                    count, labels={"qtype": qt, "rcode": rc}
+                )
+            udp = rollup.get("udp") or {}
+            if udp.get("counts"):
+                dns_udp.set_totals(udp["counts"], udp.get("sum", 0.0))
+            cache_stats = rollup.get("encode_cache") or {}
+            dns_hits.set_total(cache_stats.get("hits", 0))
+            dns_misses.set_total(cache_stats.get("misses", 0))
+            dns_invalidations.set_total(
+                cache_stats.get("invalidations", 0)
+            )
+            dns_entries.set(float(cache_stats.get("entries", 0)))
 
     router.on("poll", on_poll)
     router.on("admitted", lambda seconds: admitted.observe(seconds))

@@ -116,7 +116,7 @@ import time
 from contextlib import nullcontext
 from typing import Dict, Iterable, List, Optional, Sequence, Tuple
 
-from registrar_tpu import binderview, malformed, trace, traceview
+from registrar_tpu import binderview, dnsfront, malformed, trace, traceview
 from registrar_tpu.binderview import Answer, Resolution
 from registrar_tpu.events import EventEmitter, spawn_owned
 from registrar_tpu.retry import RetryPolicy, is_transient
@@ -655,6 +655,12 @@ class ShardWorker:
         #: per-instance tracer override (ISSUE 13); None = the process
         #: default — the spawned worker installs one from spec["trace"]
         self.tracer = None
+        #: DNS frontend (ISSUE 19): spec["dns"] present = this worker
+        #: binds an SO_REUSEPORT UDP socket + TCP listener on the
+        #: shared host:port at start(); absent = no DNS, byte-identical
+        #: behavior to the pre-19 worker.
+        self.dns_spec = spec.get("dns")
+        self.dns: Optional[dnsfront.DnsFront] = None
 
     def _make_client(self) -> ZKClient:
         spec = self.spec
@@ -688,10 +694,50 @@ class ShardWorker:
         self._server = await asyncio.start_unix_server(
             self._on_connection, path=self.socket_path
         )
+        if self.dns_spec:
+            # The DNS presence (ISSUE 19): every worker binds the SAME
+            # host:port with SO_REUSEPORT — the kernel fans queries out
+            # across the sibling workers, and any worker answers any
+            # domain (the ring is a warmth hint, not a correctness
+            # boundary), so no router hop exists on this path at all.
+            self.dns = dnsfront.DnsFront(
+                self._dns_resolve,
+                host=self.dns_spec.get("host", "127.0.0.1"),
+                port=int(self.dns_spec.get("port") or 0),
+                source=self.cache,
+                udp_payload_max=int(
+                    self.dns_spec.get("udpPayloadMax")
+                    or dnsfront.DEFAULT_UDP_PAYLOAD_MAX
+                ),
+                negative_ttl=float(
+                    self.dns_spec.get("negativeTtl")
+                    or dnsfront.DEFAULT_NEGATIVE_TTL
+                ),
+                # `or`-defaulting would turn an explicit 0 (fail closed
+                # on authority loss) back into the 30 s default.
+                stale_ttl=(
+                    float(self.dns_spec["staleTtl"])
+                    if self.dns_spec.get("staleTtl") is not None
+                    else dnsfront.DEFAULT_STALE_TTL
+                ),
+                max_entries=self.max_entries,
+                max_pending=_opt_int(self.dns_spec.get("maxPending")),
+                rate_limit=(
+                    float(self.dns_spec["rateLimit"])
+                    if self.dns_spec.get("rateLimit") is not None
+                    else None
+                ),
+            )
+            await self.dns.start()
         log.info(
-            "shard %d serving on %s (session 0x%x via %s)",
+            "shard %d serving on %s (session 0x%x via %s)%s",
             self.shard_id, self.socket_path, self.zk.session_id,
             self.zk.connected_server,
+            (
+                f" + dns {self.dns.host}:{self.dns.port}"
+                if self.dns is not None
+                else ""
+            ),
         )
         return self
 
@@ -702,6 +748,9 @@ class ShardWorker:
         self._stop.set()
 
     async def close(self) -> None:
+        if self.dns is not None:
+            await self.dns.close()
+            self.dns = None
         if self._server is not None:
             self._server.close()
             await self._server.wait_closed()
@@ -989,6 +1038,17 @@ class ShardWorker:
         self._touch(name, qtype, payload)
         return payload
 
+    async def _dns_resolve(self, name: str, qtype: str):
+        """The DnsFront's resolver hook: the same cache-backed resolve
+        the unix-socket path uses, with overload classified into the
+        DNS shed vocabulary (REFUSED, counted by reason in the front —
+        NOT double-counted into the tier's ``sheds`` rollup; the DNS
+        surface has its own metric family)."""
+        try:
+            return await binderview.resolve(self.cache, name, qtype)
+        except CacheOverloadError as err:
+            raise dnsfront.DnsRefused("cold_fill_shed") from err
+
     def _stale_payload(self, name: str, qtype: str) -> Optional[bytes]:
         entry = self.warm.get((name, qtype))
         if entry is None:
@@ -1026,6 +1086,7 @@ class ShardWorker:
                     else 0
                 ),
             },
+            "dns": self.dns.stats() if self.dns is not None else None,
             "warm": len(self.warm),
             "entries": cache.entries if cache is not None else 0,
             "authoritative": (
@@ -1095,13 +1156,53 @@ def worker_entry(argv: Sequence[str]) -> int:
 # ---------------------------------------------------------------------------
 
 
+def _dns_merge(base: Dict, live: Optional[Dict]) -> Dict:
+    """Accumulate one worker's live DNS stats onto banked totals.
+
+    Counters add (queries, latency ladder, encode-cache counters,
+    sheds); ``entries`` and ``port`` are point-in-time and the live
+    value wins.  Shared by crash banking (bank = merge(bank, dying
+    incarnation)) and the tier rollup (fold every slot's total)."""
+    live = live or {}
+    budp = base.get("udp") or {}
+    out = {
+        "port": live.get("port", base.get("port")),
+        "queries": dict(base.get("queries") or {}),
+        "udp": {
+            "counts": list(budp.get("counts") or []),
+            "sum": float(budp.get("sum") or 0.0),
+        },
+        "encode_cache": dict(base.get("encode_cache") or {}),
+        "sheds": dict(base.get("sheds") or {}),
+    }
+    for key, val in (live.get("queries") or {}).items():
+        out["queries"][key] = out["queries"].get(key, 0) + int(val)
+    lcounts = (live.get("udp") or {}).get("counts") or []
+    counts = out["udp"]["counts"]
+    if len(counts) < len(lcounts):
+        counts.extend([0] * (len(lcounts) - len(counts)))
+    for i, val in enumerate(lcounts):
+        counts[i] += int(val)
+    out["udp"]["sum"] += float((live.get("udp") or {}).get("sum") or 0.0)
+    for key, val in (live.get("encode_cache") or {}).items():
+        if key == "entries":
+            out["encode_cache"][key] = int(val)
+        else:
+            out["encode_cache"][key] = (
+                out["encode_cache"].get(key, 0) + int(val)
+            )
+    for key, val in (live.get("sheds") or {}).items():
+        out["sheds"][key] = out["sheds"].get(key, 0) + int(val)
+    return out
+
+
 class _WorkerHandle:
     """Router-side bookkeeping for one shard slot."""
 
     __slots__ = (
         "shard_id", "seq", "socket_path", "proc", "chan", "up",
         "up_since", "respawns", "resolves_base", "sheds_base",
-        "last_status",
+        "dns_base", "last_status",
     )
 
     def __init__(self, shard_id: int, seq: int, socket_path: str):
@@ -1120,6 +1221,10 @@ class _WorkerHandle:
         #: same banking for the shed counters (registrar_shed_total is
         #: a counter too; a respawn must not rewind it)
         self.sheds_base: Dict[str, int] = {r: 0 for r in SHED_REASONS}
+        #: and for the DNS surface's counters (queries, the latency
+        #: ladder, encode-cache hits) — every registrar_dns_* family
+        #: must stay monotonic across worker respawns
+        self.dns_base: Dict = {}
         self.last_status: Dict = {}
 
     def resolves_total(self) -> int:
@@ -1141,6 +1246,10 @@ class _WorkerHandle:
         return int(
             (self.last_status.get("overload") or {}).get("queue_depth", 0)
         )
+
+    def dns_total(self) -> Dict:
+        """This slot's cumulative DNS stats across every incarnation."""
+        return _dns_merge(self.dns_base, self.last_status.get("dns"))
 
 
 class ShardRouter(EventEmitter):
@@ -1174,6 +1283,7 @@ class ShardRouter(EventEmitter):
         worker_log_level: Optional[str] = None,
         worker_trace: Optional[Dict] = None,
         overload: Optional[Dict] = None,
+        dns: Optional[Dict] = None,
     ):
         super().__init__()
         if shards < 1:
@@ -1208,6 +1318,18 @@ class ShardRouter(EventEmitter):
         #: per-front-connection token bucket).  None = no armor, byte-
         #: identical specs and relays to the pre-17 tier.
         self.overload = dict(overload) if overload else None
+        #: DNS frontend config (ISSUE 19, config ``serve.dns``):
+        #: spec-key spelling ({"host", "port", "udpPayloadMax",
+        #: "negativeTtl", "maxPending", "rateLimit"}).  A port of 0 is
+        #: resolved to a concrete free port HERE, once — every worker
+        #: must bind the SAME port for the SO_REUSEPORT kernel fan-out.
+        #: None = no DNS sockets, byte-identical specs to the pre-19
+        #: tier.
+        self.dns = dict(dns) if dns else None
+        if self.dns and not self.dns.get("port"):
+            self.dns["port"] = dnsfront.allocate_port(
+                self.dns.get("host", "127.0.0.1")
+            )
         #: the router's own deliberate rejects (rate_limited lives here;
         #: worker reasons roll up from status polls + crash banking)
         self._sheds: Dict[str, int] = {r: 0 for r in SHED_REASONS}
@@ -1257,6 +1379,10 @@ class ShardRouter(EventEmitter):
             ):
                 if self.overload.get(key) is not None:
                     spec[key] = self.overload[key]
+        if self.dns:
+            # Every worker gets the SAME (already-concrete) host:port —
+            # SO_REUSEPORT is the fan-out (ISSUE 19).
+            spec["dns"] = dict(self.dns)
         return spec
 
     def _spawn_proc(self, spec: Dict) -> subprocess.Popen:
@@ -1450,6 +1576,7 @@ class ShardRouter(EventEmitter):
                     handle.up = False
                     handle.resolves_base = handle.resolves_total()
                     handle.sheds_base = handle.sheds_total()
+                    handle.dns_base = handle.dns_total()
                     handle.last_status = {}
                     if handle.chan is not None:
                         await handle.chan.close()
@@ -1837,6 +1964,28 @@ class ShardRouter(EventEmitter):
         handle = self._workers.get(shard_id)
         return handle.queue_depth() if handle is not None else 0
 
+    def dns_rollup(self) -> Optional[Dict]:
+        """Tier-wide DNS stats: every slot's cumulative total folded
+        into one dict (queries by "QTYPE RCODE", the UDP latency
+        ladder, encode-cache counters, sheds) — monotonic across
+        respawns; the registrar_dns_* families' source.  None when the
+        DNS frontend is not configured."""
+        if self.dns is None:
+            return None
+        out: Dict = {}
+        entries = 0
+        for handle in self._workers.values():
+            total = handle.dns_total()
+            # entries is a point-in-time gauge per worker: SUM across
+            # the tier (the merge's live-wins rule is for one slot).
+            entries += int((total.get("encode_cache") or {}).get(
+                "entries", 0
+            ))
+            out = _dns_merge(out, total)
+        out.setdefault("encode_cache", {})["entries"] = entries
+        out["port"] = self.dns.get("port")
+        return out
+
     def shards_down(self) -> List[int]:
         return sorted(
             sid
@@ -1867,6 +2016,7 @@ class ShardRouter(EventEmitter):
                 "resolves_total": handle.resolves_total(),
                 "queue_depth": handle.queue_depth(),
                 "sheds": handle.sheds_total(),
+                "dns": handle.dns_total() if self.dns is not None else None,
                 "entries": st.get("entries", 0),
                 "warm": st.get("warm", 0),
                 "authoritative": st.get("authoritative", False),
@@ -1883,6 +2033,7 @@ class ShardRouter(EventEmitter):
                 "respawns_total": self.respawns_total(),
                 "overload": self.overload,
                 "sheds_total": self.sheds_total(),
+                "dns": self.dns,
             },
             "degraded": bool(down),
             "shards_down": down,

@@ -462,6 +462,12 @@ class SLOHarness(EventEmitter):
         #: never-blips assertions diff snapshots of this)
         self.slice_errors: Dict[str, int] = {}
         self.shard_probes = 0
+        #: the DNS frontend leg (ISSUE 19; rides the shard tier): real
+        #: UDP A queries against the workers' SO_REUSEPORT socket, one
+        #: slice domain per sample round-robin, fresh source port each
+        #: time so samples hash across the whole worker group
+        self.dns_probes = 0
+        self.dns_errors = 0
         #: the serve tier's overload armor (ISSUE 17) — installed by
         #: _start_shard_tier iff repair is on; None IS the detection
         #: proof's lever (repair=False runs the same storm unarmored)
@@ -648,6 +654,12 @@ class SLOHarness(EventEmitter):
             attach_spread="spread" if self.ensemble is not None else "any",
             timeout_ms=self.session_timeout_ms,
             poll_interval_s=0.5,
+            # The DNS frontend (ISSUE 19) rides the same workers: every
+            # probe sample sends a REAL A query over UDP, so "the tier
+            # is up" means the packet path answers, not just the unix
+            # relay.  Port 0: the harness must never collide with a
+            # developer's own 5300.
+            dns={"host": "127.0.0.1", "port": 0},
             # Worker disconnect/degrade warnings are the simulator
             # working as intended, same stance as tools/slo.py takes
             # for the fleet's own clients (SLO_VERBOSE restores them).
@@ -789,9 +801,16 @@ class SLOHarness(EventEmitter):
                     self.cached_probes += 1
                     self.stale_probes += 1
                 if self.shard_client is not None:
-                    shard_ok = await self._probe_shards()
+                    # The two tier legs run concurrently: they share no
+                    # state, and adding the DNS exchange's latency on
+                    # top of the slice sweep's would quantize every
+                    # outage window up by the serial sum.
+                    shard_ok, dns_ok = await asyncio.gather(
+                        self._probe_shards(), self._probe_dns(),
+                    )
                     span.set_attr("shard_ok", shard_ok)
-                    ok = ok and shard_ok
+                    span.set_attr("dns_ok", dns_ok)
+                    ok = ok and shard_ok and dns_ok
         self.probes.append(
             Probe(t, ok, len(expected - live_set), span.trace_id)
         )
@@ -826,6 +845,60 @@ class SLOHarness(EventEmitter):
                 shard_ok = False
         self.shard_probes += 1
         return shard_ok
+
+    async def _probe_dns(self) -> bool:
+        """The DNS frontend probe leg (ISSUE 19): one real UDP A query
+        per sample against the workers' shared SO_REUSEPORT socket,
+        round-robin over the slice domains.  A fresh source port each
+        sample means the kernel's 4-tuple hash spreads samples across
+        the whole worker group over time, so no single worker's DNS
+        path can rot unobserved.  The answer must be NOERROR with the
+        slice's static host — a REFUSED shed, a timeout, or a wrong
+        answer IS fleet downtime: this leg is what "real DNS fronts
+        this tier" changes about the availability math."""
+        from registrar_tpu import dnsfront
+
+        names = list(self.slice_expected)
+        if not names:
+            return True
+        name = names[self.dns_probes % len(names)]
+        expected_ip = self.slice_expected[name]
+        self.dns_probes += 1
+        packet = dnsfront.build_query(
+            (self.dns_probes & 0xFFFF) or 1, name,
+            dnsfront.QTYPE_A, rd=True, edns_size=1232,
+        )
+        # Two attempts, matching what any real resolver does with a
+        # dropped UDP exchange — and each retry is a fresh source port,
+        # so the kernel rehashes it to a (likely) different worker.
+        # 0.2 s per attempt: orders of magnitude above a healthy
+        # exchange (sub-ms on loopback) but short enough that a
+        # dead-air sample doesn't stall the probe cadence and quantize
+        # the measured outage windows up by its own timeout.
+        good = False
+        for _attempt in range(2):
+            try:
+                data = await dnsfront.query_udp(
+                    self.router.dns["host"], self.router.dns["port"],
+                    packet, timeout=0.2,
+                )
+                resp = dnsfront.decode_response(data)
+                good = (
+                    (data[3] & 0x0F) == dnsfront.RCODE_NOERROR
+                    and any(
+                        rtype == "A" and text == expected_ip
+                        for _n, rtype, _ttl, text in resp.answers
+                    )
+                )
+            except asyncio.CancelledError:
+                raise
+            except Exception:  # noqa: BLE001 - a failed exchange IS a failed probe
+                good = False
+            if good:
+                break
+        if not good:
+            self.dns_errors += 1
+        return good
 
     async def wait_healthy(self, timeout: float = 8.0) -> None:
         """Block until the prober sees a full fleet again (scenario
@@ -1482,6 +1555,8 @@ class SLOHarness(EventEmitter):
                 "slice_domains": len(self.slice_expected),
                 "slice_probes": self.shard_probes,
                 "slice_errors": sum(self.slice_errors.values()),
+                "dns_probes": self.dns_probes,
+                "dns_errors": self.dns_errors,
                 "respawns": (
                     self.router.respawns_total()
                     if self.router is not None

@@ -835,6 +835,26 @@ async def _cmd_status(args) -> int:
                 f"sheds: {shed_bits}",
                 file=sys.stderr,
             )
+            # DNS frontend at a glance (ISSUE 19): query volume, the
+            # encode cache's hit ratio (the line-rate path's health),
+            # and DNS-side sheds — one line per shard.
+            dns = info.get("dns")
+            if dns:
+                queries = dns.get("queries") or {}
+                cache = dns.get("encode_cache") or {}
+                hits = cache.get("hits", 0)
+                lookups = hits + cache.get("misses", 0)
+                ratio = f"{hits / lookups:.2f}" if lookups else "-"
+                dns_sheds = sum((dns.get("sheds") or {}).values())
+                print(
+                    f"zkcli: status: shard {sid} "
+                    f"dns port={dns.get('port')} "
+                    f"queries={sum(queries.values())} "
+                    f"encodeCacheHit={ratio} "
+                    f"entries={cache.get('entries', 0)} "
+                    f"sheds={dns_sheds}",
+                    file=sys.stderr,
+                )
         problems = []
         for sid in snapshot.get("shards_down") or []:
             problems.append(f"shard {sid} down")
@@ -1042,6 +1062,147 @@ def _infer_qtype(name: str) -> str:
     ):
         return "SRV"
     return "A"
+
+
+async def _dig_endpoint(args) -> Optional[Tuple[str, int]]:
+    """Resolve where `dig` should send packets: --server wins, else the
+    config's serve.dns block; a configured port of 0 (allocate at tier
+    start) is read off the running tier's ``GET /status`` serve block,
+    which carries the concrete SO_REUSEPORT port."""
+    if args.server:
+        host, _, port_s = args.server.rpartition(":")
+        try:
+            port = int(port_s)
+        except ValueError:
+            port = 0
+        if not host or not (0 < port < 65536):
+            print(f"zkcli: dig: bad --server {args.server!r} "
+                  "(want HOST:PORT)", file=sys.stderr)
+            return None
+        return host, port
+    if not args.file:
+        print("zkcli: dig: need --server HOST:PORT or -f CONFIG",
+              file=sys.stderr)
+        return None
+    from registrar_tpu.config import ConfigError, load_config
+
+    try:
+        cfg = load_config(args.file)
+    except ConfigError as e:
+        print(f"zkcli: dig: {e}", file=sys.stderr)
+        return None
+    dns_cfg = cfg.serve.dns if cfg.serve is not None else None
+    if dns_cfg is None:
+        print(f"zkcli: dig: {args.file} has no serve.dns block",
+              file=sys.stderr)
+        return None
+    if dns_cfg.port:
+        return dns_cfg.host, dns_cfg.port
+    if cfg.metrics is None:
+        print(
+            "zkcli: dig: serve.dns.port is 0 (allocated at tier start) "
+            "and the config has no metrics block to ask the running "
+            "tier — pin a port or pass --server", file=sys.stderr,
+        )
+        return None
+    try:
+        snapshot = await _metrics_get_json(
+            cfg.metrics.host, cfg.metrics.port, "/status", args.timeout
+        )
+    except (OSError, ValueError, asyncio.TimeoutError) as e:
+        print(
+            f"zkcli: dig: {cfg.metrics.host}:{cfg.metrics.port}: {e} "
+            "(serve.dns.port is 0; the running tier's /status has the "
+            "allocated port)", file=sys.stderr,
+        )
+        return None
+    port = ((snapshot.get("serve") or {}).get("dns") or {}).get("port")
+    if not port:
+        print("zkcli: dig: the running tier reports no DNS frontend",
+              file=sys.stderr)
+        return None
+    return dns_cfg.host, int(port)
+
+
+async def _cmd_dig(args) -> int:
+    """Query the DNS frontend with real packets (ISSUE 19): the wire-
+    level sibling of `resolve` — same answers, but through the tier's
+    SO_REUSEPORT UDP socket (TCP on truncation), so it proves the whole
+    serve path an actual resolver would traverse.
+
+    Exit codes follow the probe contract: 0 = NOERROR with answers,
+    1 = a well-formed negative or refusal (NXDOMAIN, NODATA, REFUSED,
+    SERVFAIL), 2 = unreachable (nowhere to send, timeout, or a reply
+    the codec rejects).
+    """
+    import random
+    import time as time_mod
+
+    from registrar_tpu import dnsfront
+
+    endpoint = await _dig_endpoint(args)
+    if endpoint is None:
+        return 2
+    host, port = endpoint
+    qtype = args.qtype or _infer_qtype(args.name)
+    packet = dnsfront.build_query(
+        random.randrange(1 << 16), args.name, dnsfront.TYPE_CODES[qtype],
+        edns_size=dnsfront.DEFAULT_UDP_PAYLOAD_MAX,
+    )
+    proto = "TCP" if args.tcp else "UDP"
+    t0 = time_mod.perf_counter()
+    try:
+        if args.tcp:
+            raw = await dnsfront.query_tcp(
+                host, port, packet, timeout=args.timeout)
+        else:
+            raw = await dnsfront.query_udp(
+                host, port, packet, timeout=args.timeout)
+            if dnsfront.decode_response(raw).tc:
+                # The TC bit: the answer outgrew the UDP budget — retry
+                # the same query over the tier's TCP listener, like any
+                # real resolver would.
+                print(";; truncated: retrying over TCP", file=sys.stderr)
+                proto = "UDP->TCP"
+                raw = await dnsfront.query_tcp(
+                    host, port, packet, timeout=args.timeout)
+    except (asyncio.TimeoutError, ConnectionError, OSError) as e:
+        print(f"zkcli: dig: {host}:{port}: {e!r}", file=sys.stderr)
+        return 2
+    elapsed_ms = (time_mod.perf_counter() - t0) * 1000.0
+    try:
+        resp = dnsfront.decode_response(raw)
+    except dnsfront.DnsError as e:
+        print(f"zkcli: dig: malformed reply from {host}:{port}: {e}",
+              file=sys.stderr)
+        return 2
+    status = dnsfront.RCODE_NAMES.get(resp.rcode, str(resp.rcode))
+    flag_bits = " ".join(
+        label for label, mask in (
+            ("qr", dnsfront.FLAG_QR), ("aa", dnsfront.FLAG_AA),
+            ("tc", dnsfront.FLAG_TC), ("rd", dnsfront.FLAG_RD),
+            ("ra", dnsfront.FLAG_RA),
+        ) if resp.flags & mask
+    )
+    print(f";; ->>HEADER<<- opcode: QUERY, status: {status}, "
+          f"id: {resp.qid}")
+    print(f";; flags: {flag_bits}; ANSWER: {len(resp.answers)}, "
+          f"AUTHORITY: {len(resp.authorities)}, "
+          f"ADDITIONAL: {len(resp.additionals)}")
+    print(";; QUESTION SECTION:")
+    qtname = dnsfront.QTYPE_NAMES.get(resp.qtype, str(resp.qtype))
+    print(f";{resp.qname}.\t\tIN\t{qtname}")
+    for title, section in (("ANSWER", resp.answers),
+                           ("AUTHORITY", resp.authorities),
+                           ("ADDITIONAL", resp.additionals)):
+        if section:
+            print(f";; {title} SECTION:")
+            for name, tname, ttl, text in section:
+                print(f"{name}.\t{ttl}\tIN\t{tname}\t{text}")
+    print(f";; Query time: {elapsed_ms:.1f} msec")
+    print(f";; SERVER: {host}#{port} ({proto})")
+    return 0 if resp.rcode == dnsfront.RCODE_NOERROR and resp.answers \
+        else 1
 
 
 async def _cmd_serve_view(args) -> int:
@@ -1263,6 +1424,14 @@ async def _cmd_serve_sharded(args) -> int:
             if cfg.serve.overload is not None
             else None
         ),
+        # DNS frontend (ISSUE 19): every worker binds an SO_REUSEPORT
+        # UDP socket + TCP listener on config.serve.dns's host:port.
+        # Absent block: None — no DNS socket anywhere.
+        dns=(
+            cfg.serve.dns.as_spec()
+            if cfg.serve.dns is not None
+            else None
+        ),
     )
     try:
         await router.start()
@@ -1304,9 +1473,15 @@ async def _cmd_serve_sharded(args) -> int:
     for sig in (signal_mod.SIGTERM, signal_mod.SIGINT):
         loop.add_signal_handler(sig, stop.set)
     loop.add_signal_handler(signal_mod.SIGHUP, reload_requested.set)
+    dns_note = (
+        f" + dns {router.dns['host']}:{router.dns['port']}/udp+tcp"
+        if router.dns
+        else ""
+    )
     print(
         f"zkcli: serve-sharded: {cfg.serve.shards} shards on "
-        f"{cfg.serve.socket_path} (SIGHUP reshards)", file=sys.stderr,
+        f"{cfg.serve.socket_path}{dns_note} (SIGHUP reshards)",
+        file=sys.stderr,
     )
     deadline = (
         loop.time() + args.duration if args.duration else None
@@ -1634,7 +1809,7 @@ def _register_commands(sub) -> None:
     )
     p.add_argument("name")
     p.add_argument("-t", "--qtype", default="A", type=str.upper,
-                   choices=["A", "SRV"])
+                   choices=["A", "SRV", "TXT"])
     p.add_argument(
         "--cached", action="store_true",
         help="serve the answer from a watch-coherent in-memory cache "
@@ -1642,6 +1817,39 @@ def _register_commands(sub) -> None:
         "the Binder hot path; see serve-view for the long-running loop)",
     )
     p.set_defaults(fn=_cmd_resolve)
+
+    p = sub.add_parser(
+        "dig",
+        help="query the serve tier's DNS frontend with real UDP/TCP "
+        "packets, dig-style output (exit 0 answers / 1 negative or "
+        "refused / 2 unreachable) — the wire-level sibling of `resolve`",
+    )
+    p.add_argument("name")
+    p.add_argument(
+        "-t", "--qtype", default=None, type=str.upper,
+        choices=["A", "SRV", "TXT"],
+        help="query type (default: SRV for _svc._proto. names, else A)",
+    )
+    p.add_argument(
+        "-f", "--file", default=None, metavar="CONFIG",
+        help="find the frontend per this config's serve.dns block (a "
+        "configured port of 0 is read off the running tier's /status)",
+    )
+    p.add_argument(
+        "--server", default=None, metavar="HOST:PORT",
+        help="query this address instead of the config's",
+    )
+    p.add_argument(
+        "--tcp", action="store_true",
+        help="query over TCP from the start (the codec retries over TCP "
+        "on a truncated UDP answer automatically)",
+    )
+    p.add_argument(
+        "--timeout", type=float, default=3.0, metavar="SECONDS",
+        help="per-exchange budget before reporting unreachable "
+        "(default 3)",
+    )
+    p.set_defaults(fn=_cmd_dig, raw=True)
 
     p = sub.add_parser(
         "serve-view",

@@ -1,11 +1,13 @@
 """Wire-contract drift rules (generation 4).
 
-The repo hand-rolls two binary protocols: the jute codec under
-``registrar_tpu/zk/`` (PR-1) and the shard tier's length-prefixed
-op-byte protocol in ``registrar_tpu/shard.py`` (PRs 12-13).  Their
-encoder/decoder pairs are kept symmetric by golden tests — which only
-catch drift on the paths the goldens exercise.  These rules check the
-*declared* contract statically:
+The repo hand-rolls three binary protocols: the jute codec under
+``registrar_tpu/zk/`` (PR-1), the shard tier's length-prefixed
+op-byte protocol in ``registrar_tpu/shard.py`` (PRs 12-13), and the
+DNS wire codec in ``registrar_tpu/dnsfront.py`` (PR-19, whose
+``QTYPE_*``/``RCODE_*`` families are op codes in everything but
+name).  Their encoder/decoder pairs are kept symmetric by golden
+tests — which only catch drift on the paths the goldens exercise.
+These rules check the *declared* contract statically:
 
 ``struct-format-drift``
     Every module-level ``NAME = struct.Struct("fmt")`` constant in the
@@ -20,15 +22,16 @@ catch drift on the paths the goldens exercise.  These rules check the
     args, ``[0]`` subscripts, a result bound to one name — stay silent.
 
 ``opcode-dispatch-drift``
-    The ``OP_*`` constant family must agree in three places: the
-    module-level definitions, at least one dispatch arm (an ``OP_*``
-    name compared in an ``if``/``elif`` or used as a dispatch-dict
-    key — a code nobody dispatches is dead protocol surface, and an arm
-    for an undefined code is a decoder for frames nobody sends), and
-    the protocol tables in docs/DESIGN.md + docs/OBSERVABILITY.md
-    (backticked ``OP_*`` rows with a numeric value column).  Doc legs
-    are skipped entirely when neither doc carries a table row, so
-    scratch trees without docs only get the code-side check.
+    The ``OP_*`` / ``QTYPE_*`` / ``RCODE_*`` constant families must
+    agree in three places: the module-level definitions, at least one
+    dispatch arm (a family name compared in an ``if``/``elif`` or used
+    as a dispatch-dict key — a code nobody dispatches is dead protocol
+    surface, and an arm for an undefined code is a decoder for frames
+    nobody sends), and the protocol tables in docs/DESIGN.md +
+    docs/OBSERVABILITY.md (backticked family-name rows with a numeric
+    value column).  Doc legs are skipped entirely when neither doc
+    carries a table row, so scratch trees without docs only get the
+    code-side check.
 
 ``flag-bit-overlap``
     Flag constants are OR'd into the same byte as the op code
@@ -54,15 +57,18 @@ from checklib.rules_contracts import read_doc_lines
 #: The hand-rolled wire-protocol surface.  Everything else in the tree
 #: may use ``struct`` casually; only these modules carry a contract.
 _SHARD = "registrar_tpu/shard.py"
+_DNSFRONT = "registrar_tpu/dnsfront.py"
 _ZK_PREFIX = "registrar_tpu/zk/"
 
 _PROTOCOL_DOCS = ("docs/DESIGN.md", "docs/OBSERVABILITY.md")
 
-_OP_NAME = re.compile(r"^OP_[A-Z0-9_]+$")
+#: The op-code families: the shard tier's OP_* plus the DNS codec's
+#: QTYPE_*/RCODE_* (wire-assigned code points with dispatch arms).
+_OP_NAME = re.compile(r"^(?:OP|QTYPE|RCODE)_[A-Z0-9_]+$")
 _STATUS_NAME = re.compile(r"^STATUS_[A-Z0-9_]+$")
-#: A protocol-table row: first cell a backticked OP_* name, some later
-#: cell a bare decimal or 0x hex value.
-_DOC_ROW = re.compile(r"^\s*\|\s*`(OP_[A-Z0-9_]+)`\s*\|(.*)$")
+#: A protocol-table row: first cell a backticked family name, some
+#: later cell a bare decimal or 0x hex value.
+_DOC_ROW = re.compile(r"^\s*\|\s*`((?:OP|QTYPE|RCODE)_[A-Z0-9_]+)`\s*\|(.*)$")
 _DOC_VALUE = re.compile(r"^(?:0[xX][0-9a-fA-F]+|\d+)$")
 
 
@@ -71,7 +77,10 @@ def _protocol_modules(model: ProgramModel) -> List[ModuleInfo]:
     for mod in model.modules.values():
         if mod.degraded or mod.ctx.tree is None:
             continue
-        if mod.rel_path == _SHARD or mod.rel_path.startswith(_ZK_PREFIX):
+        if (
+            mod.rel_path in (_SHARD, _DNSFRONT)
+            or mod.rel_path.startswith(_ZK_PREFIX)
+        ):
             out.append(mod)
     return sorted(out, key=lambda m: m.rel_path)
 

@@ -97,6 +97,9 @@ BOUNDARY_SOURCES: Dict[str, Dict[str, str]] = {
         "unpack_from": "num",
         "readexactly": "buf",
     },
+    "registrar_tpu/dnsfront.py": {
+        "unpack_from": "num",
+    },
     "registrar_tpu/health.py": {
         "read": "buf",
     },
