@@ -7,7 +7,7 @@ NAME = registrar
 RELEASE_TARBALL = $(NAME)-release.tar.gz
 RELSTAGEDIR = /tmp/$(NAME)-release
 
-.PHONY: all check check-core test test-jax chaos restart-e2e bench bench-cached bench-sharded overload-quick dns-quick profile slo slo-quick release publish clean
+.PHONY: all check check-core test test-jax chaos restart-e2e bench bench-cached bench-sharded overload-quick dns-quick profile slo slo-quick slo-nines release publish clean
 
 all: check test
 
@@ -106,6 +106,13 @@ slo:
 
 slo-quick:
 	$(PYTHON) tools/slo.py --trace quick --report slo-report.json --prove-detection
+
+# Lever proof (ISSUE 20): run the quick trace twice under ONE seed —
+# availability levers on (the default), then the reference-exact tuning
+# (--reference) — and fail unless the levers measurably beat the
+# reference nines.  The per-fault table attributes the gain.
+slo-nines:
+	$(PYTHON) tools/slo.py --trace quick --report slo-report.json --prove-levers
 
 # Cached-resolve slice (ISSUE 4): the zkcache coherence suite, then the
 # cached-latency/QPS/coherence-lag measurement with its in-process >=10x

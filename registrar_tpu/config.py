@@ -115,6 +115,17 @@ class ZookeeperConfig:
     #: identical wire behavior either way (parity pinned).  None/
     #: "asyncio" = the stdlib loop, the default.
     event_loop: Optional[str] = None
+    #: ``connectRaceStaggerMs`` (ISSUE 20): raced happy-eyeballs connect
+    #: passes — candidate k dials this many ms after candidate k-1 and
+    #: the first successful read-write handshake wins (losers aborted
+    #: cleanly).  None = the serial reference-exact pass.
+    connect_race_stagger_ms: Optional[int] = None
+    #: ``pingIntervalMs`` / ``deadAfterMs`` (ISSUE 20): override the
+    #: keepalive/watchdog schedule (default: ping every negotiated/3,
+    #: dead after 2/3 with no frame) for sub-session-timeout failure
+    #: detection.  None/None = reference-exact thirds rule.
+    ping_interval_ms: Optional[int] = None
+    dead_after_ms: Optional[int] = None
 
 
 @dataclass
@@ -132,6 +143,15 @@ class CacheConfig:
     feature defaults, reference parity exactly preserved."""
 
     max_entries: int = 4096
+    #: ``staleMaxAgeS`` (ISSUE 20) — **seconds, not milliseconds** (the
+    #: name carries the unit, like ``reconcile.intervalSeconds``):
+    #: serve-stale bound for degraded mode.  While the cache's session
+    #: is down it keeps answering from last-known-good entries for at
+    #: most this long (RFC 8767 at the resolver path); past the bound —
+    #: or on any authority restoration / terminal expiry — everything
+    #: retained is flushed.  None = reference-exact flush-on-degrade;
+    #: 0 = fail closed the moment authority is lost.
+    stale_max_age_s: Optional[float] = None
 
 
 @dataclass
@@ -396,6 +416,9 @@ def parse_config(raw: Mapping[str, Any]) -> Config:
         request_timeout_ms=_optional_ms(zk_raw, "requestTimeout"),
         can_be_read_only=can_be_read_only,
         event_loop=event_loop,
+        connect_race_stagger_ms=_optional_ms(zk_raw, "connectRaceStaggerMs"),
+        ping_interval_ms=_optional_ms(zk_raw, "pingIntervalMs"),
+        dead_after_ms=_optional_ms(zk_raw, "deadAfterMs"),
     )
 
     registration = raw.get("registration")
@@ -550,7 +573,23 @@ def parse_config(raw: Mapping[str, Any]) -> Config:
             raise ConfigError(
                 "config.cache.maxEntries must be a positive integer"
             )
-        cache = CacheConfig(max_entries=max_entries)
+        stale_max_age = cache_raw.get("staleMaxAgeS")
+        if stale_max_age is not None and (
+            not isinstance(stale_max_age, (int, float))
+            or isinstance(stale_max_age, bool)
+            or not math.isfinite(stale_max_age)
+            or stale_max_age < 0
+        ):
+            raise ConfigError(
+                "config.cache.staleMaxAgeS must be a non-negative number "
+                "of seconds"
+            )
+        cache = CacheConfig(
+            max_entries=max_entries,
+            stale_max_age_s=(
+                None if stale_max_age is None else float(stale_max_age)
+            ),
+        )
 
     restart = None
     restart_raw = raw.get("restart")

@@ -193,6 +193,9 @@ def _client_from_config(cfg: Config) -> ZKClient:
         survive_session_expiry=cfg.survive_session_expiry,
         max_session_rebirths=cfg.max_session_rebirths,
         can_be_read_only=cfg.zookeeper.can_be_read_only,
+        connect_race_stagger_ms=cfg.zookeeper.connect_race_stagger_ms,
+        ping_interval_ms=cfg.zookeeper.ping_interval_ms,
+        dead_after_ms=cfg.zookeeper.dead_after_ms,
     )
 
 
@@ -898,6 +901,24 @@ async def _status_snapshot(cfg: Config, zk, ee, note: dict) -> dict:
             "readOnly": getattr(zk, "read_only", False),
             "negotiatedTimeoutMs": zk.negotiated_timeout_ms,
             "rebirths": zk.rebirths,
+            # Connect-race outcome + failover latency (ISSUE 20): the
+            # runbook's first stop for "why was recovery slow" — which
+            # member the last raced pass attached (None under the serial
+            # reference path), how many candidates it dialed / aborted,
+            # and how long the last unexpected-teardown -> reconnect
+            # window took.
+            "connectRace": {
+                "wins": zk.race_stats["wins"],
+                "lastWinner": zk.race_stats["last_winner"],
+                "lastCandidates": zk.race_stats["last_candidates"],
+                "lastAborted": zk.race_stats["last_aborted"],
+            },
+            "lastFailoverS": (
+                round(zk.last_failover_s, 4)
+                if zk.last_failover_s is not None
+                else None
+            ),
+            "watchdogDrops": zk.watchdog_drops,
         },
         "registration": {
             "epoch": ee.epoch,

@@ -832,6 +832,16 @@ def instrument_cache(cache, registry: Optional[MetricsRegistry] = None) -> Metri
         "Transitions into degraded (non-authoritative) mode",
     ), "degraded_total")
     from_stat(reg.counter(
+        "registrar_cache_stale_serves_total",
+        "Degraded-mode lookups answered from bounded-age last-known-good "
+        "entries (serve-stale, cache.staleMaxAgeS)",
+    ), "stale_serves")
+    from_stat(reg.counter(
+        "registrar_cache_stale_refusals_total",
+        "Degraded-mode lookups that crossed the stale-age bound and "
+        "flushed the stale world instead of answering from it",
+    ), "stale_refusals")
+    from_stat(reg.counter(
         "registrar_cache_evictions_total",
         "Entries evicted by the maxEntries bound",
     ), "evictions")

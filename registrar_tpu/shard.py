@@ -1279,6 +1279,7 @@ class ShardRouter(EventEmitter):
         request_timeout_ms: Optional[int] = None,
         vnodes: int = DEFAULT_VNODES,
         poll_interval_s: float = 1.0,
+        supervise_interval_s: float = 0.05,
         python: Optional[str] = None,
         worker_log_level: Optional[str] = None,
         worker_trace: Optional[Dict] = None,
@@ -1303,6 +1304,14 @@ class ShardRouter(EventEmitter):
         self.request_timeout_ms = request_timeout_ms
         self.vnodes = vnodes
         self.poll_interval_s = poll_interval_s
+        #: crash-detection + readiness-poll cadence (ISSUE 20): the
+        #: respawn MTTR's fixed overhead is one detect interval plus
+        #: one readiness interval — availability-tuned deployments (the
+        #: SLO harness's lever mode) drop it to 0.01 s; the default is
+        #: the pre-20 hardcoded 0.05 s, byte-identical supervision.
+        if supervise_interval_s <= 0:
+            raise ValueError("supervise_interval_s must be > 0")
+        self.supervise_interval_s = supervise_interval_s
         self.python = python or sys.executable
         #: stderr log level for spawned workers (SHARD_LOG_LEVEL env;
         #: None = inherit — the SLO harness quiets its workers with it)
@@ -1418,7 +1427,7 @@ class ShardRouter(EventEmitter):
                 chan = await Channel.open(handle.socket_path)
             except (OSError, ConnectionError) as err:
                 last_err = err
-                await asyncio.sleep(0.05)
+                await asyncio.sleep(self.supervise_interval_s)
                 continue
             try:
                 status, body = await asyncio.wait_for(
@@ -1427,7 +1436,7 @@ class ShardRouter(EventEmitter):
             except (ShardError, asyncio.TimeoutError) as err:
                 last_err = err
                 await chan.close()
-                await asyncio.sleep(0.05)
+                await asyncio.sleep(self.supervise_interval_s)
                 continue
             if status != STATUS_OK:
                 await chan.close()
@@ -1558,7 +1567,7 @@ class ShardRouter(EventEmitter):
     async def _supervise_loop(self) -> None:
         next_poll = 0.0
         while not self._stopping:
-            await asyncio.sleep(0.05)
+            await asyncio.sleep(self.supervise_interval_s)
             for handle in list(self._workers.values()):
                 proc = handle.proc
                 if (

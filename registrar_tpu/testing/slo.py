@@ -343,6 +343,16 @@ _MEMBER_RECONNECT = RetryPolicy(
     jitter="decorrelated",
 )
 
+#: ISSUE 20 recovery tuning (``levers=True``): with raced connects and
+#: the tightened ping schedule doing the detection, the residual
+#: reconnect backoff IS the recovery latency — a 10-60 ms decorrelated
+#: envelope keeps retry pressure bounded without letting the backoff
+#: dominate any MTTR row.
+_LEVER_RECONNECT = RetryPolicy(
+    max_attempts=float("inf"), initial_delay=0.01, max_delay=0.06,
+    jitter="decorrelated",
+)
+
 #: registration retry for members (re)registering under harness faults:
 #: transient failures (CONNECTION_LOSS through a healing proxy, a
 #: NOT_READONLY from a minority member, an election-window drop) are the
@@ -350,6 +360,15 @@ _MEMBER_RECONNECT = RetryPolicy(
 #: harness cadence rather than the production 1-90 s envelope
 _REGISTER_RETRY = RetryPolicy(
     max_attempts=80, initial_delay=0.05, max_delay=0.3,
+    jitter="decorrelated",
+)
+
+#: ISSUE 20: the levered registration retry.  A (re)registration that
+#: collides with an election window fails once and then sleeps the
+#: backoff — with a 40 ms election, a 50-300 ms draw IS the leader-kill
+#: MTTR row, so the levers pull the envelope down to election scale.
+_LEVER_REGISTER_RETRY = RetryPolicy(
+    max_attempts=200, initial_delay=0.01, max_delay=0.05,
     jitter="decorrelated",
 )
 
@@ -399,6 +418,7 @@ class SLOHarness(EventEmitter):
         ensemble: int = 1,
         election_ms: float = 150.0,
         shards: int = 0,
+        levers: bool = False,
     ):
         """``ensemble`` (ISSUE 10): > 1 runs the fleet against an
         N-member :class:`ZKEnsemble` with a real leader/quorum protocol
@@ -418,7 +438,18 @@ class SLOHarness(EventEmitter):
         the availability math.  The shard fault classes (shard-kill,
         reshard-wave) become injectable; with ``repair=False`` the
         router's crash→respawn supervision is withheld (the recovery
-        action under test)."""
+        action under test).
+
+        ``levers`` (ISSUE 20): turn on the availability levers this PR
+        engineers — raced connects (no serial dead-host scan on
+        failover), the tightened ping/dead-after schedule (link death
+        detected in ~0.1 s rather than negotiated-timeout fractions),
+        stale-while-revalidate in the probe-side :class:`ZKCache`, a
+        harness-scale reconnect floor, and spread watch attach across
+        the ensemble.  ``False`` (the default) is reference-exact: the
+        r19 client/cache behavior, bit for bit — ``tools/slo.py
+        --prove-levers`` runs both under one seed and fails unless the
+        levers measurably beat the reference."""
         super().__init__()
         if members < 2:
             raise ValueError("a fleet needs at least 2 members")
@@ -431,6 +462,7 @@ class SLOHarness(EventEmitter):
         self.domain = domain
         self.n_ensemble = ensemble
         self.election_ms = election_ms
+        self.levers = levers
         self.fault_ids = FAULT_IDS
         self.tracer = (
             tracer
@@ -519,19 +551,51 @@ class SLOHarness(EventEmitter):
             return live[0]
         return self.server
 
+    def _lever_kwargs(self, member: Optional[_Member] = None) -> Dict[str, Any]:
+        """The ISSUE-20 client levers, or ``{}`` when ``levers`` is off
+        (reference-exact: the ZKClient keys stay absent, so the connect
+        path and ping schedule are bit-identical to r19).
+
+        Members get the full set: raced connects with a 40 ms stagger,
+        a 40 ms ping / 100 ms dead-after watchdog (their 200 ms lever
+        sessions make the reference ~67/133 ms schedule the bound), and
+        — in ensemble mode — a spread attach slot so watch load never
+        piles onto one member.  Probe clients get raced connects only:
+        their 8 s sessions die by TCP reset (they are unproxied), so
+        the watchdog lever has nothing to detect there."""
+        if not self.levers:
+            return {}
+        kwargs: Dict[str, Any] = {"connect_race_stagger_ms": 40}
+        if member is not None:
+            kwargs["ping_interval_ms"] = 40
+            kwargs["dead_after_ms"] = 100
+            if self.n_ensemble > 1:
+                kwargs["attach_preference"] = (
+                    f"spread:{member.idx % self.n_ensemble}"
+                    f"-of-{self.n_ensemble}"
+                )
+        return kwargs
+
+    def _reconnect_policy(self) -> RetryPolicy:
+        return _LEVER_RECONNECT if self.levers else _MEMBER_RECONNECT
+
+    def _register_retry(self) -> RetryPolicy:
+        return _LEVER_REGISTER_RETRY if self.levers else _REGISTER_RETRY
+
     def _make_client(self, member: _Member) -> ZKClient:
         return ZKClient(
             [p.address for p in member.proxies],
             timeout_ms=self.session_timeout_ms,
             connect_timeout_ms=300,
             connect_pass_timeout_ms=self.session_timeout_ms,
-            reconnect_policy=_MEMBER_RECONNECT,
+            reconnect_policy=self._reconnect_policy(),
             # Ensemble mode: attach read-only during quorum loss (reads
             # keep serving; writes retry through NOT_READONLY), fail
             # over fast when a read-write member returns, and keep the
             # connect-order shuffle seed-deterministic per fleet member.
             can_be_read_only=self.ensemble is not None,
             rng=random.Random(self.rng.randrange(2**32)),
+            **self._lever_kwargs(member),
         )
 
     def _probe_client(self) -> ZKClient:
@@ -540,9 +604,10 @@ class SLOHarness(EventEmitter):
             timeout_ms=8000,
             connect_timeout_ms=300,
             connect_pass_timeout_ms=2000,
-            reconnect_policy=_MEMBER_RECONNECT,
+            reconnect_policy=self._reconnect_policy(),
             can_be_read_only=self.ensemble is not None,
             rng=random.Random(self.rng.randrange(2**32)),
+            **self._lever_kwargs(),
         )
         client.rw_probe_interval_s = 0.1
         return client
@@ -568,7 +633,15 @@ class SLOHarness(EventEmitter):
         self.live_client = await self._probe_client().connect()
         self.cache_client = await self._probe_client().connect()
         self.live_client.tracer = self.tracer
-        self.cache = ZKCache(self.cache_client)
+        # The SWR lever (ISSUE 20): through a blip the cached leg keeps
+        # answering bounded-age last-known-good instead of falling to
+        # live reads against the same dead link — observable in the
+        # report's staleness/levers stats, deliberately NOT in
+        # availability (the probe's ok verdict rides the live leg).
+        self.cache = ZKCache(
+            self.cache_client,
+            stale_max_age_s=30.0 if self.levers else None,
+        )
         self.cache.tracer = self.tracer
         if self.n_shards > 0:
             await self._start_shard_tier()
@@ -641,7 +714,11 @@ class SLOHarness(EventEmitter):
         # the client that gets limited.
         if self.repair:
             self.shard_overload = {
-                "maxQueueDepth": 96,
+                # Lever mode sizes the backstop so the storm's backlog
+                # sheds at the STORM's connections (per-conn inflight)
+                # before the global bound starts refusing the probes'
+                # own relay channel — the reference depth stays 96.
+                "maxQueueDepth": 160 if self.levers else 96,
                 "maxInflightPerConn": 6,
                 "clientRateLimit": 1000.0,
                 "coldFillConcurrency": 4,
@@ -654,6 +731,10 @@ class SLOHarness(EventEmitter):
             attach_spread="spread" if self.ensemble is not None else "any",
             timeout_ms=self.session_timeout_ms,
             poll_interval_s=0.5,
+            # Lever mode (ISSUE 20): crash detect + readiness poll at
+            # 10 ms — the respawn MTTR's fixed overhead — instead of
+            # the reference 50 ms cadence.
+            supervise_interval_s=0.01 if self.levers else 0.05,
             # The DNS frontend (ISSUE 19) rides the same workers: every
             # probe sample sends a REAL A query over UDP, so "the tier
             # is up" means the packet path answers, not just the unix
@@ -948,7 +1029,7 @@ class SLOHarness(EventEmitter):
         member.znodes = await register(
             member.client, self._registration(),
             admin_ip=member.admin_ip, hostname=member.hostname,
-            settle_delay=0, retry_policy=_REGISTER_RETRY,
+            settle_delay=0, retry_policy=self._register_retry(),
         )
 
     def _live_members(self) -> List[_Member]:
@@ -1151,7 +1232,7 @@ class SLOHarness(EventEmitter):
                 register(
                     member.client, self._registration(),
                     admin_ip=member.admin_ip, hostname=member.hostname,
-                    settle_delay=0, retry_policy=_REGISTER_RETRY,
+                    settle_delay=0, retry_policy=self._register_retry(),
                 )
             )
             await asyncio.sleep(0)  # the pipeline is now in flight
@@ -1516,6 +1597,56 @@ class SLOHarness(EventEmitter):
                 staleness[
                     f"resolve_{source}_p{int(q * 100)}_ms"
                 ] = round(value * 1000.0, 4) if value is not None else None
+        # Lever attribution (ISSUE 20): how often each availability
+        # lever actually fired this run — race wins across the fleet's
+        # (current) clients, watchdog suspicions, the cache's SWR
+        # serves/refusals, and the recovery-tuning profile in force.
+        # Reported with levers OFF too (all-zero by construction), so
+        # --prove-levers diffs one shape.
+        clients = [
+            m.client for m in self.members if m.client is not None
+        ] + [
+            c
+            for c in (
+                self.live_client, self.cache_client, self._slice_client
+            )
+            if c is not None
+        ]
+        policy = self._reconnect_policy()
+        levers = {
+            "enabled": self.levers,
+            "raced_connects": {
+                "race_wins": sum(c.race_stats["wins"] for c in clients),
+            },
+            "failure_detector": {
+                "suspicions": sum(c.watchdog_drops for c in clients),
+            },
+            "swr_cache": {
+                "stale_serves": (
+                    self.cache.stats["stale_serves"]
+                    if self.cache is not None
+                    else 0
+                ),
+                "stale_refusals": (
+                    self.cache.stats["stale_refusals"]
+                    if self.cache is not None
+                    else 0
+                ),
+            },
+            "recovery_tuning": {
+                "session_timeout_ms": self.session_timeout_ms,
+                "election_ms": (
+                    self.election_ms if self.n_ensemble > 1 else None
+                ),
+                "reconnect_floor_ms": round(policy.initial_delay * 1000.0, 1),
+                "reconnect_cap_ms": round(policy.max_delay * 1000.0, 1),
+                "attach": (
+                    "spread"
+                    if self.levers and self.n_ensemble > 1
+                    else "any"
+                ),
+            },
+        }
         mttr_all = [f.mttr_s for f in self.faults if f.mttr_s is not None]
         mttd_all = [f.mttd_s for f in self.faults if f.mttd_s is not None]
         measured = sum(
@@ -1584,6 +1715,7 @@ class SLOHarness(EventEmitter):
                 "worst": worst_info,
             },
             "staleness": staleness,
+            "levers": levers,
             "gate_metrics": gate_metrics,
         }
 
@@ -1637,6 +1769,41 @@ TRACES: Dict[str, Dict[str, Any]] = {
         # scenario's envelope lands in SLO_HISTORY.json.
         "ensemble": 3,
         "election_ms": 120.0,
+        # ISSUE 20 lever overrides (tools/slo.py's default mode;
+        # --reference restores the r19 envelope above): 200 ms sessions
+        # bound the SERVER side of failure detection — a dead member's
+        # ephemerals clear in 0.2 s instead of 0.8 — the 40 ms election
+        # window shrinks every leader failover the fleet rides, and the
+        # 15 ms probe cadence resolves the sub-100 ms outages the
+        # levers leave behind (a 20 ms cadence would quantize them).
+        "levers": {
+            "session_timeout_ms": 200,
+            "election_ms": 35.0,
+            "probe_interval": 0.01,
+            # Recovery-path knobs ONLY are retuned below: the deploy
+            # pipeline's stop->start gap and the supervisor's restart
+            # delay are the operator's own machinery, which the levers
+            # are allowed to make fast.  Fault-SEVERITY knobs are
+            # byte-identical to the reference rows above — health-flap
+            # down time, the netem 2.2x-session blackhole formula,
+            # partition/quorum holds, the leader's 0.3 s death, and the
+            # storm's length/shape all stay put (a lever that shrinks
+            # the fault instead of the recovery proves nothing).
+            "scenarios": (
+                ("deploy-wave", {"wave": 2, "down_s": 0.02}),
+                ("crash-loop", {"crashes": 2, "restart_delay": 0.03}),
+                ("health-flap", {"flaps": 2, "down_s": 0.1}),
+                ("expiry-storm", {"victims": 3, "restart_delay": 0.03}),
+                ("netem-episode", {"episodes": 1}),
+                ("leader-kill", {"kills": 1, "down_s": 0.3}),
+                ("rolling-upgrade", {"pause_s": 0.15}),
+                ("partition-minority", {"hold_s": 0.4}),
+                ("quorum-loss", {"hold_s": 0.4}),
+                ("shard-kill", {"kills": 1}),
+                ("reshard-wave", {"hold_s": 0.15}),
+                ("overload-storm", {"storm_s": 1.5}),
+            ),
+        },
         # The quick trace also fronts the backends with a 2-shard serve
         # tier (ISSUE 12): every scenario's probes now include the
         # sharded resolve path, and the shard fault classes land in the
@@ -1665,6 +1832,11 @@ TRACES: Dict[str, Dict[str, Any]] = {
         "pause_s": 1.5,
         "ensemble": 3,
         "election_ms": 150.0,
+        # The soak keeps its production-shaped 1.5 s sessions and its
+        # reference scenario knobs; the levers there are the
+        # client-side ones (raced connects, ping schedule, SWR) plus a
+        # halved election window.
+        "levers": {"election_ms": 75.0},
         "shards": 3,
         "scenarios": (
             ("deploy-wave", {"wave": 6, "down_s": 0.15}),
@@ -1691,11 +1863,20 @@ async def run_trace(
     seed: Optional[int] = None,
     repair: bool = True,
     scenarios: Optional[Sequence[Tuple[str, Dict[str, Any]]]] = None,
+    levers: bool = False,
 ) -> Dict[str, Any]:
-    """Drive one named trace end to end and return the SLO report."""
+    """Drive one named trace end to end and return the SLO report.
+
+    ``levers`` (ISSUE 20) turns on the harness availability levers AND
+    applies the trace's ``"levers"`` timing overrides (tighter
+    sessions/election/cadence); ``False`` runs the reference-exact r19
+    envelope — same seed, so ``--prove-levers`` can diff the two."""
     if trace not in TRACES:
         raise ValueError(f"unknown trace {trace!r} (have {sorted(TRACES)})")
-    params = TRACES[trace]
+    params = dict(TRACES[trace])
+    overrides = params.pop("levers", None)
+    if levers and overrides:
+        params.update(overrides)
     if seed is None:
         seed = random.randrange(2**32)
     harness = SLOHarness(
@@ -1707,6 +1888,7 @@ async def run_trace(
         ensemble=params.get("ensemble", 1),
         election_ms=params.get("election_ms", 150.0),
         shards=params.get("shards", 0),
+        levers=levers,
     )
     await harness.start()
     try:

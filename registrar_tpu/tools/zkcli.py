@@ -881,6 +881,22 @@ async def _cmd_status(args) -> int:
             f"role={role or 'unknown'}{ro}",
             file=sys.stderr,
         )
+    # Connect-race outcome (ISSUE 20): which member won the last raced
+    # connect, how many candidates were in flight, and how long the last
+    # failover took — the raced-connect levers at a glance.
+    race = session.get("connectRace") or {}
+    if race.get("wins"):
+        failover = session.get("lastFailoverS")
+        failover_bit = (
+            f" lastFailover={failover}s" if failover is not None else ""
+        )
+        print(
+            f"zkcli: status: connect race won by {race.get('lastWinner')} "
+            f"(candidates={race.get('lastCandidates')} "
+            f"aborted={race.get('lastAborted')} "
+            f"wins={race.get('wins')}){failover_bit}",
+            file=sys.stderr,
+        )
     problems = []
     if not session.get("connected"):
         problems.append(f"session {session.get('state', 'unknown')}")

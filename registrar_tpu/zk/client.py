@@ -66,7 +66,7 @@ import random
 import re
 import time
 from collections import deque
-from typing import Deque, Iterable, List, Optional, Sequence, Tuple
+from typing import Deque, Dict, Iterable, List, Optional, Sequence, Tuple
 
 from registrar_tpu import trace
 from registrar_tpu.events import EventEmitter
@@ -224,6 +224,9 @@ class ZKClient(EventEmitter):
         can_be_read_only: bool = False,
         rng: Optional[random.Random] = None,
         attach_preference: str = "any",
+        connect_race_stagger_ms: Optional[int] = None,
+        ping_interval_ms: Optional[int] = None,
+        dead_after_ms: Optional[int] = None,
     ):
         """``request_timeout_ms``: per-operation deadline.  When set, every
         awaited reply is bounded; on expiry the connection is torn down
@@ -287,7 +290,29 @@ class ZKClient(EventEmitter):
             land on distinct members, which a per-process shuffle would
             undo).  Later candidates still serve as failover targets.
 
-        It is a *hint*: reachability always wins over preference."""
+        It is a *hint*: reachability always wins over preference.
+
+        ``connect_race_stagger_ms`` (ISSUE 20; RFC 8305's staggered
+        "happy eyeballs" applied to the ensemble): when set, a connect
+        pass races candidates — attempt k starts ``stagger`` ms after
+        attempt k-1 (or immediately once an earlier attempt fails), and
+        the FIRST successful read-write handshake wins while the losers
+        are aborted cleanly (a loser that minted its own fresh session
+        sends CLOSE_SESSION before hanging up, so raced fresh connects
+        never orphan sessions).  A dead-or-blackholed first candidate
+        therefore costs ~one stagger, not a full ``connect_timeout_ms``.
+        Default None: the serial reference-exact pass.
+
+        ``ping_interval_ms`` / ``dead_after_ms`` (ISSUE 20): override
+        the keepalive/watchdog schedule.  The defaults are the Apache
+        client's thirds rule — ping every negotiated/3, declare the
+        server dead after 2/3 of the negotiated timeout with no frame —
+        which ties blackhole detection to the session timeout.  Setting
+        these detects a silent server in a fraction of that (the
+        connection drops early and the reconnect machinery races to a
+        healthy member while the session is still very much alive).
+        ``dead_after_ms`` is floored at the effective ping interval.
+        Default None/None: the reference-exact schedule."""
         super().__init__()
         servers = list(servers)
         if not servers:
@@ -327,6 +352,36 @@ class ZKClient(EventEmitter):
         #: connect-order hint ("any" | "follower" | "spread:<k>-of-<n>")
         self.attach_preference = attach_preference
         self._attach_spread = _parse_attach_preference(attach_preference)
+        if connect_race_stagger_ms is not None and connect_race_stagger_ms < 0:
+            raise ValueError("connect_race_stagger_ms must be >= 0")
+        for name, value in (
+            ("ping_interval_ms", ping_interval_ms),
+            ("dead_after_ms", dead_after_ms),
+        ):
+            if value is not None and value <= 0:
+                raise ValueError(f"{name} must be > 0")
+        #: None = serial reference pass; >=0 = raced connects (stagger)
+        self.connect_race_stagger_ms = connect_race_stagger_ms
+        #: None/None = the reference thirds-rule keepalive schedule
+        self.ping_interval_ms = ping_interval_ms
+        self.dead_after_ms = dead_after_ms
+        #: raced-connect outcome (satellite: zkcli status / GET /status):
+        #: wins counts passes the raced path decided; last_* describe the
+        #: most recent pass (winning member, candidates dialed, losers
+        #: aborted).  All zero/None under the serial reference path.
+        self.race_stats = {
+            "wins": 0,
+            "last_winner": None,
+            "last_candidates": 0,
+            "last_aborted": 0,
+        }
+        #: seconds the last unexpected teardown -> reconnect took; None
+        #: until the first failover completes
+        self.last_failover_s: Optional[float] = None
+        self._failover_started_at: Optional[float] = None
+        #: connections dropped by the liveness watchdog / stalled-drain
+        #: detector (the failure detector's suspicion count)
+        self.watchdog_drops = 0
         #: True while the session is attached to a read-only member
         #: (ConnectResponse read_only flag); reads serve, writes refuse
         self.read_only = False
@@ -519,6 +574,10 @@ class ZKClient(EventEmitter):
             else self.requested_timeout_ms
         )
         deadline = time.monotonic() + pass_timeout_ms / 1000.0
+        if self.connect_race_stagger_ms is not None:
+            # ISSUE 20: staggered raced connects — opt-in; the serial
+            # reference-exact pass below runs when the knob is absent.
+            return await self._connect_raced(order, deadline)
         ro_fallback: Optional[Tuple[str, int]] = None
         for host, port in order:
             remaining = deadline - time.monotonic()
@@ -562,6 +621,179 @@ class ZKClient(EventEmitter):
             else ConnectionError("no servers within the connect pass budget")
         )
 
+    async def _connect_raced(
+        self, order: List[Tuple[str, int]], deadline: float
+    ) -> "ZKClient":
+        """Happy-eyeballs connect pass (ISSUE 20, RFC 8305 shape).
+
+        Candidates start ``connect_race_stagger_ms`` apart (a failure
+        releases the next immediately); the first successful read-write
+        handshake wins and every other attempt is aborted.  A loser that
+        completed a handshake on a session OTHER than the winner's (a
+        fresh client races fresh-session handshakes, each minting its
+        own) best-effort sends CLOSE_SESSION before hanging up, so the
+        race never strands orphan sessions on the ensemble.  A read-only
+        handshake is HELD open as the fallback while the race keeps
+        hunting read-write — adopted directly if nothing better lands
+        (one dial cheaper than the serial pass's re-dial)."""
+        stagger_s = self.connect_race_stagger_ms / 1000.0
+        pending = list(order)
+        tasks: Dict[asyncio.Task, Tuple[str, int]] = {}
+        attempted = 0
+        last_err: Optional[Exception] = None
+        #: held read-only fallback: (host, port, reader, writer, resp)
+        ro_held: Optional[tuple] = None
+        #: completed-but-unadopted handshakes needing loser cleanup
+        losers: List[tuple] = []
+        adopted = False
+
+        def spawn() -> None:
+            nonlocal attempted
+            host, port = pending.pop(0)
+            remaining = deadline - time.monotonic()
+            task = asyncio.create_task(
+                self._dial_handshake(host, port, max_wait=remaining)
+            )
+            tasks[task] = (host, port)
+            attempted += 1
+
+        try:
+            spawn()
+            next_spawn = time.monotonic() + stagger_s
+            while tasks:
+                timeout = deadline - time.monotonic()
+                if pending:
+                    timeout = min(timeout, next_spawn - time.monotonic())
+                done, _ = await asyncio.wait(
+                    set(tasks),
+                    timeout=max(timeout, 0.0),
+                    return_when=asyncio.FIRST_COMPLETED,
+                )
+                if not done:
+                    if time.monotonic() >= deadline:
+                        break
+                    if pending:
+                        spawn()
+                        next_spawn = time.monotonic() + stagger_s
+                    continue
+                winner: Optional[tuple] = None
+                for task in done:
+                    host, port = tasks.pop(task)
+                    if task.cancelled():
+                        continue
+                    try:
+                        reader, writer, resp = task.result()
+                    except SessionExpiredError:
+                        raise
+                    except Exception as err:  # noqa: BLE001 - next candidate
+                        last_err = err
+                        log.debug(
+                            "raced connect to %s:%d failed: %r",
+                            host, port, err,
+                        )
+                        # A fast failure frees the slot: the next
+                        # candidate starts now, not at the stagger mark.
+                        next_spawn = time.monotonic()
+                        continue
+                    if resp.read_only:
+                        if ro_held is None:
+                            # ADOPT the session the handshake minted (the
+                            # serial pass does the same — see _connect_one's
+                            # orphan rationale) and keep the live transport
+                            # as the fallback while the race keeps hunting.
+                            self.session_id = resp.session_id
+                            self.session_passwd = resp.passwd
+                            self.negotiated_timeout_ms = resp.timeout_ms
+                            ro_held = (host, port, reader, writer, resp)
+                        else:
+                            losers.append((reader, writer, resp))
+                        continue
+                    if winner is None:
+                        winner = (host, port, reader, writer, resp)
+                    else:
+                        losers.append((reader, writer, resp))
+                if winner is not None:
+                    host, port, reader, writer, resp = winner
+                    if ro_held is not None:
+                        losers.append(ro_held[2:])
+                        ro_held = None
+                    adopted = True
+                    self.race_stats["wins"] += 1
+                    self.race_stats["last_winner"] = f"{host}:{port}"
+                    self.race_stats["last_candidates"] = attempted
+                    await self._adopt_connection(host, port, reader, writer, resp)
+                    return self
+                while (
+                    pending
+                    and time.monotonic() >= next_spawn
+                    and time.monotonic() < deadline
+                ):
+                    spawn()
+                    next_spawn = time.monotonic() + stagger_s
+            if ro_held is not None:
+                # No read-write member answered: degrade onto the held
+                # read-only handshake (reads serve; the rw-probe loop
+                # fails over the moment quorum returns).
+                host, port, reader, writer, resp = ro_held
+                ro_held = None
+                adopted = True
+                self.race_stats["wins"] += 1
+                self.race_stats["last_winner"] = f"{host}:{port}"
+                self.race_stats["last_candidates"] = attempted
+                await self._adopt_connection(host, port, reader, writer, resp)
+                return self
+            raise (
+                last_err
+                if last_err
+                else ConnectionError("no servers within the connect pass budget")
+            )
+        finally:
+            for task in tasks:
+                task.cancel()
+            if tasks:
+                stragglers = await asyncio.gather(
+                    *tasks, return_exceptions=True
+                )
+                losers.extend(
+                    r for r in stragglers if isinstance(r, tuple)
+                )
+            if ro_held is not None and not adopted:
+                losers.append(ro_held[2:])
+            if losers:
+                keep = self.session_id if adopted else 0
+                self.race_stats["last_aborted"] = len(losers)
+                await self._abort_losers(losers, keep_session=keep)
+            elif adopted:
+                self.race_stats["last_aborted"] = 0
+
+    async def _abort_losers(
+        self, losers: List[tuple], keep_session: int
+    ) -> None:
+        """Close out raced handshakes that lost.
+
+        A loser attached to the SAME session as the winner (a reconnect
+        race: every attempt offered our existing session) just drops its
+        transport — CLOSE_SESSION there would kill the session the
+        winner is using.  A loser on a DIFFERENT session (fresh-session
+        races mint one per handshake) closes it first, so the ensemble
+        never accumulates orphans that, under quorum loss, could not
+        even expire."""
+        for reader, writer, resp in losers:
+            try:
+                if resp.session_id != keep_session:
+                    writer.write(
+                        proto.encode_request(1, OpCode.CLOSE_SESSION)
+                    )
+                    await asyncio.wait_for(writer.drain(), timeout=0.25)
+            except asyncio.CancelledError:
+                raise
+            except Exception:  # noqa: BLE001 - best-effort cleanup
+                pass
+            try:
+                writer.close()
+            except Exception:  # noqa: BLE001
+                pass
+
     async def _connect_one(
         self,
         host: str,
@@ -569,6 +801,42 @@ class ZKClient(EventEmitter):
         max_wait: Optional[float] = None,
         allow_read_only: bool = True,
     ) -> None:
+        reader, writer, resp = await self._dial_handshake(
+            host, port, max_wait=max_wait
+        )
+        if resp.read_only and not allow_read_only:
+            # A read-only member while the pass is still hunting for a
+            # read-write one: drop the TRANSPORT only (no CLOSE_SESSION
+            # — the session stays alive server-side, exactly like a
+            # reconnect) and let connect() note the fallback.  ADOPT the
+            # session the handshake just established/attached first: a
+            # fresh client that hunted past N read-only members would
+            # otherwise mint a new session per refused handshake —
+            # orphans that, under quorum loss (leader-only expiry),
+            # could never be reaped.  The fallback (or the next pass)
+            # reattaches this same session instead.
+            self.session_id = resp.session_id
+            self.session_passwd = resp.passwd
+            self.negotiated_timeout_ms = resp.timeout_ms
+            writer.close()
+            raise _ReadOnlyMember()
+        await self._adopt_connection(host, port, reader, writer, resp)
+
+    async def _dial_handshake(
+        self,
+        host: str,
+        port: int,
+        max_wait: Optional[float] = None,
+    ) -> Tuple[asyncio.StreamReader, asyncio.StreamWriter,
+               "proto.ConnectResponse"]:
+        """Dial one candidate and run the ConnectRequest handshake.
+
+        State-free with respect to the client's connection fields: the
+        returned transport has NOT been installed (no read loop, no ping
+        loop, session fields untouched) — :meth:`_adopt_connection`
+        does that for whichever handshake the caller picks.  Shared
+        byte-for-byte by the serial pass and the raced pass, so the two
+        connect modes cannot drift apart on the wire."""
         per_step = self.connect_timeout_ms / 1000.0
         # The pass budget is CUMULATIVE across the dial/handshake steps: a
         # server that trickles — dial completes just in time, then the
@@ -613,7 +881,10 @@ class ZKClient(EventEmitter):
                 reader.readexactly(length), step_timeout()
             )
             resp = proto.ConnectResponse.read(Reader(payload))
-        except Exception:
+        except BaseException:
+            # BaseException, not Exception: a raced attempt that loses
+            # gets CancelledError mid-handshake and must still close its
+            # half-open socket.
             writer.close()
             raise
 
@@ -622,23 +893,18 @@ class ZKClient(EventEmitter):
             writer.close()
             self._emit_expired()
             raise SessionExpiredError()
-        if resp.read_only and not allow_read_only:
-            # A read-only member while the pass is still hunting for a
-            # read-write one: drop the TRANSPORT only (no CLOSE_SESSION
-            # — the session stays alive server-side, exactly like a
-            # reconnect) and let connect() note the fallback.  ADOPT the
-            # session the handshake just established/attached first: a
-            # fresh client that hunted past N read-only members would
-            # otherwise mint a new session per refused handshake —
-            # orphans that, under quorum loss (leader-only expiry),
-            # could never be reaped.  The fallback (or the next pass)
-            # reattaches this same session instead.
-            self.session_id = resp.session_id
-            self.session_passwd = resp.passwd
-            self.negotiated_timeout_ms = resp.timeout_ms
-            writer.close()
-            raise _ReadOnlyMember()
+        return reader, writer, resp
 
+    async def _adopt_connection(
+        self,
+        host: str,
+        port: int,
+        reader: asyncio.StreamReader,
+        writer: asyncio.StreamWriter,
+        resp: "proto.ConnectResponse",
+    ) -> None:
+        """Install a successful handshake as THE connection: session
+        fields, read/ping loops, auth replay, watch re-arm, events."""
         reattached = self.session_id == resp.session_id and self.session_id != 0
         # NOT consumed yet: the handshake tail below (auth replay, watch
         # re-arm) still awaits, and a drop there aborts this attempt —
@@ -672,6 +938,12 @@ class ZKClient(EventEmitter):
             host, port, self.session_id, self.negotiated_timeout_ms,
             " (read-only)" if self.read_only else "",
         )
+        if self._failover_started_at is not None:
+            # The whole between-members window (teardown -> this
+            # handshake), surfaced via GET /status for the "why was
+            # recovery slow" runbook question.
+            self.last_failover_s = time.monotonic() - self._failover_started_at
+            self._failover_started_at = None
         if self._failover_span is not None:
             # Failover complete: the span's duration is the whole
             # between-members window (including any election wait).
@@ -835,6 +1107,10 @@ class ZKClient(EventEmitter):
             self.emit("state", "disconnected")
             self.emit("close")
         if not expected and not self._closed and self.reconnect:
+            if was_connected and self._failover_started_at is None:
+                # Failover clock: closed by the next successful
+                # _adopt_connection (last_failover_s).
+                self._failover_started_at = time.monotonic()
             tr = trace.tracer_for(self)
             if tr.enabled and was_connected and self._failover_span is None:
                 # The session is now between members: one zk.failover
@@ -1285,6 +1561,28 @@ class ZKClient(EventEmitter):
             out.append(fut.result() if err is None else err)
         return out
 
+    def _ping_schedule(self) -> Tuple[float, float]:
+        """(ping interval, dead-after) seconds for the current session.
+
+        The default is the Apache client's thirds rule off the
+        NEGOTIATED timeout: ping every third, declare the server dead
+        after two-thirds of silence.  ``ping_interval_ms`` /
+        ``dead_after_ms`` override each half independently (ISSUE 20's
+        sub-session-timeout failure detection); an overridden dead-after
+        is floored at the effective interval so the watchdog can never
+        fire between its own pings."""
+        if self.ping_interval_ms is not None:
+            interval = self.ping_interval_ms / 1000.0
+        else:
+            interval = max(self.negotiated_timeout_ms / 3000.0, 0.02)
+        if self.dead_after_ms is not None:
+            dead_after = max(self.dead_after_ms / 1000.0, interval)
+        else:
+            dead_after = max(
+                self.negotiated_timeout_ms * 2 / 3000.0, 2 * interval
+            )
+        return interval, dead_after
+
     async def _ping_loop(self) -> None:
         """Session keepalive + server-liveness watchdog.
 
@@ -1292,9 +1590,9 @@ class ZKClient(EventEmitter):
         more than 2/3 of the session timeout — TCP alive but unresponsive —
         the connection is torn down so the reconnect machinery can find a
         working server before the session expires (the same policy as the
-        Apache ZooKeeper client's readTimeout)."""
-        interval = max(self.negotiated_timeout_ms / 3000.0, 0.02)
-        dead_after = max(self.negotiated_timeout_ms * 2 / 3000.0, 2 * interval)
+        Apache ZooKeeper client's readTimeout).  Both knobs are tunable:
+        :meth:`_ping_schedule`."""
+        interval, dead_after = self._ping_schedule()
         try:
             while self._connected:
                 await asyncio.sleep(interval)
@@ -1305,6 +1603,7 @@ class ZKClient(EventEmitter):
                         "no server response in %.1fs; dropping connection",
                         dead_after,
                     )
+                    self.watchdog_drops += 1
                     await self._teardown(expected=False)
                     return
                 try:
@@ -1336,6 +1635,7 @@ class ZKClient(EventEmitter):
                         "send buffer stalled for the remaining dead-after "
                         "budget (peer stopped reading); dropping connection",
                     )
+                    self.watchdog_drops += 1
                     await self._teardown(expected=False)
                     return
                 except (ConnectionError, OSError):
@@ -2026,6 +2326,9 @@ async def create_zk_client(
     can_be_read_only: bool = False,
     rng: Optional[random.Random] = None,
     attach_preference: str = "any",
+    connect_race_stagger_ms: Optional[int] = None,
+    ping_interval_ms: Optional[int] = None,
+    dead_after_ms: Optional[int] = None,
 ) -> ZKClient:
     """Create and connect a client, retrying forever (reference lib/zk.js:62-127).
 
@@ -2047,6 +2350,9 @@ async def create_zk_client(
         can_be_read_only=can_be_read_only,
         rng=rng,
         attach_preference=attach_preference,
+        connect_race_stagger_ms=connect_race_stagger_ms,
+        ping_interval_ms=ping_interval_ms,
+        dead_after_ms=dead_after_ms,
     )
     return await connect_with_backoff(
         client, on_attempt=on_attempt, retry_policy=retry_policy

@@ -36,7 +36,20 @@ Coherence model (docs/DESIGN.md "Watch-coherent resolve cache"):
     the next clean connect.  A reconnect (including a
     ``surviveSessionExpiry`` rebirth) resumes *cold but authoritative*:
     entries were flushed, and each refill arms fresh watches on the new
-    connection, so nothing cached can predate the session boundary.
+    connection, so nothing cached can predate the session boundary;
+  * **stale-while-revalidate** (ISSUE 20, opt-in ``stale_max_age_s``,
+    config ``cache.staleMaxAgeS``): the RFC 8767 serve-stale stance the
+    DNS frontend and shard tier already take, promoted into the core
+    cache.  Instead of flushing on a session drop, last-known-good
+    entries keep answering for a bounded window — a backend blip or
+    election is not a resolve outage for names whose data never changed
+    — while the client's reconnect machinery IS the revalidation.  Past
+    the bound the whole stale world is flushed and lookups fail
+    truthfully; restoring authority flushes too (the invalidations
+    missed while dark make every retained entry unprovable), and a
+    terminal session expiry always flushes, so a rebirth can never
+    resurrect a stale answer.  Default None: flush-on-degrade,
+    reference-exact.
 
 Single-flight fills: concurrent misses for one path share one in-flight
 read, so a cold hot domain costs one RPC burst, not one per waiter.
@@ -118,14 +131,25 @@ class ZKCache(EventEmitter):
         zk: ZKClient,
         max_entries: int = DEFAULT_MAX_ENTRIES,
         fill_concurrency: Optional[int] = None,
+        stale_max_age_s: Optional[float] = None,
     ):
         super().__init__()
         if max_entries < 1:
             raise ValueError("max_entries must be >= 1")
         if fill_concurrency is not None and fill_concurrency < 0:
             raise ValueError("fill_concurrency must be >= 0")
+        if stale_max_age_s is not None and stale_max_age_s < 0:
+            raise ValueError("stale_max_age_s must be >= 0")
         self._zk = zk
         self.max_entries = max_entries
+        #: serve-stale bound in seconds (module docstring).  None =
+        #: reference-exact flush-on-degrade; 0 = fail closed (entries
+        #: drop the moment authority is lost, like ``staleTtl: 0``).
+        self.stale_max_age_s = stale_max_age_s
+        #: monotonic stamp of the moment authority was lost with entries
+        #: retained (the serve-stale window's start); None while
+        #: authoritative or when SWR is off
+        self._stale_since: Optional[float] = None
         #: cold-fill stampede bound (ISSUE 17): at most this many
         #: DISTINCT-path read_node fills in flight at once; the next
         #: would-be fill LEADER raises :class:`CacheOverloadError`
@@ -173,6 +197,8 @@ class ZKCache(EventEmitter):
             "coherence_lag_ms_total": 0.0,
             "coherence_lag_count": 0,
             "fill_sheds": 0,
+            "stale_serves": 0,
+            "stale_refusals": 0,
         }
         self._was_authoritative = self.authoritative
         zk.on("close", self._on_close)
@@ -214,7 +240,7 @@ class ZKCache(EventEmitter):
         # A fresh connection re-arms per-fill; the previous connection's
         # re-arm verdict is moot once it is gone.
         self._rearm_failed = False
-        self.clear()
+        self._lose_authority()
         self._authority_changed("disconnected")
 
     def _on_connect(self, *_a) -> None:
@@ -222,21 +248,52 @@ class ZKCache(EventEmitter):
         # Cold but authoritative: everything cached before the drop was
         # flushed, and every refill arms fresh watches on THIS
         # connection — unless this connect's batch re-arm failed
-        # (watch_rearm_failed fires before the connect event).
+        # (watch_rearm_failed fires before the connect event).  With
+        # serve-stale this is the revalidation landing: the retained
+        # entries are unprovable (their invalidations may have fired
+        # while we were dark) and flush here too.
         self.clear()
         self._authority_changed("connected")
 
     def _on_session_expired(self, *_a) -> None:
         # Terminal expiry (surviveSessionExpiry off, or its breaker
         # tripped): the client is permanently closed; so is authority.
+        # ALWAYS flushes — serve-stale never outlives the session's
+        # death, so a later rebirth cannot resurrect a stale answer.
         self._terminal = True
         self.clear()
         self._authority_changed("session_expired")
 
     def _on_rearm_failed(self, *_a) -> None:
         self._rearm_failed = True
-        self.clear()
+        self._lose_authority()
         self._authority_changed("watch_rearm_failed")
+
+    def _lose_authority(self) -> None:
+        """Authority lost on a non-terminal path: flush (reference), or
+        — with ``stale_max_age_s`` set — open the serve-stale window and
+        keep the last-known-good entries for its bounded duration."""
+        if self.stale_max_age_s is None or self._terminal:
+            self.clear()
+            return
+        if self._stale_since is None:
+            self._stale_since = time.monotonic()
+
+    def _stale_entry(self, path: str) -> Optional[_Entry]:
+        """A bounded-age last-known-good entry servable while degraded,
+        or None.  Crossing the age bound refuses and flushes the whole
+        stale world: past it nothing retained is provable, and lookups
+        must fail truthfully instead of answering from history."""
+        if self._stale_since is None:
+            return None
+        entry = self._entries.get(path)
+        if entry is None:
+            return None
+        if time.monotonic() - self._stale_since > self.stale_max_age_s:
+            self.stats["stale_refusals"] += 1
+            self.clear()
+            return None
+        return entry
 
     def clear(self) -> None:
         """Flush every entry and kill every in-flight store (epoch bump)."""
@@ -244,6 +301,7 @@ class ZKCache(EventEmitter):
         self._gens.clear()
         self._lag_candidates.clear()
         self._epoch += 1
+        self._stale_since = None
         self.stats["clears"] += 1
 
     def close(self) -> None:
@@ -352,6 +410,16 @@ class ZKCache(EventEmitter):
         """Cached :meth:`ZKClient.read_node`: ``(data, stat, children)``
         or None when absent (served from the negative cache)."""
         if not self.authoritative:
+            stale = self._stale_entry(path)
+            if stale is not None and (
+                stale.negative or stale.children is not None
+            ):
+                # Serve-stale (ISSUE 20): a bounded-age last-known-good
+                # answer through the blip, RFC 8767 style.
+                self.stats["stale_serves"] += 1
+                if stale.negative:
+                    return None
+                return (stale.data, stale.stat, list(stale.children))
             self.stats["bypasses"] += 1
             return await self._zk.read_node(path)
         entry = self._entries.get(path)
@@ -370,6 +438,19 @@ class ZKCache(EventEmitter):
         pipelined watch-arming burst."""
         paths = list(paths)
         if not self.authoritative:
+            if paths and self._stale_since is not None:
+                # All-or-nothing: a batch mixing stale entries with live
+                # reads would compose an answer no single point in time
+                # ever looked like — serve stale only when EVERY path is
+                # covered, else fall through whole (and fail truthfully
+                # if the backend is dark).
+                stale = [self._stale_entry(p) for p in paths]
+                if all(e is not None for e in stale):
+                    self.stats["stale_serves"] += len(stale)
+                    return [
+                        None if e.negative else (e.data, e.stat)
+                        for e in stale
+                    ]
             self.stats["bypasses"] += 1
             return await self._zk.get_many(paths)
         out: List[Optional[Tuple[bytes, Stat]]] = [None] * len(paths)

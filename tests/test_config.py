@@ -505,3 +505,63 @@ class TestServeBlock:
             "serve": {"shards": 2, "socketPath": "/run/r.sock"},
         })
         assert "serve" not in cfg.unknown_keys
+
+
+class TestAvailabilityKnobs:
+    """ISSUE 20: the nines levers — raced connects, sub-session-timeout
+    failure detection, and serve-stale — every key absent means
+    reference-exact behavior, and each parses/validates independently."""
+
+    BASE = {
+        "registration": {"domain": "a.b.c", "type": "host"},
+        "zookeeper": {"servers": [{"host": "h", "port": 2181}]},
+    }
+
+    def _zk(self, **extra):
+        return {
+            **self.BASE,
+            "zookeeper": {
+                "servers": [{"host": "h", "port": 2181}], **extra,
+            },
+        }
+
+    def test_absent_keys_mean_reference_behavior(self):
+        cfg = parse_config(dict(self.BASE))
+        assert cfg.zookeeper.connect_race_stagger_ms is None
+        assert cfg.zookeeper.ping_interval_ms is None
+        assert cfg.zookeeper.dead_after_ms is None
+
+    def test_zookeeper_knobs_parse(self):
+        cfg = parse_config(self._zk(
+            connectRaceStaggerMs=40, pingIntervalMs=40, deadAfterMs=100,
+        ))
+        assert cfg.zookeeper.connect_race_stagger_ms == 40
+        assert cfg.zookeeper.ping_interval_ms == 40
+        assert cfg.zookeeper.dead_after_ms == 100
+        # JSON null is the same as absent
+        cfg = parse_config(self._zk(connectRaceStaggerMs=None))
+        assert cfg.zookeeper.connect_race_stagger_ms is None
+
+    @pytest.mark.parametrize(
+        "key", ["connectRaceStaggerMs", "pingIntervalMs", "deadAfterMs"]
+    )
+    @pytest.mark.parametrize("bad", [0, -1, "fast", True, float("nan")])
+    def test_zookeeper_knobs_validate(self, key, bad):
+        with pytest.raises(ConfigError):
+            parse_config(self._zk(**{key: bad}))
+
+    def test_stale_max_age_parses(self):
+        cfg = parse_config({**self.BASE, "cache": {"staleMaxAgeS": 30}})
+        assert cfg.cache.stale_max_age_s == 30.0
+        cfg = parse_config({**self.BASE, "cache": {"staleMaxAgeS": 2.5}})
+        assert cfg.cache.stale_max_age_s == 2.5
+        # absent (or null) = the PR-4 flush-on-degrade default
+        cfg = parse_config({**self.BASE, "cache": {}})
+        assert cfg.cache.stale_max_age_s is None
+        cfg = parse_config({**self.BASE, "cache": {"staleMaxAgeS": None}})
+        assert cfg.cache.stale_max_age_s is None
+
+    @pytest.mark.parametrize("bad", [-1, "long", True, float("inf")])
+    def test_stale_max_age_validates(self, bad):
+        with pytest.raises(ConfigError):
+            parse_config({**self.BASE, "cache": {"staleMaxAgeS": bad}})

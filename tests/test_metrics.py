@@ -372,6 +372,7 @@ class TestCacheInstrumentation:
             stats = {
                 "hits": 0, "misses": 0, "invalidations": 0,
                 "bypasses": 0, "degraded_total": 0, "evictions": 0,
+                "stale_serves": 0, "stale_refusals": 0,
                 "coherence_lag_ms_last": 0.0,
                 "coherence_lag_ms_total": 0.0, "coherence_lag_count": 0,
             }
@@ -392,6 +393,8 @@ class TestCacheInstrumentation:
             "registrar_cache_invalidations_total 0",
             "registrar_cache_bypasses_total 0",
             "registrar_cache_degraded_total 0",
+            "registrar_cache_stale_serves_total 0",
+            "registrar_cache_stale_refusals_total 0",
             "registrar_cache_evictions_total 0",
             "registrar_cache_coherence_lag_seconds_total 0",
             "registrar_cache_coherence_lag_count 0",

@@ -10,10 +10,12 @@ the session/ephemeral behavior the reference never tests.
 """
 
 import asyncio
+import time
 
 import pytest
 
 from registrar_tpu.retry import RetryPolicy
+from registrar_tpu.testing.netem import DOWN, UP, Blackhole, ChaosProxy
 from registrar_tpu.testing.server import ZKServer
 from registrar_tpu.zk.client import (
     OwnershipError,
@@ -1340,4 +1342,198 @@ class TestWatchRearmFailure:
         finally:
             client._submit = orig
             await client.close()
+            await server.stop()
+
+
+class TestRacedConnect:
+    """ISSUE 20 tentpole: happy-eyeballs staggered connects, opt-in via
+    ``connect_race_stagger_ms``.  The serial reference pass must stay
+    byte-identical when the knob is absent."""
+
+    async def test_race_beats_hung_candidate(self):
+        """A candidate that accepts TCP but never answers the handshake
+        must not serialize the pass: the stagger releases the next
+        member, which wins in milliseconds instead of after the hung
+        one's full connect timeout."""
+
+        async def _hold(reader, writer):
+            try:
+                await asyncio.sleep(30)
+            finally:
+                writer.close()
+
+        hung = await asyncio.start_server(_hold, "127.0.0.1", 0)
+        hung_addr = hung.sockets[0].getsockname()[:2]
+        server = await ZKServer().start()
+        client = None
+        try:
+            client = ZKClient(
+                [tuple(hung_addr), server.address],
+                connect_race_stagger_ms=30,
+                connect_timeout_ms=3000,
+                # spread:0-of-1 pins the candidate order (no shuffle):
+                # the hung member is ALWAYS dialed first, so a fast
+                # connect proves the race, not shuffle luck.
+                attach_preference="spread:0-of-1",
+            )
+            t0 = time.monotonic()
+            await client.connect()
+            elapsed = time.monotonic() - t0
+            assert client.connected
+            # Far under the 3s the serial pass would burn waiting out
+            # the hung candidate before even dialing the live one.
+            assert elapsed < 2.0
+            assert client.race_stats["wins"] == 1
+            host, port = server.address
+            assert client.race_stats["last_winner"] == f"{host}:{port}"
+            assert client.race_stats["last_candidates"] == 2
+            # The session works end to end.
+            await client.mkdirp("/raced")
+            assert await client.exists("/raced") is not None
+        finally:
+            if client is not None:
+                await client.close()
+            hung.close()
+            await hung.wait_closed()
+            await server.stop()
+
+    async def test_losing_handshake_closes_its_session(self):
+        """Fresh-session races mint one session per handshake; the loser
+        must CLOSE_SESSION so the ensemble never accumulates orphans
+        (which under quorum loss could not even expire)."""
+        server = await ZKServer().start()
+        client = ZKClient(
+            [server.address, server.address], connect_race_stagger_ms=0
+        )
+        orig = client._dial_handshake
+        n_done = 0
+        gate = asyncio.Event()
+
+        async def gated(host, port, max_wait=None):
+            # Let BOTH handshakes complete before either returns, so the
+            # race deterministically sees one winner and one completed
+            # loser (not a cancelled half-dial).
+            nonlocal n_done
+            res = await orig(host, port, max_wait=max_wait)
+            n_done += 1
+            if n_done >= 2:
+                gate.set()
+                # Yield once so the gate-parked attempt finishes before
+                # this one does: both land in the same done-set and the
+                # loser takes the completed-handshake abort path.
+                await asyncio.sleep(0)
+                return res
+            await gate.wait()
+            return res
+
+        client._dial_handshake = gated
+        try:
+            await client.connect()
+            assert client.race_stats["wins"] == 1
+            assert client.race_stats["last_candidates"] == 2
+            assert client.race_stats["last_aborted"] == 1
+            # The loser's freshly-minted session gets closed server-side;
+            # only the winner's survives.
+            for _ in range(200):
+                if len(server.sessions) == 1:
+                    break
+                await asyncio.sleep(0.02)
+            assert len(server.sessions) == 1
+            assert client.session_id in server.sessions
+        finally:
+            await client.close()
+            await server.stop()
+
+    async def test_knob_absent_uses_serial_reference_pass(self):
+        """Config parity: without ``connect_race_stagger_ms`` the raced
+        path must never run — the serial pass is reference-exact."""
+        server = await ZKServer().start()
+        client = ZKClient([server.address])
+
+        async def boom(order, deadline):  # pragma: no cover - must not run
+            raise AssertionError("raced connect used without the knob")
+
+        client._connect_raced = boom
+        try:
+            await client.connect()
+            assert client.connected
+            assert client.race_stats == {
+                "wins": 0,
+                "last_winner": None,
+                "last_candidates": 0,
+                "last_aborted": 0,
+            }
+        finally:
+            await client.close()
+            await server.stop()
+
+    async def test_knob_validation(self):
+        with pytest.raises(ValueError):
+            ZKClient([("127.0.0.1", 1)], connect_race_stagger_ms=-1)
+        with pytest.raises(ValueError):
+            ZKClient([("127.0.0.1", 1)], ping_interval_ms=0)
+        with pytest.raises(ValueError):
+            ZKClient([("127.0.0.1", 1)], dead_after_ms=-5)
+
+
+class TestPingSchedule:
+    """ISSUE 20 tentpole: sub-session-timeout failure detection.  The
+    default schedule is the Apache client's thirds rule off the
+    negotiated timeout; ``ping_interval_ms`` / ``dead_after_ms``
+    override each half independently."""
+
+    async def test_reference_thirds_rule(self):
+        client = ZKClient([("127.0.0.1", 1)])
+        client.negotiated_timeout_ms = 6000
+        assert client._ping_schedule() == (2.0, 4.0)
+        # Tiny negotiated timeouts hit the interval floor (20ms) and the
+        # dead-after floor (two intervals).
+        client.negotiated_timeout_ms = 30
+        assert client._ping_schedule() == (0.02, 0.04)
+
+    async def test_overrides_decouple_from_session_timeout(self):
+        client = ZKClient(
+            [("127.0.0.1", 1)], ping_interval_ms=40, dead_after_ms=100
+        )
+        client.negotiated_timeout_ms = 6000
+        # 40ms/100ms detection under a 6s session: the whole point.
+        assert client._ping_schedule() == (0.04, 0.1)
+
+    async def test_dead_after_floored_at_interval(self):
+        """The watchdog can never fire between its own pings: an
+        inverted configuration floors dead-after at the interval."""
+        client = ZKClient(
+            [("127.0.0.1", 1)], ping_interval_ms=500, dead_after_ms=100
+        )
+        client.negotiated_timeout_ms = 6000
+        assert client._ping_schedule() == (0.5, 0.5)
+
+    async def test_watchdog_drops_blackholed_connection(self):
+        """TCP alive but totally unresponsive (blackhole both ways): the
+        tuned watchdog declares the server dead in ~dead_after_ms, far
+        inside the session timeout."""
+        server = await ZKServer().start()
+        proxy = ChaosProxy(server.address)
+        await proxy.start()
+        client = None
+        try:
+            client = await ZKClient(
+                [proxy.address],
+                ping_interval_ms=20,
+                dead_after_ms=80,
+                reconnect=False,
+            ).connect()
+            closed = asyncio.Event()
+            client.on("close", lambda *_a: closed.set())
+            proxy.add(Blackhole(), direction=UP)
+            proxy.add(Blackhole(), direction=DOWN)
+            t0 = time.monotonic()
+            await asyncio.wait_for(closed.wait(), timeout=5)
+            assert client.watchdog_drops >= 1
+            # Suspicion well inside even the minimum session timeout.
+            assert time.monotonic() - t0 < 2.0
+        finally:
+            if client is not None:
+                await client.close()
+            await proxy.stop()
             await server.stop()

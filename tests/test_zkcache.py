@@ -20,6 +20,8 @@ The contract under test (registrar_tpu/zkcache.py, docs/DESIGN.md):
 
 import asyncio
 
+import pytest
+
 from registrar_tpu import binderview
 from registrar_tpu.records import domain_to_path, host_record, payload_bytes
 from registrar_tpu.registration import register, unregister
@@ -488,3 +490,130 @@ class TestEviction:
             await reader.close()
             await writer.close()
             await server.stop()
+
+
+class TestStaleWhileRevalidate:
+    """ISSUE 20: serve-stale (RFC 8767 stance), opt-in ``stale_max_age_s``.
+
+    Extends the PR-4 invariants: with the knob set, a blip serves
+    bounded-age last-known-good answers instead of flushing; past the
+    bound the cache refuses truthfully and flushes; a restore or a
+    session death always lands on a flushed, stale-free world.  With the
+    knob absent every PR-4 test above pins the flush-on-degrade default.
+    """
+
+    async def test_serves_last_known_good_through_blip(self):
+        server, writer, reader = await _stack()
+        cache = ZKCache(reader, stale_max_age_s=30.0)
+        try:
+            warm = await binderview.resolve(cache, DOMAIN, "A")
+            assert cache.authoritative
+            # Degrade WITHOUT killing the transport: coherence is gone
+            # (watches dead) but the blip is young — serve stale.
+            reader.emit("watch_rearm_failed", RuntimeError("boom"))
+            assert not cache.authoritative
+            res = await binderview.resolve(cache, DOMAIN, "A")
+            assert sorted(a.data for a in res.answers) == sorted(
+                a.data for a in warm.answers
+            )
+            assert cache.stats["stale_serves"] > 0
+            assert cache.entries > 0  # retained, not flushed
+        finally:
+            cache.close()
+            await reader.close()
+            await writer.close()
+            await server.stop()
+
+    async def test_over_age_refuses_and_flushes(self):
+        server, writer, reader = await _stack()
+        cache = ZKCache(reader, stale_max_age_s=0.05)
+        try:
+            await binderview.resolve(cache, DOMAIN, "A")
+            reader.emit("watch_rearm_failed", RuntimeError("boom"))
+            assert not cache.authoritative
+            await asyncio.sleep(0.1)  # cross the age bound
+            # A write made after coherence died: past the bound the cache
+            # must answer with live truth, never with history.
+            await writer.set_data(
+                f"{PATH}/inst0",
+                payload_bytes(host_record("load_balancer", "10.9.9.9")),
+            )
+            res = await binderview.resolve(cache, DOMAIN, "A")
+            assert "10.9.9.9" in [a.data for a in res.answers]
+            assert cache.stats["stale_refusals"] >= 1
+            assert cache.entries == 0  # the whole stale world flushed
+        finally:
+            cache.close()
+            await reader.close()
+            await writer.close()
+            await server.stop()
+
+    async def test_restore_flushes_the_stale_world(self):
+        server, writer, reader = await _stack()
+        cache = ZKCache(reader, stale_max_age_s=30.0)
+        try:
+            await binderview.resolve(cache, DOMAIN, "A")
+            degraded = asyncio.Event()
+            cache.on("degraded", lambda _r: degraded.set())
+            await server.drop_connections()
+            await asyncio.wait_for(degraded.wait(), timeout=5)
+
+            async def restored():
+                return cache.authoritative
+
+            await _converge(restored)
+            # Revalidation landed: the retained stale entries are gone
+            # (cold start) — nothing cached under the dead watches can
+            # leak into the authoritative world.
+            assert cache.entries == 0
+            res = await binderview.resolve(cache, DOMAIN, "A")
+            assert len(res.answers) == 2
+        finally:
+            cache.close()
+            await reader.close()
+            await writer.close()
+            await server.stop()
+
+    async def test_rebirth_never_resurrects_stale(self):
+        """Session death ALWAYS flushes, serve-stale or not: a write made
+        while the cache was dark must be visible after rebirth, never the
+        retained pre-death answer."""
+        server, writer, _ = await _stack()
+        reader = await ZKClient(
+            [server.address],
+            survive_session_expiry=True,
+            reconnect_policy=FAST_RECONNECT,
+        ).connect()
+        cache = ZKCache(reader, stale_max_age_s=30.0)
+        try:
+            await binderview.resolve(cache, DOMAIN, "A")
+            reborn = asyncio.Event()
+            reader.on("session_reborn", lambda _sid: reborn.set())
+            await server.expire_session(reader.session_id)
+            await writer.set_data(
+                f"{PATH}/inst1",
+                payload_bytes(host_record("load_balancer", "10.6.6.6")),
+            )
+            await asyncio.wait_for(reborn.wait(), timeout=10)
+
+            async def fresh():
+                if not cache.authoritative:
+                    return False
+                res = await binderview.resolve(cache, DOMAIN, "A")
+                return "10.6.6.6" in [a.data for a in res.answers]
+
+            await _converge(fresh)
+            live = await binderview.resolve(writer, DOMAIN, "A")
+            cached = await binderview.resolve(cache, DOMAIN, "A")
+            assert sorted(a.data for a in cached.answers) == sorted(
+                a.data for a in live.answers
+            )
+        finally:
+            cache.close()
+            await reader.close()
+            await writer.close()
+            await server.stop()
+
+    async def test_knob_validation(self):
+        client = ZKClient([("127.0.0.1", 1)])
+        pytest.raises(ValueError, ZKCache, client, stale_max_age_s=-1)
